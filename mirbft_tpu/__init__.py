@@ -1,0 +1,16 @@
+"""mirbft_tpu — a TPU-native Mir-BFT atomic-broadcast framework.
+
+A ground-up rebuild of the capabilities of the reference MirBFT library
+(`mbrandenburger/mirbft`, pure Go) designed TPU-first:
+
+* L1 — a deterministic, single-threaded consensus state machine on host CPU
+  (branchy protocol logic stays off-device by design).
+* L2 — a processor layer whose crypto hot path (batch digesting, batch/epoch
+  -change verification, client-signature verification) is executed as padded,
+  vmapped JAX/Pallas kernels on TPU (`mirbft_tpu.ops`), dispatched
+  asynchronously so the event loop never blocks on device latency.
+* L3 — a concurrent node runtime, plus a deterministic in-process test engine
+  that replaces it for simulation/testing.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,326 @@
+"""L0 wire/state schema: consensus messages, network state, and WAL entries.
+
+TPU-native rebuild of the reference's protobuf schema
+(``/root/reference/protos/msgs/msgs.proto``).  We use frozen dataclasses with a
+canonical binary codec (``mirbft_tpu.wire``) instead of protobuf: the codec is
+deterministic (required because epoch-change digests are computed over
+serialized message content on every node), dependency-free, and keeps message
+construction allocation-light on the host side so the hot loop feeds the TPU
+hash batcher without marshaling overhead.
+
+Message vocabulary parity (reference ``msgs.proto:189-207``): 15 message
+variants, 8 persistent WAL entry kinds, NetworkState/Config/Client, and
+Reconfiguration variants.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple, Union
+
+# ---------------------------------------------------------------------------
+# Network state (consensused configuration).  Reference: msgs.proto:18-111.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class NetworkConfig:
+    """Consensused protocol parameters (reference msgs.proto:19-73)."""
+
+    nodes: Tuple[int, ...]
+    checkpoint_interval: int
+    max_epoch_length: int
+    number_of_buckets: int
+    f: int
+
+
+@dataclass(frozen=True, slots=True)
+class ClientState:
+    """Per-client request-window state (reference msgs.proto:75-105)."""
+
+    id: int
+    width: int
+    width_consumed_last_checkpoint: int
+    low_watermark: int
+    committed_mask: bytes
+
+
+@dataclass(frozen=True, slots=True)
+class ReconfigNewClient:
+    id: int
+    width: int
+
+
+@dataclass(frozen=True, slots=True)
+class ReconfigRemoveClient:
+    id: int
+
+
+@dataclass(frozen=True, slots=True)
+class ReconfigNewConfig:
+    config: NetworkConfig
+
+
+Reconfiguration = Union[ReconfigNewClient, ReconfigRemoveClient, ReconfigNewConfig]
+
+
+@dataclass(frozen=True, slots=True)
+class NetworkState:
+    """Reference msgs.proto:18-111 (``reconfigured`` bool intentionally omitted:
+    the reference marks it "TODO, do we need this?" and never reads it)."""
+
+    config: NetworkConfig
+    clients: Tuple[ClientState, ...]
+    pending_reconfigurations: Tuple[Reconfiguration, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# Requests and acks.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class RequestAck:
+    """Digest-attestation for (client_id, req_no) (reference msgs.proto:241-245)."""
+
+    client_id: int
+    req_no: int
+    digest: bytes
+
+
+@dataclass(frozen=True, slots=True)
+class Request:
+    client_id: int
+    req_no: int
+    data: bytes
+
+
+# ---------------------------------------------------------------------------
+# Epoch configuration / view-change payloads.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class EpochConfig:
+    """Reference msgs.proto:321-328."""
+
+    number: int
+    leaders: Tuple[int, ...]
+    planned_expiration: int
+
+
+@dataclass(frozen=True, slots=True)
+class CheckpointMsg:
+    """Checkpoint attestation message (reference msgs.proto:266-269)."""
+
+    seq_no: int
+    value: bytes
+
+
+@dataclass(frozen=True, slots=True)
+class EpochChangeSetEntry:
+    """P-set / Q-set entry (reference msgs.proto:285-289)."""
+
+    epoch: int
+    seq_no: int
+    digest: bytes
+
+
+@dataclass(frozen=True, slots=True)
+class EpochChange:
+    """PBFT view-change message, Mir-adapted (reference msgs.proto:275-299)."""
+
+    new_epoch: int
+    checkpoints: Tuple[CheckpointMsg, ...]
+    p_set: Tuple[EpochChangeSetEntry, ...]
+    q_set: Tuple[EpochChangeSetEntry, ...]
+
+
+@dataclass(frozen=True, slots=True)
+class EpochChangeAck:
+    """Reference msgs.proto:305-314."""
+
+    originator: int
+    epoch_change: EpochChange
+
+
+@dataclass(frozen=True, slots=True)
+class NewEpochConfig:
+    """Reference msgs.proto:330-340."""
+
+    config: EpochConfig
+    starting_checkpoint: CheckpointMsg
+    final_preprepares: Tuple[bytes, ...]
+
+
+@dataclass(frozen=True, slots=True)
+class RemoteEpochChange:
+    node_id: int
+    digest: bytes
+
+
+@dataclass(frozen=True, slots=True)
+class NewEpoch:
+    """NewView analogue; config Bracha-broadcast (reference msgs.proto:342-362)."""
+
+    new_config: NewEpochConfig
+    epoch_changes: Tuple[RemoteEpochChange, ...]
+
+
+# ---------------------------------------------------------------------------
+# The 15 consensus message variants (reference msgs.proto:189-207).
+# Variants that share a payload type in the proto oneof (fetch_request /
+# request_ack are both msgs.RequestAck; new_epoch_echo / new_epoch_ready are
+# both NewEpochConfig) get distinct wrapper classes so dispatch is by type.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class Preprepare:
+    seq_no: int
+    epoch: int
+    batch: Tuple[RequestAck, ...]
+
+
+@dataclass(frozen=True, slots=True)
+class Prepare:
+    seq_no: int
+    epoch: int
+    digest: bytes
+
+
+@dataclass(frozen=True, slots=True)
+class Commit:
+    seq_no: int
+    epoch: int
+    digest: bytes
+
+
+@dataclass(frozen=True, slots=True)
+class Suspect:
+    epoch: int
+
+
+@dataclass(frozen=True, slots=True)
+class NewEpochEcho:
+    config: NewEpochConfig
+
+
+@dataclass(frozen=True, slots=True)
+class NewEpochReady:
+    config: NewEpochConfig
+
+
+@dataclass(frozen=True, slots=True)
+class FetchBatch:
+    seq_no: int
+    digest: bytes
+
+
+@dataclass(frozen=True, slots=True)
+class ForwardBatch:
+    seq_no: int
+    request_acks: Tuple[RequestAck, ...]
+    digest: bytes
+
+
+@dataclass(frozen=True, slots=True)
+class FetchRequest:
+    ack: RequestAck
+
+
+@dataclass(frozen=True, slots=True)
+class ForwardRequest:
+    request_ack: RequestAck
+    request_data: bytes
+
+
+@dataclass(frozen=True, slots=True)
+class AckMsg:
+    """Broadcast request acknowledgement (proto oneof field ``request_ack``)."""
+
+    ack: RequestAck
+
+
+Msg = Union[
+    Preprepare,
+    Prepare,
+    Commit,
+    CheckpointMsg,
+    Suspect,
+    EpochChange,
+    EpochChangeAck,
+    NewEpoch,
+    NewEpochEcho,
+    NewEpochReady,
+    FetchBatch,
+    ForwardBatch,
+    FetchRequest,
+    ForwardRequest,
+    AckMsg,
+]
+
+
+# ---------------------------------------------------------------------------
+# Persistent WAL entries (8 kinds; reference msgs.proto:127-186).
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class QEntry:
+    """Persisted before a batch is preprepared (reference msgs.proto:157-164)."""
+
+    seq_no: int
+    digest: bytes
+    requests: Tuple[RequestAck, ...]
+
+
+@dataclass(frozen=True, slots=True)
+class PEntry:
+    """Persisted before a batch is prepared (reference msgs.proto:166-171)."""
+
+    seq_no: int
+    digest: bytes
+
+
+@dataclass(frozen=True, slots=True)
+class CEntry:
+    """Persisted before a Checkpoint message is sent (reference msgs.proto:173-179)."""
+
+    seq_no: int
+    checkpoint_value: bytes
+    network_state: NetworkState
+
+
+@dataclass(frozen=True, slots=True)
+class NEntry:
+    """New sequence-window allocation marker (reference msgs.proto:141-146)."""
+
+    seq_no: int
+    epoch_config: EpochConfig
+
+
+@dataclass(frozen=True, slots=True)
+class FEntry:
+    """Graceful epoch-end marker (reference msgs.proto:148-150)."""
+
+    ends_epoch_config: EpochConfig
+
+
+@dataclass(frozen=True, slots=True)
+class ECEntry:
+    """Epoch-change-sent marker; halts truncation (reference msgs.proto:152-155)."""
+
+    epoch_number: int
+
+
+@dataclass(frozen=True, slots=True)
+class TEntry:
+    """State-transfer-requested marker (reference msgs.proto:157-160)."""
+
+    seq_no: int
+    value: bytes
+
+
+# Suspect doubles as the eighth persistent kind (reference msgs.proto:127-139).
+Persistent = Union[QEntry, PEntry, CEntry, NEntry, FEntry, ECEntry, TEntry, Suspect]

@@ -1,0 +1,181 @@
+"""Unit tests for quorum math, bitmask, bucket mapping, and the view-change
+decision function (reference pkg/statemachine/stateless.go semantics)."""
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+import pytest
+
+from mirbft_tpu import messages as m
+from mirbft_tpu.statemachine import stateless as sl
+
+
+def net_config(n=4, f=1, buckets=4, ci=5, mel=200):
+    return m.NetworkConfig(
+        nodes=tuple(range(n)),
+        checkpoint_interval=ci,
+        max_epoch_length=mel,
+        number_of_buckets=buckets,
+        f=f,
+    )
+
+
+def test_quorums():
+    # n=4, f=1: intersection = (4+1+2)//2 = 3; weak = 2
+    cfg = net_config()
+    assert sl.intersection_quorum(cfg) == 3
+    assert sl.some_correct_quorum(cfg) == 2
+    # n=7, f=2 → (7+2+2)//2 = 5
+    cfg7 = net_config(n=7, f=2)
+    assert sl.intersection_quorum(cfg7) == 5
+    # n=1, f=0 → 1
+    cfg1 = net_config(n=1, f=0, buckets=1)
+    assert sl.intersection_quorum(cfg1) == 1
+    assert sl.some_correct_quorum(cfg1) == 1
+
+
+def test_bucket_mapping():
+    cfg = net_config(buckets=4)
+    assert sl.client_req_to_bucket(1, 2, cfg) == 3
+    assert sl.client_req_to_bucket(2, 2, cfg) == 0
+    assert sl.seq_to_bucket(7, cfg) == 3
+    assert sl.seq_to_bucket(8, cfg) == 0
+
+
+def test_bitmask_msb_first():
+    bm = sl.Bitmask(nbits=16)
+    bm.set_bit(0)
+    assert bm.to_bytes() == b"\x80\x00"
+    bm.set_bit(7)
+    assert bm.to_bytes() == b"\x81\x00"
+    bm.set_bit(8)
+    assert bm.to_bytes() == b"\x81\x80"
+    assert bm.is_bit_set(0) and bm.is_bit_set(7) and bm.is_bit_set(8)
+    assert not bm.is_bit_set(1)
+    # out-of-range reads are False, writes raise
+    assert not bm.is_bit_set(100)
+    with pytest.raises(IndexError):
+        bm.set_bit(100)
+
+
+def test_is_committed():
+    cs = m.ClientState(
+        id=1, width=8, width_consumed_last_checkpoint=0,
+        low_watermark=10, committed_mask=b"\xa0",
+    )
+    assert sl.is_committed(9, cs)  # below watermark
+    assert sl.is_committed(10, cs)  # bit 0 set
+    assert not sl.is_committed(11, cs)
+    assert sl.is_committed(12, cs)  # bit 2 set
+    assert not sl.is_committed(19, cs)  # above window
+
+
+def test_epoch_change_hash_data_layout():
+    ec = m.EpochChange(
+        new_epoch=5,
+        checkpoints=(m.CheckpointMsg(10, b"v"),),
+        p_set=(m.EpochChangeSetEntry(1, 3, b"pd"),),
+        q_set=(m.EpochChangeSetEntry(2, 4, b"qd"),),
+    )
+    data = sl.epoch_change_hash_data(ec)
+    assert data == [
+        (5).to_bytes(8, "big"),
+        (10).to_bytes(8, "big"), b"v",
+        (1).to_bytes(8, "big"), (3).to_bytes(8, "big"), b"pd",
+        (2).to_bytes(8, "big"), (4).to_bytes(8, "big"), b"qd",
+    ]
+
+
+# ---------------------------------------------------------------------------
+# construct_new_epoch_config
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FakeParsed:
+    underlying: m.EpochChange
+    low_watermark: int
+    p_set: Dict[int, m.EpochChangeSetEntry] = field(default_factory=dict)
+    q_set: Dict[int, Dict[int, bytes]] = field(default_factory=dict)
+
+
+def make_change(new_epoch, cp_seq, cp_value, p=(), q=()):
+    return FakeParsed(
+        underlying=m.EpochChange(
+            new_epoch=new_epoch,
+            checkpoints=(m.CheckpointMsg(cp_seq, cp_value),),
+            p_set=tuple(p),
+            q_set=tuple(q),
+        ),
+        low_watermark=cp_seq,
+        p_set={e.seq_no: e for e in p},
+        q_set={
+            e.seq_no: {**{e2.epoch: e2.digest for e2 in q if e2.seq_no == e.seq_no}}
+            for e in q
+        },
+    )
+
+
+def test_new_epoch_config_empty_logs():
+    """All nodes at the same checkpoint with empty P/Q sets → null window."""
+    cfg = net_config(ci=5)
+    changes = {i: make_change(1, 0, b"genesis") for i in range(4)}
+    nec = sl.construct_new_epoch_config(cfg, (0, 1, 2, 3), changes)
+    assert nec is not None
+    assert nec.config.number == 1
+    assert nec.starting_checkpoint == m.CheckpointMsg(0, b"genesis")
+    assert nec.final_preprepares == ()  # nothing selected → null window
+    assert nec.config.planned_expiration == 0 + cfg.max_epoch_length
+
+
+def test_new_epoch_config_insufficient_changes():
+    cfg = net_config()
+    # only 2 of 4 changes, but intersection quorum is 3 → checkpoint fails
+    changes = {i: make_change(1, 0, b"g") for i in range(2)}
+    assert sl.construct_new_epoch_config(cfg, (0,), changes) is None
+
+
+def test_new_epoch_config_selects_prepared_digest():
+    cfg = net_config(ci=5)
+    p_entry = m.EpochChangeSetEntry(epoch=0, seq_no=1, digest=b"D1")
+    q_entry = m.EpochChangeSetEntry(epoch=0, seq_no=1, digest=b"D1")
+    changes = {
+        i: make_change(1, 0, b"g", p=(p_entry,), q=(q_entry,)) for i in range(3)
+    }
+    # fourth node saw nothing
+    changes[3] = make_change(1, 0, b"g")
+    nec = sl.construct_new_epoch_config(cfg, (0, 1, 2, 3), changes)
+    assert nec is not None
+    assert len(nec.final_preprepares) == 2 * cfg.checkpoint_interval
+    assert nec.final_preprepares[0] == b"D1"
+    assert all(d == b"" for d in nec.final_preprepares[1:])
+
+
+def test_new_epoch_config_waits_when_conflicted():
+    """One node prepared a digest but neither A nor B can be satisfied."""
+    cfg = net_config(ci=5)
+    p_entry = m.EpochChangeSetEntry(epoch=0, seq_no=1, digest=b"D1")
+    # two nodes have the P entry but no Q entries anywhere → A2 fails;
+    # B fails because only 2 < 3 nodes lack the P entry.
+    changes = {
+        0: make_change(1, 0, b"g", p=(p_entry,)),
+        1: make_change(1, 0, b"g", p=(p_entry,)),
+        2: make_change(1, 0, b"g"),
+        3: make_change(1, 0, b"g"),
+    }
+    assert sl.construct_new_epoch_config(cfg, (0,), changes) is None
+
+
+def test_new_epoch_config_picks_max_checkpoint():
+    cfg = net_config(ci=5)
+    changes = {
+        0: make_change(1, 10, b"cp10"),
+        1: make_change(1, 10, b"cp10"),
+        2: make_change(1, 0, b"g"),
+        3: make_change(1, 0, b"g"),
+    }
+    # cp10 supported by weak quorum (2 ≥ f+1), watermark coverage:
+    # nodes 2,3 have lw=0 ≤ 10, nodes 0,1 lw=10 ≤ 10 → 4 ≥ 3. cp0 likewise.
+    nec = sl.construct_new_epoch_config(cfg, (0,), changes)
+    assert nec is not None
+    assert nec.starting_checkpoint.seq_no == 10

@@ -1,0 +1,173 @@
+"""Round-trip and determinism tests for the canonical wire codec."""
+
+import io
+
+import pytest
+
+from mirbft_tpu import messages as m
+from mirbft_tpu import state as s
+from mirbft_tpu import wire
+
+
+def sample_network_state() -> m.NetworkState:
+    return m.NetworkState(
+        config=m.NetworkConfig(
+            nodes=(0, 1, 2, 3),
+            checkpoint_interval=20,
+            max_epoch_length=200,
+            number_of_buckets=4,
+            f=1,
+        ),
+        clients=(
+            m.ClientState(
+                id=7,
+                width=100,
+                width_consumed_last_checkpoint=3,
+                low_watermark=42,
+                committed_mask=b"\x80\x01",
+            ),
+        ),
+        pending_reconfigurations=(
+            m.ReconfigNewClient(id=9, width=50),
+            m.ReconfigRemoveClient(id=7),
+        ),
+    )
+
+
+SAMPLES = [
+    m.RequestAck(client_id=1, req_no=2, digest=b"\x00" * 32),
+    m.Preprepare(seq_no=5, epoch=1, batch=(m.RequestAck(1, 2, b"d"),)),
+    m.Prepare(seq_no=5, epoch=1, digest=b"xyz"),
+    m.Commit(seq_no=5, epoch=1, digest=b"xyz"),
+    m.CheckpointMsg(seq_no=20, value=b"cpval"),
+    m.Suspect(epoch=3),
+    m.EpochChange(
+        new_epoch=2,
+        checkpoints=(m.CheckpointMsg(0, b"g"),),
+        p_set=(m.EpochChangeSetEntry(1, 4, b"pd"),),
+        q_set=(m.EpochChangeSetEntry(1, 4, b"qd"),),
+    ),
+    m.NewEpoch(
+        new_config=m.NewEpochConfig(
+            config=m.EpochConfig(number=2, leaders=(0, 1), planned_expiration=220),
+            starting_checkpoint=m.CheckpointMsg(20, b"v"),
+            final_preprepares=(b"", b"abc"),
+        ),
+        epoch_changes=(m.RemoteEpochChange(node_id=1, digest=b"ecd"),),
+    ),
+    m.NewEpochEcho(
+        config=m.NewEpochConfig(
+            config=m.EpochConfig(2, (0,), 220),
+            starting_checkpoint=m.CheckpointMsg(20, b"v"),
+            final_preprepares=(),
+        )
+    ),
+    m.FetchBatch(seq_no=4, digest=b"fb"),
+    m.ForwardBatch(seq_no=4, request_acks=(m.RequestAck(1, 2, b"d"),), digest=b"fb"),
+    m.FetchRequest(ack=m.RequestAck(1, 2, b"d")),
+    m.ForwardRequest(request_ack=m.RequestAck(1, 2, b"d"), request_data=b"payload"),
+    m.AckMsg(ack=m.RequestAck(1, 2, b"d")),
+    m.EpochChangeAck(
+        originator=3,
+        epoch_change=m.EpochChange(2, (), (), ()),
+    ),
+    # persistents
+    m.QEntry(seq_no=1, digest=b"qd", requests=(m.RequestAck(1, 2, b"d"),)),
+    m.PEntry(seq_no=1, digest=b"pd"),
+    m.NEntry(seq_no=1, epoch_config=m.EpochConfig(0, (0, 1, 2, 3), 200)),
+    m.FEntry(ends_epoch_config=m.EpochConfig(0, (0,), 200)),
+    m.ECEntry(epoch_number=2),
+    m.TEntry(seq_no=40, value=b"tv"),
+    # events
+    s.EventInitialParameters(
+        id=1, batch_size=20, heartbeat_ticks=2, suspect_ticks=4,
+        new_epoch_timeout_ticks=8, buffer_size=5 * 1024 * 1024,
+    ),
+    s.EventLoadCompleted(),
+    s.EventTickElapsed(),
+    s.EventActionsReceived(),
+    s.EventHashResult(
+        digest=b"h" * 32,
+        origin=s.BatchOrigin(source=1, epoch=0, seq_no=3, request_acks=()),
+    ),
+    s.EventHashResult(
+        digest=b"h" * 32,
+        origin=s.VerifyBatchOrigin(
+            source=1, seq_no=3, request_acks=(), expected_digest=b"e"
+        ),
+    ),
+    s.EventHashResult(
+        digest=b"h" * 32,
+        origin=s.EpochChangeOrigin(
+            source=1, origin=2, epoch_change=m.EpochChange(2, (), (), ())
+        ),
+    ),
+    s.EventRequestPersisted(request_ack=m.RequestAck(1, 2, b"d")),
+    s.EventStep(source=2, msg=m.Prepare(5, 1, b"xyz")),
+    s.EventStateTransferFailed(seq_no=40, checkpoint_value=b"v"),
+    # actions
+    s.ActionSend(targets=(0, 1, 2), msg=m.Suspect(epoch=1)),
+    s.ActionHashRequest(
+        data=(b"a", b"bb"), origin=s.BatchOrigin(1, 0, 3, ())
+    ),
+    s.ActionPersist(index=3, entry=m.PEntry(1, b"pd")),
+    s.ActionTruncate(index=2),
+    s.ActionCommit(batch=m.QEntry(1, b"qd", ())),
+    s.ActionAllocatedRequest(client_id=1, req_no=2),
+    s.ActionCorrectRequest(ack=m.RequestAck(1, 2, b"d")),
+    s.ActionForwardRequest(targets=(1,), ack=m.RequestAck(1, 2, b"d")),
+    s.ActionStateTransfer(seq_no=40, value=b"v"),
+]
+
+
+@pytest.mark.parametrize("obj", SAMPLES, ids=lambda o: type(o).__name__)
+def test_roundtrip(obj):
+    assert wire.decode(wire.encode(obj)) == obj
+
+
+def test_roundtrip_nested_network_state():
+    ns = sample_network_state()
+    assert wire.decode(wire.encode(ns)) == ns
+    entry = m.CEntry(seq_no=20, checkpoint_value=b"v", network_state=ns)
+    assert wire.decode(wire.encode(entry)) == entry
+    ev = s.EventCheckpointResult(
+        seq_no=20, value=b"v", network_state=ns, reconfigured=True
+    )
+    assert wire.decode(wire.encode(ev)) == ev
+    act = s.ActionCheckpoint(
+        seq_no=20, network_config=ns.config, client_states=ns.clients
+    )
+    assert wire.decode(wire.encode(act)) == act
+
+
+def test_encoding_is_deterministic():
+    ns = sample_network_state()
+    assert wire.encode(ns) == wire.encode(sample_network_state())
+
+
+def test_framed_stream_roundtrip():
+    buf = io.BytesIO()
+    records = [
+        s.RecordedEvent(node_id=1, time=100, state_event=s.EventTickElapsed()),
+        s.RecordedEvent(
+            node_id=2, time=115, state_event=s.EventStep(0, m.Suspect(1))
+        ),
+    ]
+    for r in records:
+        wire.write_framed(buf, r)
+    buf.seek(0)
+    out = []
+    while (rec := wire.read_framed(buf)) is not None:
+        out.append(rec)
+    assert out == records
+
+
+def test_unknown_tag_rejected():
+    with pytest.raises(ValueError):
+        wire.decode(b"\xff\xff\x01")
+
+
+def test_trailing_bytes_rejected():
+    data = wire.encode(m.Suspect(epoch=1)) + b"\x00"
+    with pytest.raises(ValueError):
+        wire.decode(data)
