@@ -1,0 +1,13 @@
+"""TPU compute kernels and their CPU reference implementations.
+
+The crypto hot path of the framework: batched SHA-256 digesting (batch
+digests, batch verification, epoch-change hashing) and, in extended
+configurations, batched Ed25519 signature verification.  The TPU
+implementations are pure-JAX/Pallas kernels over fixed-shape uint32 arrays
+with length bucketing to avoid recompilation; the CPU implementations are
+hashlib-based references used for numerical-equality testing and small runs.
+"""
+
+from .cpu import CpuHasher
+
+__all__ = ["CpuHasher"]

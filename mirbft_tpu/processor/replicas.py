@@ -1,0 +1,124 @@
+"""Network-ingress pre-validation.
+
+Rebuild of reference ``pkg/processor/replicas.go`` + ``msgfilter.go``: a
+structural sanity gate applied to every message before it enters the state
+machine.  With the canonical wire codec, type confusion is already rejected
+at decode time (``mirbft_tpu.wire``); this layer re-validates structure for
+messages arriving through in-process transports that bypass serialization,
+and intercepts ForwardRequest before the state machine (reference
+replicas.go:45-52 — its handling is deliberately external so apps can attach
+their own signature validation; like the reference, the actual buffering is
+not yet implemented).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, get_type_hints
+
+from ..messages import (
+    AckMsg,
+    CheckpointMsg,
+    Commit,
+    EpochChange,
+    EpochChangeAck,
+    FetchBatch,
+    FetchRequest,
+    ForwardBatch,
+    ForwardRequest,
+    Msg,
+    NewEpoch,
+    NewEpochConfig,
+    NewEpochEcho,
+    NewEpochReady,
+    Preprepare,
+    Prepare,
+    RequestAck,
+    Suspect,
+)
+from ..statemachine.actions import Events
+
+_MSG_TYPES = (
+    Preprepare,
+    Prepare,
+    Commit,
+    CheckpointMsg,
+    Suspect,
+    EpochChange,
+    EpochChangeAck,
+    NewEpoch,
+    NewEpochEcho,
+    NewEpochReady,
+    FetchBatch,
+    ForwardBatch,
+    FetchRequest,
+    ForwardRequest,
+    AckMsg,
+)
+
+
+class MessageValidationError(ValueError):
+    pass
+
+
+def pre_process(msg: Msg) -> None:
+    """Structural validation of all 15 message types
+    (reference msgfilter.go:18-105)."""
+    if not isinstance(msg, _MSG_TYPES):
+        raise MessageValidationError(
+            f"unknown message type {type(msg).__name__}"
+        )
+    if isinstance(msg, (FetchRequest, AckMsg)):
+        if not isinstance(msg.ack, RequestAck):
+            raise MessageValidationError("ack field must be a RequestAck")
+    elif isinstance(msg, ForwardRequest):
+        if not isinstance(msg.request_ack, RequestAck):
+            raise MessageValidationError(
+                "ForwardRequest request_ack must be a RequestAck"
+            )
+    elif isinstance(msg, NewEpoch):
+        cfg = msg.new_config
+        if not isinstance(cfg, NewEpochConfig) or cfg.config is None or (
+            cfg.starting_checkpoint is None
+        ):
+            raise MessageValidationError("NewEpoch config incomplete")
+    elif isinstance(msg, (NewEpochEcho, NewEpochReady)):
+        cfg = msg.config
+        if not isinstance(cfg, NewEpochConfig) or cfg.config is None or (
+            cfg.starting_checkpoint is None
+        ):
+            raise MessageValidationError(
+                f"{type(msg).__name__} config incomplete"
+            )
+
+
+class Replica:
+    """Reference replicas.go:34-56."""
+
+    __slots__ = ("id",)
+
+    def __init__(self, replica_id: int):
+        self.id = replica_id
+
+    def step(self, msg: Msg) -> Events:
+        pre_process(msg)
+        if isinstance(msg, ForwardRequest):
+            # Buffered outside the state machine (unimplemented, mirroring
+            # the reference).
+            return Events()
+        return Events().step(self.id, msg)
+
+
+class Replicas:
+    """Reference replicas.go:14-32."""
+
+    __slots__ = ("_replicas",)
+
+    def __init__(self):
+        self._replicas: Dict[int, Replica] = {}
+
+    def replica(self, replica_id: int) -> Replica:
+        r = self._replicas.get(replica_id)
+        if r is None:
+            r = Replica(replica_id)
+            self._replicas[replica_id] = r
+        return r

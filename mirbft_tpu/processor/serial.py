@@ -1,0 +1,181 @@
+"""Pure action-execution functions (reference ``pkg/processor/serial.go``).
+
+Ordering guarantees preserved from the reference:
+* **WAL-before-send**: ``process_wal_actions`` performs all writes/truncates
+  and a sync, then hands the WAL-dependent Sends onward (serial.go:128-156).
+* **reqstore-sync-before-ack**: ``process_reqstore_events`` syncs the request
+  store before its events reach the state machine (serial.go:62-69).
+* Self-sends short-circuit into local Step events (serial.go:166-171).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .. import state as st
+from ..messages import CEntry, EpochConfig, FEntry, NetworkState, Persistent
+from ..statemachine.actions import Actions, Events
+from ..statemachine.machine import StateMachine
+from .interfaces import App, EventInterceptor, Hasher, Link, WAL, RequestStore
+
+
+def process_reqstore_events(req_store: RequestStore, events: Events) -> Events:
+    """Sync the request store, then release the events (durability barrier)."""
+    req_store.sync()
+    return events
+
+
+def initialize_wal_for_new_node(
+    wal: WAL,
+    runtime_params: st.EventInitialParameters,
+    initial_network_state: NetworkState,
+    initial_checkpoint_value: bytes,
+) -> Events:
+    """Seed a fresh WAL with the genesis CEntry + FEntry
+    (reference serial.go:71-113)."""
+    entries: List[Persistent] = [
+        CEntry(
+            seq_no=0,
+            checkpoint_value=initial_checkpoint_value,
+            network_state=initial_network_state,
+        ),
+        FEntry(
+            ends_epoch_config=EpochConfig(
+                number=0,
+                leaders=initial_network_state.config.nodes,
+                planned_expiration=0,
+            )
+        ),
+    ]
+    events = Events().initialize(runtime_params)
+    for i, entry in enumerate(entries):
+        index = i + 1
+        events.load_persisted_entry(index, entry)
+        wal.write(index, entry)
+    events.complete_initialization()
+    wal.sync()
+    return events
+
+
+def recover_wal_for_existing_node(
+    wal: WAL, runtime_params: st.EventInitialParameters
+) -> Events:
+    """Replay an existing WAL into initialization events
+    (reference serial.go:115-126)."""
+    events = Events().initialize(runtime_params)
+    wal.load_all(lambda index, entry: events.load_persisted_entry(index, entry))
+    events.complete_initialization()
+    return events
+
+
+def process_wal_actions(wal: WAL, actions: Actions) -> Actions:
+    """Execute Persist/Truncate actions, sync, and pass Sends through —
+    the fsync-before-send barrier (reference serial.go:128-156)."""
+    net_actions = Actions()
+    for action in actions:
+        if isinstance(action, st.ActionSend):
+            net_actions.push_back(action)
+        elif isinstance(action, st.ActionPersist):
+            wal.write(action.index, action.entry)
+        elif isinstance(action, st.ActionTruncate):
+            wal.truncate(action.index)
+        else:
+            raise AssertionError(
+                f"unexpected WAL action type {type(action).__name__}"
+            )
+    wal.sync()
+    return net_actions
+
+
+def process_net_actions(self_id: int, link: Link, actions: Actions) -> Events:
+    """Sends to self become local Step events (reference serial.go:158-178)."""
+    events = Events()
+    for action in actions:
+        if not isinstance(action, st.ActionSend):
+            raise AssertionError(
+                f"unexpected Net action type {type(action).__name__}"
+            )
+        for replica in action.targets:
+            if replica == self_id:
+                events.step(replica, action.msg)
+            else:
+                link.send(replica, action.msg)
+    return events
+
+
+def process_hash_actions(hasher: Hasher, actions: Actions) -> Events:
+    """The TPU hot path (reference serial.go:180-198, redesigned batched):
+    every ActionHashRequest of the iteration becomes one entry in a single
+    ``hash_batches`` call; the backend pads and vmaps them in one device
+    dispatch.  Results are emitted in action order, so the event stream stays
+    deterministic regardless of device timing."""
+    hash_actions = []
+    for action in actions:
+        if not isinstance(action, st.ActionHashRequest):
+            raise AssertionError(
+                f"unexpected Hash action type {type(action).__name__}"
+            )
+        hash_actions.append(action)
+
+    events = Events()
+    if not hash_actions:
+        return events
+    digests = hasher.hash_batches([action.data for action in hash_actions])
+    if len(digests) != len(hash_actions):
+        raise AssertionError("hasher returned wrong number of digests")
+    for action, digest in zip(hash_actions, digests):
+        events.hash_result(digest, action.origin)
+    return events
+
+
+def process_app_actions(app: App, actions: Actions) -> Events:
+    """Commit / Checkpoint / StateTransfer execution
+    (reference serial.go:200-244)."""
+    events = Events()
+    for action in actions:
+        if isinstance(action, st.ActionCommit):
+            app.apply(action.batch)
+        elif isinstance(action, st.ActionCheckpoint):
+            value, pending_reconfigs = app.snap(
+                action.network_config, action.client_states
+            )
+            events.checkpoint_result(
+                seq_no=action.seq_no,
+                value=value,
+                network_state=NetworkState(
+                    config=action.network_config,
+                    clients=action.client_states,
+                    pending_reconfigurations=tuple(pending_reconfigs),
+                ),
+                reconfigured=bool(pending_reconfigs),
+            )
+        elif isinstance(action, st.ActionStateTransfer):
+            try:
+                network_state = app.transfer_to(action.seq_no, action.value)
+            except Exception:
+                events.state_transfer_failed(action.seq_no, action.value)
+            else:
+                events.state_transfer_complete(
+                    action.seq_no, action.value, network_state
+                )
+        else:
+            raise AssertionError(
+                f"unexpected App action type {type(action).__name__}"
+            )
+    return events
+
+
+def process_state_machine_events(
+    sm: StateMachine, interceptor: Optional[EventInterceptor], events: Events
+) -> Actions:
+    """Apply events to the deterministic state machine, tapping each through
+    the interceptor, and close with an ActionsReceived marker correlating the
+    resulting action batch to its events (reference serial.go:246-270)."""
+    actions = Actions()
+    for event in events:
+        if interceptor is not None:
+            interceptor.intercept(event)
+        actions.concat(sm.apply_event(event))
+    if interceptor is not None:
+        interceptor.intercept(st.EventActionsReceived())
+    return actions

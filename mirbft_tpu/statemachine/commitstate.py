@@ -1,0 +1,383 @@
+"""Commit ordering, checkpoint windows, and per-client committed bookkeeping.
+
+Rebuild of reference ``pkg/statemachine/commitstate.go``: the two
+checkpoint-interval halves of pending QEntries (:24-38), in-order ``drain``
+emitting Commit actions plus a Checkpoint action at the interval boundary
+(:228-269), checkpoint-result application with reconfiguration-aware
+``stop_at_seq_no`` gating (:114-153), state-transfer initiation/resume
+(:91-112), and the ``committingClient`` mask bookkeeping (:271-366).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..messages import (
+    CEntry,
+    CheckpointMsg,
+    ClientState,
+    NetworkConfig,
+    NetworkState,
+    QEntry,
+    ReconfigNewClient,
+    ReconfigNewConfig,
+    ReconfigRemoveClient,
+    TEntry,
+)
+from ..state import EventCheckpointResult
+from .actions import Actions
+from .persisted import PersistedLog
+from .stateless import Bitmask
+
+
+class CommittingClient:
+    """Tracks which request numbers a client committed since the last
+    checkpoint (reference commitstate.go:271-366)."""
+
+    __slots__ = ("last_state", "committed_since_last_checkpoint")
+
+    def __init__(self, seq_no: int, client_state: ClientState):
+        committed: List[Optional[int]] = [None] * client_state.width
+        mask = Bitmask(client_state.committed_mask)
+        for i in range(mask.bits()):
+            if mask.is_bit_set(i) and i < len(committed):
+                committed[i] = seq_no
+        self.last_state = client_state
+        self.committed_since_last_checkpoint = committed
+
+    def mark_committed(self, seq_no: int, req_no: int) -> None:
+        if req_no < self.last_state.low_watermark:
+            return
+        offset = req_no - self.last_state.low_watermark
+        self.committed_since_last_checkpoint[offset] = seq_no
+
+    def create_checkpoint_state(self) -> ClientState:
+        """Roll the client window forward at a checkpoint boundary
+        (reference commitstate.go:302-366)."""
+        old = self.last_state
+        first_uncommitted: Optional[int] = None
+        last_committed: Optional[int] = None
+        for i, seq in enumerate(self.committed_since_last_checkpoint):
+            req_no = old.low_watermark + i
+            if seq is not None:
+                last_committed = req_no
+            elif first_uncommitted is None:
+                first_uncommitted = req_no
+
+        if last_committed is None:
+            new_state = ClientState(
+                id=old.id,
+                width=old.width,
+                width_consumed_last_checkpoint=0,
+                low_watermark=old.low_watermark,
+                committed_mask=b"",
+            )
+            self.last_state = new_state
+            return new_state
+
+        if first_uncommitted is None:
+            high_watermark = (
+                old.low_watermark
+                + old.width
+                - old.width_consumed_last_checkpoint
+                - 1
+            )
+            if last_committed != high_watermark:
+                raise AssertionError(
+                    "if no client reqs are uncommitted, all through the high "
+                    f"watermark should be committed: {last_committed} != "
+                    f"{high_watermark}"
+                )
+            self.committed_since_last_checkpoint = []
+            new_state = ClientState(
+                id=old.id,
+                width=old.width,
+                width_consumed_last_checkpoint=old.width,
+                low_watermark=last_committed + 1,
+                committed_mask=b"",
+            )
+            self.last_state = new_state
+            return new_state
+
+        width_consumed = first_uncommitted - old.low_watermark
+        self.committed_since_last_checkpoint = (
+            self.committed_since_last_checkpoint[width_consumed:]
+            + [None] * (old.width - width_consumed)
+        )
+
+        mask_bytes = b""
+        if last_committed != first_uncommitted:
+            mask = Bitmask(nbits=8 * ((last_committed - first_uncommitted) // 8 + 1))
+            for i in range(last_committed - first_uncommitted + 1):
+                if self.committed_since_last_checkpoint[i] is None:
+                    continue
+                if i == 0:
+                    raise AssertionError(
+                        "the first uncommitted request cannot be committed"
+                    )
+                mask.set_bit(i)
+            mask_bytes = mask.to_bytes()
+
+        new_state = ClientState(
+            id=old.id,
+            width=old.width,
+            width_consumed_last_checkpoint=width_consumed,
+            low_watermark=first_uncommitted,
+            committed_mask=mask_bytes,
+        )
+        self.last_state = new_state
+        return new_state
+
+
+def next_network_config(
+    starting_state: NetworkState,
+    committing_clients: Dict[int, CommittingClient],
+) -> Tuple[NetworkConfig, Tuple[ClientState, ...]]:
+    """Compute the post-checkpoint network config, applying any pending
+    reconfigurations (reference commitstate.go:188-225)."""
+    next_config = starting_state.config
+    next_clients: List[ClientState] = []
+    for old_client in starting_state.clients:
+        cc = committing_clients.get(old_client.id)
+        if cc is None:
+            raise AssertionError(
+                f"no committing client instance for client {old_client.id}"
+            )
+        next_clients.append(cc.create_checkpoint_state())
+
+    for reconfig in starting_state.pending_reconfigurations:
+        if isinstance(reconfig, ReconfigNewClient):
+            next_clients.append(
+                ClientState(
+                    id=reconfig.id,
+                    width=reconfig.width,
+                    width_consumed_last_checkpoint=0,
+                    low_watermark=0,
+                    committed_mask=b"",
+                )
+            )
+        elif isinstance(reconfig, ReconfigRemoveClient):
+            found = False
+            for i, client in enumerate(next_clients):
+                if client.id == reconfig.id:
+                    del next_clients[i]
+                    found = True
+                    break
+            if not found:
+                raise AssertionError(
+                    f"asked to remove client {reconfig.id} which doesn't exist"
+                )
+        elif isinstance(reconfig, ReconfigNewConfig):
+            next_config = reconfig.config
+
+    return next_config, tuple(next_clients)
+
+
+class CommitState:
+    """Reference commitstate.go:24-38.  Network state only changes at
+    checkpoint boundaries; ``stop_at_seq_no`` pauses ordering past the next
+    checkpoint while a reconfiguration is pending."""
+
+    __slots__ = (
+        "persisted",
+        "committing_clients",
+        "logger",
+        "low_watermark",
+        "last_applied_commit",
+        "highest_commit",
+        "stop_at_seq_no",
+        "active_state",
+        "lower_half_commits",
+        "upper_half_commits",
+        "checkpoint_pending",
+        "transferring",
+    )
+
+    def __init__(self, persisted: PersistedLog, logger=None):
+        self.persisted = persisted
+        self.logger = logger
+        self.committing_clients: Dict[int, CommittingClient] = {}
+        self.low_watermark = 0
+        self.last_applied_commit = 0
+        self.highest_commit = 0
+        self.stop_at_seq_no = 0
+        self.active_state: Optional[NetworkState] = None
+        self.lower_half_commits: List[Optional[QEntry]] = []
+        self.upper_half_commits: List[Optional[QEntry]] = []
+        self.checkpoint_pending = False
+        self.transferring = False
+
+    # --- (re)initialization from the log (reference commitstate.go:52-112) ---
+
+    def reinitialize(self) -> Actions:
+        last_c: Optional[CEntry] = None
+        second_to_last_c: Optional[CEntry] = None
+        last_t: Optional[TEntry] = None
+        for _, entry in self.persisted.entries:
+            if isinstance(entry, CEntry):
+                second_to_last_c, last_c = last_c, entry
+            elif isinstance(entry, TEntry):
+                last_t = entry
+
+        assert last_c is not None, "log must contain a CEntry"
+
+        if second_to_last_c is None or not (
+            second_to_last_c.network_state.pending_reconfigurations
+        ):
+            self.active_state = last_c.network_state
+            self.low_watermark = last_c.seq_no
+        else:
+            # The newest CEntry's state is post-reconfiguration; restart from
+            # the previous one until the epoch gracefully ends.
+            self.active_state = second_to_last_c.network_state
+            self.low_watermark = second_to_last_c.seq_no
+
+        actions = Actions().state_applied(self.low_watermark, self.active_state)
+
+        ci = self.active_state.config.checkpoint_interval
+        if not self.active_state.pending_reconfigurations:
+            self.stop_at_seq_no = last_c.seq_no + 2 * ci
+        else:
+            self.stop_at_seq_no = last_c.seq_no + ci
+
+        self.last_applied_commit = last_c.seq_no
+        self.highest_commit = last_c.seq_no
+        self.lower_half_commits = [None] * ci
+        self.upper_half_commits = [None] * ci
+        self.checkpoint_pending = False
+
+        self.committing_clients = {
+            cs.id: CommittingClient(last_c.seq_no, cs)
+            for cs in last_c.network_state.clients
+        }
+
+        if last_t is None or last_c.seq_no >= last_t.seq_no:
+            self.transferring = False
+            return actions
+
+        # We crashed mid-state-transfer: re-issue the transfer request.
+        self.transferring = True
+        return actions.state_transfer(last_t.seq_no, last_t.value)
+
+    def transfer_to(self, seq_no: int, value: bytes) -> Actions:
+        """Persist a TEntry and request app state transfer
+        (reference commitstate.go:114-123)."""
+        if self.transferring:
+            raise AssertionError("concurrent state transfers are not supported")
+        self.transferring = True
+        return self.persisted.add_t_entry(
+            TEntry(seq_no=seq_no, value=value)
+        ).state_transfer(seq_no, value)
+
+    # --- checkpoint results (reference commitstate.go:125-165) ---
+
+    def apply_checkpoint_result(self, result: EventCheckpointResult) -> Actions:
+        ci = self.active_state.config.checkpoint_interval
+
+        if self.transferring:
+            return Actions()
+
+        if result.seq_no != self.low_watermark + ci:
+            raise AssertionError(
+                f"stale checkpoint result seq={result.seq_no}, expected "
+                f"{self.low_watermark + ci}"
+            )
+
+        if not result.network_state.pending_reconfigurations:
+            self.stop_at_seq_no = result.seq_no + 2 * ci
+        # else: reconfiguration pending — do not extend the stop sequence; the
+        # epoch must end gracefully so the new config activates.
+
+        self.active_state = result.network_state
+        self.lower_half_commits = self.upper_half_commits
+        self.upper_half_commits = [None] * ci
+        self.low_watermark = result.seq_no
+        self.checkpoint_pending = False
+
+        return (
+            self.persisted.add_c_entry(
+                CEntry(
+                    seq_no=result.seq_no,
+                    checkpoint_value=result.value,
+                    network_state=result.network_state,
+                )
+            )
+            .send(
+                self.active_state.config.nodes,
+                CheckpointMsg(seq_no=result.seq_no, value=result.value),
+            )
+            .state_applied(result.seq_no, result.network_state)
+        )
+
+    # --- commits (reference commitstate.go:167-186) ---
+
+    def commit(self, q_entry: QEntry) -> None:
+        if self.transferring:
+            raise AssertionError("must never commit during state transfer")
+        if q_entry.seq_no > self.stop_at_seq_no:
+            raise AssertionError(
+                f"commit seq {q_entry.seq_no} exceeds stop {self.stop_at_seq_no}"
+            )
+        if q_entry.seq_no <= self.low_watermark:
+            # During epoch change we may re-commit already-committed seqnos.
+            return
+
+        if self.highest_commit < q_entry.seq_no:
+            if self.highest_commit + 1 != q_entry.seq_no:
+                raise AssertionError(
+                    f"out-of-order commit: highest={self.highest_commit}, "
+                    f"got {q_entry.seq_no}"
+                )
+            self.highest_commit = q_entry.seq_no
+
+        ci = self.active_state.config.checkpoint_interval
+        upper = q_entry.seq_no - self.low_watermark > ci
+        offset = (q_entry.seq_no - (self.low_watermark + 1)) % ci
+        commits = self.upper_half_commits if upper else self.lower_half_commits
+        existing = commits[offset]
+        if existing is not None:
+            if existing.digest != q_entry.digest:
+                raise AssertionError(
+                    f"conflicting commit digests at seq {q_entry.seq_no}"
+                )
+        else:
+            commits[offset] = q_entry
+
+    def drain(self) -> Actions:
+        """Emit all in-order Commit actions plus the Checkpoint action at the
+        interval boundary (reference commitstate.go:228-269)."""
+        ci = self.active_state.config.checkpoint_interval
+        actions = Actions()
+        while self.last_applied_commit < self.low_watermark + 2 * ci:
+            if (
+                self.last_applied_commit == self.low_watermark + ci
+                and not self.checkpoint_pending
+            ):
+                network_config, client_configs = next_network_config(
+                    self.active_state, self.committing_clients
+                )
+                actions.checkpoint(
+                    self.last_applied_commit, network_config, client_configs
+                )
+                self.checkpoint_pending = True
+
+            next_commit = self.last_applied_commit + 1
+            upper = next_commit - self.low_watermark > ci
+            offset = (next_commit - (self.low_watermark + 1)) % ci
+            commits = self.upper_half_commits if upper else self.lower_half_commits
+            commit = commits[offset]
+            if commit is None:
+                break
+            if commit.seq_no != next_commit:
+                raise AssertionError(
+                    f"attempted out-of-order commit: {commit.seq_no} != "
+                    f"{next_commit}"
+                )
+            actions.commit(commit)
+            for req in commit.requests:
+                self.committing_clients[req.client_id].mark_committed(
+                    commit.seq_no, req.req_no
+                )
+            self.last_applied_commit = next_commit
+
+        return actions

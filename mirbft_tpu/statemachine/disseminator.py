@@ -1,0 +1,627 @@
+"""Client request ACK/dissemination protocol (consensus side).
+
+Rebuild of reference ``pkg/statemachine/client_hash_disseminator.go`` — the
+library's request-dissemination departure from the Mir paper
+(``docs/Clients.md`` "Client ACKs"): per client × req_no, accumulate
+``RequestAck``s; a weak quorum (f+1) marks a request *correct*
+(→ CorrectRequest action), a strong quorum (2f+1) marks it *ready to
+propose*; conflicting correct requests from a byzantine client are resolved
+by promoting the null request; un-replicated correct requests are proactively
+fetched with timeouts; own acks are rebroadcast with linear backoff
+(reference :507-629).
+
+Hardening vs the reference: a replica's first non-null ack per req_no is
+binding — later non-null acks for different digests from the same replica are
+ignored (the reference documents this rule at :106-112 but does not enforce it
+on the hot ack path, see its ``filter`` TODO at :194).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..messages import (
+    AckMsg,
+    ClientState,
+    FetchRequest,
+    Msg,
+    NetworkConfig,
+    NetworkState,
+    RequestAck,
+)
+from ..state import EventInitialParameters
+from .actions import Actions
+from .client_tracker import ClientTracker
+from .msgbuffers import Applyable, MsgBuffer, NodeBuffers
+from .stateless import intersection_quorum, is_committed, some_correct_quorum
+
+CORRECT_FETCH_TICKS = 4
+FETCH_TIMEOUT_TICKS = 4
+ACK_RESEND_TICKS = 20
+
+
+class ClientRequest:
+    """One (client, req_no, digest) candidate (reference :631-668)."""
+
+    __slots__ = (
+        "ack",
+        "agreements",
+        "stored",
+        "fetching",
+        "ticks_fetching",
+        "ticks_correct",
+    )
+
+    def __init__(self, ack: RequestAck):
+        self.ack = ack
+        self.agreements: Set[int] = set()
+        self.stored = False  # persisted locally
+        self.fetching = False
+        self.ticks_fetching = 0
+        self.ticks_correct = 0
+
+    def fetch(self) -> Actions:
+        if self.fetching:
+            return Actions()
+        self.fetching = True
+        self.ticks_fetching = 0
+        return Actions().send(
+            tuple(sorted(self.agreements)), FetchRequest(ack=self.ack)
+        )
+
+
+class ClientReqNo:
+    """Ack accumulation for one (client, req_no) (reference :339-629)."""
+
+    __slots__ = (
+        "my_config",
+        "network_config",
+        "client_id",
+        "req_no",
+        "valid_after_seq_no",
+        "non_null_voters",
+        "requests",
+        "weak_requests",
+        "strong_requests",
+        "my_requests",
+        "committed",
+        "acks_sent",
+        "ticks_since_ack",
+    )
+
+    def __init__(
+        self,
+        my_config: EventInitialParameters,
+        client_id: int,
+        req_no: int,
+        network_config: NetworkConfig,
+        valid_after_seq_no: int,
+    ):
+        self.my_config = my_config
+        self.client_id = client_id
+        self.req_no = req_no
+        self.network_config = network_config
+        self.valid_after_seq_no = valid_after_seq_no
+        self.non_null_voters: Set[int] = set()
+        self.requests: Dict[bytes, ClientRequest] = {}  # all observed
+        self.weak_requests: Dict[bytes, ClientRequest] = {}  # correct
+        self.strong_requests: Dict[bytes, ClientRequest] = {}  # proposable
+        self.my_requests: Dict[bytes, ClientRequest] = {}  # locally persisted
+        self.committed = False
+        self.acks_sent = 0
+        self.ticks_since_ack = 0
+
+    def reinitialize(self, network_config: NetworkConfig) -> None:
+        """Re-derive quorum sets under a (possibly changed) config
+        (reference :371-408)."""
+        self.network_config = network_config
+        old_requests = self.requests
+        self.non_null_voters = set()
+        self.requests = {}
+        self.weak_requests = {}
+        self.strong_requests = {}
+        self.my_requests = {}
+
+        for digest in sorted(old_requests):
+            old_req = old_requests[digest]
+            for node in network_config.nodes:
+                if node in old_req.agreements:
+                    self._apply_request_ack(node, old_req.ack)
+            if old_req.stored:
+                new_req = self.client_req(old_req.ack)
+                new_req.stored = True
+                self.my_requests[digest] = new_req
+
+    def client_req(self, ack: RequestAck) -> ClientRequest:
+        digest_key = ack.digest  # null request → b""
+        req = self.requests.get(digest_key)
+        if req is None:
+            req = ClientRequest(ack)
+            self.requests[digest_key] = req
+        return req
+
+    def apply_new_request(self, ack: RequestAck) -> None:
+        """A request body was persisted locally (reference :431-443)."""
+        if ack.digest in self.my_requests:
+            return  # race between a forward and a local proposal
+        req = self.client_req(ack)
+        req.stored = True
+        self.my_requests[ack.digest] = req
+
+    def generate_ack(self) -> Optional[Msg]:
+        """Reference :445-479."""
+        if not self.my_requests:
+            return None
+        if len(self.my_requests) == 1:
+            self.acks_sent = 1
+            self.ticks_since_ack = 0
+            (req,) = self.my_requests.values()
+            return AckMsg(ack=req.ack)
+
+        # Multiple locally-known requests: ack the null request.
+        null_ack = RequestAck(client_id=self.client_id, req_no=self.req_no, digest=b"")
+        null_req = self.client_req(null_ack)
+        null_req.stored = True
+        self.my_requests[b""] = null_req
+        self.acks_sent = 1
+        self.ticks_since_ack = 0
+        return AckMsg(ack=null_ack)
+
+    def _apply_request_ack(self, source: int, ack: RequestAck) -> None:
+        """Quorum bookkeeping used during reinitialize (reference :481-505)."""
+        if ack.digest:
+            self.non_null_voters.add(source)
+        req = self.client_req(ack)
+        req.agreements.add(source)
+        if len(req.agreements) < some_correct_quorum(self.network_config):
+            return
+        self.weak_requests[ack.digest] = req
+        if len(req.agreements) < intersection_quorum(self.network_config):
+            return
+        self.strong_requests[ack.digest] = req
+
+    def tick(self) -> Actions:
+        """Null-promotion, proactive fetch, fetch retry, ack rebroadcast with
+        linear backoff (reference :507-629)."""
+        if self.committed:
+            return Actions()
+
+        actions = Actions()
+
+        # 1. Conflicting correct requests and no null yet → promote null.
+        if b"" not in self.my_requests and len(self.weak_requests) > 1:
+            null_ack = RequestAck(
+                client_id=self.client_id, req_no=self.req_no, digest=b""
+            )
+            null_req = self.client_req(null_ack)
+            null_req.stored = True
+            self.my_requests[b""] = null_req
+            self.acks_sent = 1
+            self.ticks_since_ack = 0
+            actions.send(self.network_config.nodes, AckMsg(ack=null_ack)).correct_request(
+                null_ack
+            )
+
+        # 2. Exactly one correct request we don't hold → proactively fetch.
+        if len(self.weak_requests) == 1:
+            (req,) = self.weak_requests.values()
+            if not req.stored and not req.fetching:
+                if req.ticks_correct <= CORRECT_FETCH_TICKS:
+                    req.ticks_correct += 1
+                else:
+                    actions.concat(req.fetch())
+
+        # 3. Fetches that timed out → retry (deterministic digest order).
+        to_fetch: List[ClientRequest] = []
+        for req in self.weak_requests.values():
+            if not req.fetching:
+                continue
+            if req.ticks_fetching <= FETCH_TIMEOUT_TICKS:
+                req.ticks_fetching += 1
+                continue
+            req.fetching = False
+            to_fetch.append(req)
+        to_fetch.sort(key=lambda r: r.ack.digest, reverse=True)
+        for req in to_fetch:
+            actions.concat(req.fetch())
+
+        # 4. Ack rebroadcast with linear backoff.
+        if self.acks_sent == 0:
+            return actions
+        if self.ticks_since_ack != self.acks_sent * ACK_RESEND_TICKS:
+            self.ticks_since_ack += 1
+            return actions
+
+        if len(self.my_requests) > 1:
+            ack = self.my_requests[b""].ack
+        elif len(self.my_requests) == 1:
+            (req,) = self.my_requests.values()
+            ack = req.ack
+        else:
+            raise AssertionError("sent an ack for a request we do not have")
+
+        self.acks_sent += 1
+        self.ticks_since_ack = 0
+        actions.send(self.network_config.nodes, AckMsg(ack=ack))
+        return actions
+
+
+class Client:
+    """Watermark window of ClientReqNos for one client (reference :670-904)."""
+
+    __slots__ = (
+        "my_config",
+        "logger",
+        "network_config",
+        "client_state",
+        "client_tracker",
+        "high_watermark",
+        "next_ready_mark",
+        "next_ack_mark",
+        "req_nos",
+    )
+
+    def __init__(self, my_config: EventInitialParameters, tracker: ClientTracker, logger=None):
+        self.my_config = my_config
+        self.logger = logger
+        self.client_tracker = tracker
+        self.network_config: Optional[NetworkConfig] = None
+        self.client_state: Optional[ClientState] = None
+        self.high_watermark = 0
+        self.next_ready_mark = 0
+        self.next_ack_mark = 0
+        self.req_nos: Dict[int, ClientReqNo] = {}  # insertion-ordered window
+
+    def reinitialize(
+        self,
+        seq_no: int,
+        network_config: NetworkConfig,
+        client_state: ClientState,
+        reconfiguring: bool,
+    ) -> Actions:
+        """Reference :692-743."""
+        actions = Actions()
+        old_req_nos = self.req_nos
+
+        intermediate_high = (
+            client_state.low_watermark
+            + client_state.width
+            - client_state.width_consumed_last_checkpoint
+        )
+        self.network_config = network_config
+        self.client_state = client_state
+        self.high_watermark = (
+            client_state.low_watermark + client_state.width
+            if not reconfiguring
+            else intermediate_high
+        )
+        self.next_ready_mark = client_state.low_watermark
+        if self.next_ack_mark < client_state.low_watermark:
+            self.next_ack_mark = client_state.low_watermark
+        self.req_nos = {}
+
+        for req_no in range(client_state.low_watermark, self.high_watermark + 1):
+            crn = old_req_nos.get(req_no)
+            if crn is None:
+                valid_after = (
+                    seq_no + network_config.checkpoint_interval
+                    if req_no > intermediate_high
+                    else seq_no
+                )
+                crn = ClientReqNo(
+                    self.my_config,
+                    client_state.id,
+                    req_no,
+                    network_config,
+                    valid_after,
+                )
+                actions.allocate_request(client_state.id, req_no)
+            crn.committed = is_committed(req_no, client_state)
+            crn.reinitialize(network_config)
+            self.req_nos[req_no] = crn
+
+        self.advance_ready()
+        return actions
+
+    def allocate(
+        self, seq_no: int, state: ClientState, reconfiguring: bool
+    ) -> Actions:
+        """Roll the window forward after a checkpoint (reference :745-804)."""
+        actions = Actions()
+        intermediate_high = (
+            state.low_watermark + state.width - state.width_consumed_last_checkpoint
+        )
+        if intermediate_high != self.high_watermark:
+            raise AssertionError(
+                "new intermediate high watermark must equal the old high "
+                f"watermark for client {state.id}"
+            )
+        new_high = (
+            state.low_watermark + state.width if not reconfiguring else intermediate_high
+        )
+
+        if state.low_watermark > self.next_ready_mark:
+            # A request we never saw as ready may have committed as correct.
+            self.next_ready_mark = state.low_watermark
+        if state.low_watermark > self.next_ack_mark:
+            self.next_ack_mark = state.low_watermark
+
+        for req_no in list(self.req_nos):
+            if req_no == state.low_watermark:
+                break
+            del self.req_nos[req_no]
+
+        for req_no in range(state.low_watermark, self.high_watermark + 1):
+            if is_committed(req_no, state):
+                self.req_nos[req_no].committed = True
+
+        self.client_state = state
+
+        valid_after = seq_no + self.network_config.checkpoint_interval
+        for req_no in range(intermediate_high + 1, new_high + 1):
+            actions.allocate_request(state.id, req_no)
+            self.req_nos[req_no] = ClientReqNo(
+                self.my_config, state.id, req_no, self.network_config, valid_after
+            )
+
+        self.high_watermark = new_high
+        self.advance_ready()
+        return actions
+
+    def ack(self, source: int, ack: RequestAck, force: bool = False) -> Tuple[Actions, ClientRequest]:
+        """Record a replica's ack; drive correct/available/ready transitions
+        (reference :806-840)."""
+        actions = Actions()
+        crn = self.req_nos.get(ack.req_no)
+        if crn is None:
+            raise AssertionError(
+                f"client {ack.client_id} ack for req_no {ack.req_no} outside "
+                f"watermarks [{self.client_state.low_watermark}, "
+                f"{self.high_watermark}]"
+            )
+
+        # First-non-null-ack-is-binding rule (see module docstring): a replica
+        # that already voted for a different non-null digest is ignored unless
+        # the digest is known-correct (force).
+        if ack.digest and not force:
+            existing = crn.requests.get(ack.digest)
+            already_voted_this = existing is not None and source in existing.agreements
+            if source in crn.non_null_voters and not already_voted_this:
+                return actions, crn.client_req(ack)
+
+        if ack.digest:
+            crn.non_null_voters.add(source)
+
+        cr = crn.client_req(ack)
+        cr.agreements.add(source)
+
+        newly_correct = len(cr.agreements) == some_correct_quorum(self.network_config)
+        if newly_correct:
+            crn.weak_requests[ack.digest] = cr
+            if not cr.stored:
+                actions.correct_request(ack)
+
+        correct_and_my_ack = (
+            len(cr.agreements) >= some_correct_quorum(self.network_config)
+            and source == self.my_config.id
+        )
+        if cr.stored and (newly_correct or correct_and_my_ack):
+            self.client_tracker.add_available(ack)
+
+        if len(cr.agreements) == intersection_quorum(self.network_config):
+            crn.strong_requests[ack.digest] = cr
+            self.advance_ready()
+
+        return actions, cr
+
+    def in_watermarks(self, req_no: int) -> bool:
+        return self.client_state.low_watermark <= req_no <= self.high_watermark
+
+    def req_no(self, req_no: int) -> ClientReqNo:
+        crn = self.req_nos.get(req_no)
+        if crn is None:
+            raise AssertionError(
+                f"client {self.client_state.id} should have req_no {req_no}"
+            )
+        return crn
+
+    def advance_ready(self) -> None:
+        """Reference :852-876."""
+        for i in range(self.next_ready_mark, self.high_watermark + 1):
+            if i != self.next_ready_mark:
+                return  # previous iteration failed to advance
+            crn = self.req_no(i)
+            if crn.committed:
+                self.next_ready_mark = i + 1
+                continue
+            for digest in crn.strong_requests:
+                if digest not in crn.my_requests:
+                    continue
+                self.client_tracker.add_ready(crn)
+                self.next_ready_mark = i + 1
+                break
+
+    def advance_acks(self) -> Actions:
+        """Reference :878-895."""
+        actions = Actions()
+        for i in range(self.next_ack_mark, self.high_watermark + 1):
+            ack_msg = self.req_no(i).generate_ack()
+            if ack_msg is None:
+                break
+            actions.send(self.network_config.nodes, ack_msg)
+            self.next_ack_mark = i + 1
+        return actions
+
+    def tick(self) -> Actions:
+        actions = Actions()
+        for crn in self.req_nos.values():
+            actions.concat(crn.tick())
+        return actions
+
+
+class ClientHashDisseminator:
+    """Reference :121-321."""
+
+    __slots__ = (
+        "logger",
+        "my_config",
+        "node_buffers",
+        "allocated_through",
+        "network_config",
+        "client_states",
+        "msg_buffers",
+        "clients",
+        "client_tracker",
+    )
+
+    def __init__(
+        self,
+        node_buffers: NodeBuffers,
+        my_config: EventInitialParameters,
+        client_tracker: ClientTracker,
+        logger=None,
+    ):
+        self.logger = logger
+        self.my_config = my_config
+        self.node_buffers = node_buffers
+        self.client_tracker = client_tracker
+        self.allocated_through = 0
+        self.network_config: Optional[NetworkConfig] = None
+        self.client_states: Tuple[ClientState, ...] = ()
+        self.msg_buffers: Dict[int, MsgBuffer] = {}
+        self.clients: Dict[int, Client] = {}
+
+    def reinitialize(self, seq_no: int, network_state: NetworkState) -> Actions:
+        """Reference :143-180."""
+        actions = Actions()
+        reconfiguring = bool(network_state.pending_reconfigurations)
+
+        self.allocated_through = seq_no
+        self.network_config = network_state.config
+
+        old_clients = self.clients
+        self.clients = {}
+        self.client_states = network_state.clients
+        for client_state in self.client_states:
+            client = old_clients.get(client_state.id)
+            if client is None:
+                client = Client(self.my_config, self.client_tracker, self.logger)
+            self.clients[client_state.id] = client
+            actions.concat(
+                client.reinitialize(
+                    seq_no, network_state.config, client_state, reconfiguring
+                )
+            )
+
+        old_msg_buffers = self.msg_buffers
+        self.msg_buffers = {}
+        for node in network_state.config.nodes:
+            buffer = old_msg_buffers.get(node)
+            if buffer is None:
+                buffer = MsgBuffer("clients", self.node_buffers.node_buffer(node))
+            self.msg_buffers[node] = buffer
+
+        return actions
+
+    def tick(self) -> Actions:
+        actions = Actions()
+        for client_state in self.client_states:
+            actions.concat(self.clients[client_state.id].tick())
+        return actions
+
+    def filter(self, _source: int, msg: Msg) -> Applyable:
+        """Reference :191-213."""
+        if isinstance(msg, AckMsg):
+            ack = msg.ack
+            client = self.clients.get(ack.client_id)
+            if client is None:
+                return Applyable.FUTURE
+            if client.client_state.low_watermark > ack.req_no:
+                return Applyable.PAST
+            if client.high_watermark < ack.req_no:
+                return Applyable.FUTURE
+            return Applyable.CURRENT
+        if isinstance(msg, FetchRequest):
+            return Applyable.CURRENT
+        raise AssertionError(f"unexpected client message type {type(msg).__name__}")
+
+    def step(self, source: int, msg: Msg) -> Actions:
+        verdict = self.filter(source, msg)
+        if verdict == Applyable.PAST:
+            return Actions()
+        if verdict == Applyable.FUTURE:
+            self.msg_buffers[source].store(msg)
+            return Actions()
+        return self.apply_msg(source, msg)
+
+    def apply_msg(self, source: int, msg: Msg) -> Actions:
+        if isinstance(msg, AckMsg):
+            actions, _ = self.ack(source, msg.ack)
+            return actions
+        if isinstance(msg, FetchRequest):
+            ack = msg.ack
+            return self.reply_fetch_request(
+                source, ack.client_id, ack.req_no, ack.digest
+            )
+        raise AssertionError(f"unexpected client message type {type(msg).__name__}")
+
+    def apply_new_request(self, ack: RequestAck) -> Actions:
+        """EventRequestPersisted: our processor persisted a request body
+        (reference :242-257)."""
+        client = self.clients.get(ack.client_id)
+        if client is None:
+            return Actions()  # client removed since the request was processed
+        if not client.in_watermarks(ack.req_no):
+            return Actions()  # already committed
+        client.req_no(ack.req_no).apply_new_request(ack)
+        return client.advance_acks()
+
+    def allocate(self, seq_no: int, network_state: NetworkState) -> Actions:
+        """Advance client windows after a checkpoint (reference :260-278)."""
+        if seq_no != network_state.config.checkpoint_interval + self.allocated_through:
+            raise AssertionError(
+                "unexpected skip in allocate; expected next allocation at "
+                "next checkpoint"
+            )
+        actions = Actions()
+        self.allocated_through = seq_no
+        reconfiguring = bool(network_state.pending_reconfigurations)
+        for client_state in network_state.clients:
+            actions.concat(
+                self.clients[client_state.id].allocate(
+                    seq_no, client_state, reconfiguring
+                )
+            )
+        for node in self.network_config.nodes:
+            self.msg_buffers[node].iterate(
+                self.filter,
+                lambda source, msg: actions.concat(self.apply_msg(source, msg)),
+            )
+        return actions
+
+    def reply_fetch_request(
+        self, source: int, client_id: int, req_no: int, digest: bytes
+    ) -> Actions:
+        """Reference :280-308."""
+        client = self.clients.get(client_id)
+        if client is None or not client.in_watermarks(req_no):
+            return Actions()
+        crn = client.req_no(req_no)
+        data = crn.requests.get(digest)
+        if data is None or self.my_config.id not in data.agreements:
+            return Actions()
+        return Actions().forward_request(
+            (source,),
+            RequestAck(client_id=client_id, req_no=req_no, digest=digest),
+        )
+
+    def ack(self, source: int, ack: RequestAck, force: bool = False) -> Tuple[Actions, ClientRequest]:
+        client = self.clients.get(ack.client_id)
+        if client is None:
+            raise AssertionError(
+                "step filtering should delay reqs for non-existent clients"
+            )
+        return client.ack(source, ack, force=force)
+
+    def client(self, client_id: int) -> Optional[Client]:
+        return self.clients.get(client_id)

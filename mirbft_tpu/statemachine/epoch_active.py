@@ -1,0 +1,435 @@
+"""Active epoch: the normal-case ordering machinery.
+
+Rebuild of reference ``pkg/statemachine/epoch_active.go``: the watermark
+window of sequences in checkpoint-interval chunks, bucket→leader assignment
+(:61-70), per-bucket in-order preprepare buffers (:88-97), the
+past/current/future/invalid message filter (:142-213), the commit cascade
+into ``CommitState`` (:296-317), window advancement allocating new intervals
++ NEntries and pulling proposals for owned buckets (:368-423), and the tick
+handler driving the progress watchdog (→ Suspect) and heartbeat (null /
+partial batches) (:438-490).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..messages import (
+    Commit,
+    EpochConfig,
+    Msg,
+    NEntry,
+    NetworkConfig,
+    Preprepare,
+    Prepare,
+    RequestAck,
+    Suspect,
+)
+from ..state import EventInitialParameters
+from .actions import Actions
+from .client_tracker import ClientTracker
+from .commitstate import CommitState
+from .msgbuffers import Applyable, MsgBuffer, NodeBuffers
+from .outstanding import AllOutstandingReqs
+from .persisted import PersistedLog
+from .proposer import Proposer
+from .sequence import SeqState, Sequence
+from .stateless import seq_to_bucket
+
+
+class PreprepareBuffer:
+    __slots__ = ("next_seq_no", "buffer")
+
+    def __init__(self, next_seq_no: int, buffer: MsgBuffer):
+        self.next_seq_no = next_seq_no
+        self.buffer = buffer
+
+
+def assign_buckets(
+    epoch_config: EpochConfig, network_config: NetworkConfig
+) -> Dict[int, int]:
+    """Bucket→leader assignment, rotating with the epoch number; buckets whose
+    natural leader is not in the leader set overflow round-robin onto actual
+    leaders (reference epoch_active.go:53-70)."""
+    leaders = set(epoch_config.leaders)
+    buckets: Dict[int, int] = {}
+    overflow_index = 0
+    nodes = network_config.nodes
+    for i in range(network_config.number_of_buckets):
+        natural = nodes[(i + epoch_config.number) % len(nodes)]
+        if natural in leaders:
+            buckets[i] = natural
+        else:
+            buckets[i] = epoch_config.leaders[
+                overflow_index % len(epoch_config.leaders)
+            ]
+            overflow_index += 1
+    return buckets
+
+
+class ActiveEpoch:
+    """Reference epoch_active.go:22-121."""
+
+    __slots__ = (
+        "epoch_config",
+        "network_config",
+        "my_config",
+        "logger",
+        "outstanding_reqs",
+        "proposer",
+        "persisted",
+        "commit_state",
+        "buckets",
+        "sequences",
+        "preprepare_buffers",
+        "other_buffers",
+        "lowest_uncommitted",
+        "lowest_unallocated",
+        "last_committed_at_tick",
+        "ticks_since_progress",
+    )
+
+    def __init__(
+        self,
+        epoch_config: EpochConfig,
+        persisted: PersistedLog,
+        node_buffers: NodeBuffers,
+        commit_state: CommitState,
+        client_tracker: ClientTracker,
+        my_config: EventInitialParameters,
+        logger=None,
+    ):
+        network_config = commit_state.active_state.config
+        starting_seq_no = commit_state.highest_commit
+
+        self.epoch_config = epoch_config
+        self.network_config = network_config
+        self.my_config = my_config
+        self.logger = logger
+        self.persisted = persisted
+        self.commit_state = commit_state
+
+        self.outstanding_reqs = AllOutstandingReqs(
+            client_tracker.available_list, commit_state.active_state, logger
+        )
+        self.buckets = assign_buckets(epoch_config, network_config)
+
+        num_buckets = len(self.buckets)
+        self.lowest_unallocated = [0] * num_buckets
+        for i in range(num_buckets):
+            first_seq_no = starting_seq_no + i + 1
+            self.lowest_unallocated[
+                seq_to_bucket(first_seq_no, network_config)
+            ] = first_seq_no
+
+        self.lowest_uncommitted = commit_state.highest_commit + 1
+
+        self.proposer = Proposer(
+            base_checkpoint=starting_seq_no,
+            checkpoint_interval=network_config.checkpoint_interval,
+            my_config=my_config,
+            ready_list=client_tracker.ready_list,
+            buckets=self.buckets,
+            network_config=network_config,
+        )
+
+        self.preprepare_buffers = [
+            PreprepareBuffer(
+                next_seq_no=self.lowest_unallocated[i],
+                buffer=MsgBuffer(
+                    f"epoch-{epoch_config.number}-preprepare",
+                    node_buffers.node_buffer(self.buckets[i]),
+                ),
+            )
+            for i in range(num_buckets)
+        ]
+        self.other_buffers = {
+            node: MsgBuffer(
+                f"epoch-{epoch_config.number}-other",
+                node_buffers.node_buffer(node),
+            )
+            for node in network_config.nodes
+        }
+
+        # checkpoint-interval chunks of Sequence (window)
+        self.sequences: List[List[Sequence]] = []
+        self.last_committed_at_tick = 0
+        self.ticks_since_progress = 0
+
+    # --- window geometry ---
+
+    def seq_to_bucket(self, seq_no: int) -> int:
+        return seq_to_bucket(seq_no, self.network_config)
+
+    def low_watermark(self) -> int:
+        return self.sequences[0][0].seq_no
+
+    def high_watermark(self) -> int:
+        if not self.sequences:
+            return self.commit_state.low_watermark
+        return self.sequences[-1][-1].seq_no
+
+    def in_watermarks(self, seq_no: int) -> bool:
+        return self.low_watermark() <= seq_no <= self.high_watermark()
+
+    def sequence(self, seq_no: int) -> Sequence:
+        ci = self.network_config.checkpoint_interval
+        index = (seq_no - self.low_watermark()) // ci
+        offset = (seq_no - self.low_watermark()) % ci
+        seq = self.sequences[index][offset]
+        if seq.seq_no != seq_no:
+            raise AssertionError("sequence retrieved had unexpected seq_no")
+        return seq
+
+    # --- message filtering (reference epoch_active.go:142-213) ---
+
+    def filter(self, source: int, msg: Msg) -> Applyable:
+        if isinstance(msg, Preprepare):
+            seq_no = msg.seq_no
+            bucket = self.seq_to_bucket(seq_no)
+            if self.buckets[bucket] != source:
+                return Applyable.INVALID
+            if seq_no > self.epoch_config.planned_expiration:
+                return Applyable.INVALID
+            if seq_no > self.high_watermark():
+                return Applyable.FUTURE
+            if seq_no < self.low_watermark():
+                return Applyable.PAST
+            next_preprepare = self.preprepare_buffers[bucket].next_seq_no
+            if seq_no < next_preprepare:
+                return Applyable.PAST
+            if seq_no > next_preprepare:
+                return Applyable.FUTURE
+            return Applyable.CURRENT
+        if isinstance(msg, Prepare):
+            seq_no = msg.seq_no
+            bucket = self.seq_to_bucket(seq_no)
+            if self.buckets[bucket] == source:
+                return Applyable.INVALID  # owners never send Prepare
+            if seq_no > self.epoch_config.planned_expiration:
+                return Applyable.INVALID
+            if seq_no < self.low_watermark():
+                return Applyable.PAST
+            if seq_no > self.high_watermark():
+                return Applyable.FUTURE
+            return Applyable.CURRENT
+        if isinstance(msg, Commit):
+            seq_no = msg.seq_no
+            if seq_no > self.epoch_config.planned_expiration:
+                return Applyable.INVALID
+            if seq_no < self.low_watermark():
+                return Applyable.PAST
+            if seq_no > self.high_watermark():
+                return Applyable.FUTURE
+            return Applyable.CURRENT
+        raise AssertionError(f"unexpected msg type {type(msg).__name__}")
+
+    def apply(self, source: int, msg: Msg) -> Actions:
+        """Reference epoch_active.go:215-241."""
+        actions = Actions()
+        if isinstance(msg, Preprepare):
+            bucket = self.seq_to_bucket(msg.seq_no)
+            buffer = self.preprepare_buffers[bucket]
+            next_msg: Optional[Msg] = msg
+            while next_msg is not None:
+                actions.concat(
+                    self.apply_preprepare_msg(
+                        source, next_msg.seq_no, list(next_msg.batch)
+                    )
+                )
+                buffer.next_seq_no += len(self.buckets)
+                next_msg = buffer.buffer.next(self.filter)
+        elif isinstance(msg, Prepare):
+            actions.concat(self.apply_prepare_msg(source, msg.seq_no, msg.digest))
+        elif isinstance(msg, Commit):
+            actions.concat(self.apply_commit_msg(source, msg.seq_no, msg.digest))
+        else:
+            raise AssertionError(f"unexpected msg type {type(msg).__name__}")
+        return actions
+
+    def step(self, source: int, msg: Msg) -> Actions:
+        verdict = self.filter(source, msg)
+        if verdict == Applyable.CURRENT:
+            return self.apply(source, msg)
+        if verdict == Applyable.FUTURE:
+            if isinstance(msg, Preprepare):
+                bucket = self.seq_to_bucket(msg.seq_no)
+                self.preprepare_buffers[bucket].buffer.store(msg)
+            else:
+                self.other_buffers[source].store(msg)
+        # PAST / INVALID: drop
+        return Actions()
+
+    # --- three-phase message application ---
+
+    def apply_preprepare_msg(
+        self, source: int, seq_no: int, batch: List[RequestAck]
+    ) -> Actions:
+        """Reference epoch_active.go:247-271."""
+        seq = self.sequence(seq_no)
+
+        if seq.owner == self.my_config.id:
+            # Already allocated at proposal time; the loopback Preprepare is
+            # our own prepare-equivalent.
+            return seq.apply_prepare_msg(source, seq.digest)
+
+        bucket = self.seq_to_bucket(seq_no)
+        if seq_no != self.lowest_unallocated[bucket]:
+            raise AssertionError(
+                "step should defer all but the next expected preprepare"
+            )
+        self.lowest_unallocated[bucket] += len(self.buckets)
+
+        # Validates in-order request consumption and allocates the sequence;
+        # ValueError here means a protocol-invalid batch from a byzantine
+        # leader (the reference panics with a TODO to suspect instead).
+        return self.outstanding_reqs.apply_acks(bucket, seq, batch)
+
+    def apply_prepare_msg(self, source: int, seq_no: int, digest: bytes) -> Actions:
+        return self.sequence(seq_no).apply_prepare_msg(source, digest)
+
+    def apply_commit_msg(self, source: int, seq_no: int, digest: bytes) -> Actions:
+        """Commit plus in-order cascade into CommitState
+        (reference epoch_active.go:296-317)."""
+        seq = self.sequence(seq_no)
+        seq.apply_commit_msg(source, digest)
+        if seq.state != SeqState.COMMITTED or seq_no != self.lowest_uncommitted:
+            return Actions()
+
+        while self.lowest_uncommitted <= self.high_watermark():
+            seq = self.sequence(self.lowest_uncommitted)
+            if seq.state != SeqState.COMMITTED:
+                break
+            self.commit_state.commit(seq.q_entry)
+            self.lowest_uncommitted += 1
+        return Actions()
+
+    def apply_batch_hash_result(self, seq_no: int, digest: bytes) -> Actions:
+        """Route a TPU-computed batch digest to its sequence
+        (reference epoch_active.go:425-436)."""
+        if not self.in_watermarks(seq_no):
+            return Actions()  # benign during/after state transfer
+        return self.sequence(seq_no).apply_batch_hash_result(digest)
+
+    # --- watermark movement / window advance ---
+
+    def move_low_watermark(self, seq_no: int) -> Tuple[Actions, bool]:
+        """Returns (actions, epoch_done) (reference epoch_active.go:319-337)."""
+        if seq_no == self.epoch_config.planned_expiration:
+            return Actions(), True
+        if seq_no == self.commit_state.stop_at_seq_no:
+            return Actions(), True
+
+        actions = self.advance()
+        while seq_no > self.low_watermark():
+            self.sequences = self.sequences[1:]
+        return actions, False
+
+    def drain_buffers(self) -> Actions:
+        """Reference epoch_active.go:339-366."""
+        actions = Actions()
+        for bucket in range(len(self.buckets)):
+            buffer = self.preprepare_buffers[bucket]
+            source = self.buckets[bucket]
+            next_msg = buffer.buffer.next(self.filter)
+            if next_msg is None:
+                continue
+            # apply() loops over consecutive preprepares internally
+            actions.concat(self.apply(source, next_msg))
+
+        for node in self.network_config.nodes:
+            self.other_buffers[node].iterate(
+                self.filter,
+                lambda nid, msg: actions.concat(self.apply(nid, msg)),
+            )
+        return actions
+
+    def advance(self) -> Actions:
+        """Extend the window with new checkpoint intervals (persisting an
+        NEntry per chunk), drain buffers, pull proposals into owned buckets
+        (reference epoch_active.go:368-423)."""
+        actions = Actions()
+        if self.high_watermark() > self.epoch_config.planned_expiration:
+            raise AssertionError("window extends beyond planned expiration")
+        if self.high_watermark() > self.commit_state.stop_at_seq_no:
+            raise AssertionError("window extends beyond the stop sequence")
+
+        ci = self.network_config.checkpoint_interval
+        while (
+            self.high_watermark() < self.epoch_config.planned_expiration
+            and self.high_watermark() < self.commit_state.stop_at_seq_no
+        ):
+            base = self.high_watermark() + 1
+            actions.concat(
+                self.persisted.add_n_entry(
+                    NEntry(seq_no=base, epoch_config=self.epoch_config)
+                )
+            )
+            chunk = [
+                Sequence(
+                    owner=self.buckets[self.seq_to_bucket(base + i)],
+                    epoch=self.epoch_config.number,
+                    seq_no=base + i,
+                    persisted=self.persisted,
+                    network_config=self.network_config,
+                    my_id=self.my_config.id,
+                )
+                for i in range(ci)
+            ]
+            self.sequences.append(chunk)
+
+        actions.concat(self.drain_buffers())
+
+        self.proposer.advance(self.lowest_uncommitted)
+
+        for bucket in range(self.network_config.number_of_buckets):
+            if self.buckets[bucket] != self.my_config.id:
+                continue
+            prb = self.proposer.proposal_bucket(bucket)
+            while True:
+                seq_no = self.lowest_unallocated[bucket]
+                if seq_no > self.high_watermark():
+                    break
+                if not prb.has_pending(seq_no):
+                    break
+                seq = self.sequence(seq_no)
+                actions.concat(seq.allocate_as_owner(prb.next()))
+                self.lowest_unallocated[bucket] += len(self.buckets)
+        return actions
+
+    # --- ticks (reference epoch_active.go:438-490) ---
+
+    def tick(self) -> Actions:
+        if self.last_committed_at_tick < self.commit_state.highest_commit:
+            self.last_committed_at_tick = self.commit_state.highest_commit
+            self.ticks_since_progress = 0
+            return Actions()
+
+        self.ticks_since_progress += 1
+        actions = Actions()
+
+        if self.ticks_since_progress > self.my_config.suspect_ticks:
+            suspect = Suspect(epoch=self.epoch_config.number)
+            actions.send(self.network_config.nodes, suspect)
+            actions.concat(self.persisted.add_suspect(suspect))
+
+        if (
+            self.my_config.heartbeat_ticks == 0
+            or self.ticks_since_progress % self.my_config.heartbeat_ticks != 0
+        ):
+            return actions
+
+        # Heartbeat: cut a partial (possibly null) batch in every owned bucket.
+        for bucket, unallocated_seq_no in enumerate(self.lowest_unallocated):
+            if unallocated_seq_no > self.high_watermark():
+                continue
+            if self.buckets[bucket] != self.my_config.id:
+                continue
+            seq = self.sequence(unallocated_seq_no)
+            prb = self.proposer.proposal_bucket(bucket)
+            client_reqs = []
+            if prb.has_outstanding(unallocated_seq_no):
+                client_reqs = prb.next()
+            actions.concat(seq.allocate_as_owner(client_reqs))
+            self.lowest_unallocated[bucket] += len(self.buckets)
+        return actions

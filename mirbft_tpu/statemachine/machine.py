@@ -1,0 +1,329 @@
+"""Root deterministic state machine: event dispatch and the fixpoint loop.
+
+Rebuild of reference ``pkg/statemachine/state_machine.go``: the 3-phase
+lifecycle (UNINITIALIZED → LOADING_PERSISTED → INITIALIZED, :90-94), event
+dispatch (:173-231), message routing by type (:310-349), hash-result demux by
+origin (:351-371) — the return path of every TPU hash dispatch — checkpoint
+results (:373-401), and the post-event loop: garbage-collect watermarks, then
+iterate ``commit_state.drain()`` + ``epoch_tracker.advance_state()`` to
+fixpoint (:239-267).
+
+The machine is single-threaded and deterministic by construction: same event
+sequence in, same action sequence out, on every replica and on every replay.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from .. import state as st
+from ..messages import (
+    AckMsg,
+    CEntry,
+    CheckpointMsg,
+    Commit,
+    EpochChange,
+    EpochChangeAck,
+    FEntry,
+    FetchBatch,
+    FetchRequest,
+    ForwardBatch,
+    ForwardRequest,
+    Msg,
+    NetworkConfig,
+    NetworkState,
+    NewEpoch,
+    NewEpochEcho,
+    NewEpochReady,
+    Preprepare,
+    Prepare,
+    Suspect,
+)
+from .actions import Actions
+from .batch_tracker import BatchTracker
+from .checkpoints import CheckpointState, CheckpointTracker
+from .client_tracker import ClientTracker
+from .commitstate import CommitState
+from .disseminator import ClientHashDisseminator
+from .epoch_tracker import EpochTracker
+from .msgbuffers import NodeBuffers
+from .persisted import PersistedLog
+
+
+class MachineState(enum.IntEnum):
+    UNINITIALIZED = 0
+    LOADING_PERSISTED = 1
+    INITIALIZED = 2
+
+
+class StateMachine:
+    """Reference state_machine.go:96-170."""
+
+    __slots__ = (
+        "logger",
+        "state",
+        "my_config",
+        "commit_state",
+        "client_tracker",
+        "client_hash_disseminator",
+        "node_buffers",
+        "batch_tracker",
+        "checkpoint_tracker",
+        "epoch_tracker",
+        "persisted",
+    )
+
+    def __init__(self, logger=None):
+        self.logger = logger
+        self.state = MachineState.UNINITIALIZED
+        self.my_config: Optional[st.EventInitialParameters] = None
+        self.commit_state: Optional[CommitState] = None
+        self.client_tracker: Optional[ClientTracker] = None
+        self.client_hash_disseminator: Optional[ClientHashDisseminator] = None
+        self.node_buffers: Optional[NodeBuffers] = None
+        self.batch_tracker: Optional[BatchTracker] = None
+        self.checkpoint_tracker: Optional[CheckpointTracker] = None
+        self.epoch_tracker: Optional[EpochTracker] = None
+        self.persisted: Optional[PersistedLog] = None
+
+    # --- lifecycle ---
+
+    def _initialize(self, parameters: st.EventInitialParameters) -> None:
+        if self.state != MachineState.UNINITIALIZED:
+            raise AssertionError("state machine has already been initialized")
+        self.my_config = parameters
+        self.state = MachineState.LOADING_PERSISTED
+        self.persisted = PersistedLog(self.logger)
+        self.node_buffers = NodeBuffers(parameters, self.logger)
+        self.checkpoint_tracker = CheckpointTracker(
+            self.persisted, self.node_buffers, parameters, self.logger
+        )
+        self.client_tracker = ClientTracker(parameters, self.logger)
+        self.commit_state = CommitState(self.persisted, self.logger)
+        self.client_hash_disseminator = ClientHashDisseminator(
+            self.node_buffers, parameters, self.client_tracker, self.logger
+        )
+        self.batch_tracker = BatchTracker(self.persisted)
+        self.epoch_tracker = EpochTracker(
+            self.persisted,
+            self.node_buffers,
+            self.commit_state,
+            parameters,
+            self.batch_tracker,
+            self.client_tracker,
+            self.client_hash_disseminator,
+            self.logger,
+        )
+
+    def _apply_persisted(self, index: int, entry) -> None:
+        if self.state != MachineState.LOADING_PERSISTED:
+            raise AssertionError("not in the loading-persisted phase")
+        self.persisted.append_initial_load(index, entry)
+
+    def _complete_initialization(self) -> Actions:
+        if self.state != MachineState.LOADING_PERSISTED:
+            raise AssertionError("not in the loading-persisted phase")
+        self.state = MachineState.INITIALIZED
+        return self._reinitialize()
+
+    def _reinitialize(self) -> Actions:
+        """Shared by start, state transfer, and reconfiguration
+        (reference state_machine.go:272-287)."""
+        actions = self._recover_log()
+        actions.concat(self.commit_state.reinitialize())
+        self.client_tracker.reinitialize(self.commit_state.active_state)
+        actions.concat(
+            self.client_hash_disseminator.reinitialize(
+                self.commit_state.low_watermark, self.commit_state.active_state
+            )
+        )
+        self.checkpoint_tracker.reinitialize()
+        self.batch_tracker.reinitialize()
+        return actions.concat(self.epoch_tracker.reinitialize())
+
+    def _recover_log(self) -> Actions:
+        """Truncate the WAL through the last CEntry preceding each FEntry
+        (reference state_machine.go:290-308)."""
+        actions = Actions()
+        last_c: Optional[CEntry] = None
+        for _, entry in list(self.persisted.entries):
+            if isinstance(entry, CEntry):
+                last_c = entry
+            elif isinstance(entry, FEntry):
+                if last_c is None:
+                    raise AssertionError(
+                        "FEntry without corresponding CEntry; corrupt log"
+                    )
+                actions.concat(self.persisted.truncate(last_c.seq_no))
+        if last_c is None:
+            raise AssertionError("found no checkpoints in the log")
+        return actions
+
+    # --- event dispatch (reference state_machine.go:168-270) ---
+
+    def apply_event(self, event: st.Event) -> Actions:
+        actions = Actions()
+
+        if isinstance(event, st.EventInitialParameters):
+            self._initialize(event)
+            return Actions()
+        if isinstance(event, st.EventLoadPersistedEntry):
+            self._apply_persisted(event.index, event.entry)
+            return Actions()
+        if isinstance(event, st.EventLoadCompleted):
+            actions = self._complete_initialization()
+        elif isinstance(event, st.EventActionsReceived):
+            # No-op marker correlating action batches to their events in the
+            # recorded stream.
+            return Actions()
+        else:
+            if self.state != MachineState.INITIALIZED:
+                raise AssertionError(
+                    "cannot apply events to an uninitialized state machine"
+                )
+            if isinstance(event, st.EventTickElapsed):
+                actions.concat(self.client_hash_disseminator.tick())
+                actions.concat(self.epoch_tracker.tick())
+            elif isinstance(event, st.EventStep):
+                actions.concat(self.step(event.source, event.msg))
+            elif isinstance(event, st.EventHashResult):
+                actions.concat(self._process_hash_result(event))
+            elif isinstance(event, st.EventCheckpointResult):
+                actions.concat(self._process_checkpoint_result(event))
+            elif isinstance(event, st.EventRequestPersisted):
+                actions.concat(
+                    self.client_hash_disseminator.apply_new_request(
+                        event.request_ack
+                    )
+                )
+            elif isinstance(event, st.EventStateTransferFailed):
+                # Mirrors the reference's unresolved edge
+                # (state_machine.go:210-212).
+                raise NotImplementedError("state transfer failure handling")
+            elif isinstance(event, st.EventStateTransferComplete):
+                if not self.commit_state.transferring:
+                    raise AssertionError(
+                        "state transfer completed but none was requested"
+                    )
+                actions.concat(
+                    self.persisted.add_c_entry(
+                        CEntry(
+                            seq_no=event.seq_no,
+                            checkpoint_value=event.checkpoint_value,
+                            network_state=event.network_state,
+                        )
+                    )
+                )
+                actions.concat(self._reinitialize())
+            else:
+                raise AssertionError(f"unknown event type {type(event).__name__}")
+
+        # At most one watermark movement is possible per event (a second
+        # would need a fresh checkpoint result from ourselves).
+        if self.checkpoint_tracker.state == CheckpointState.GARBAGE_COLLECTABLE:
+            new_low = self.checkpoint_tracker.garbage_collect()
+            # Deviation from the reference, which drops the Truncate action
+            # returned here (state_machine.go:243), leaving the durable WAL
+            # to grow until recovery: we emit it so the WAL stays bounded.
+            actions.concat(self.persisted.truncate(new_low))
+            ci = self.checkpoint_tracker.network_config.checkpoint_interval
+            if new_low > ci:
+                # Keep one extra checkpoint interval of batches for epoch change.
+                self.batch_tracker.truncate(new_low - ci)
+            actions.concat(self.epoch_tracker.move_low_watermark(new_low))
+
+        # Fixpoint: drain commits and advance the epoch until quiescent.
+        while True:
+            actions.concat(self.commit_state.drain())
+            loop_actions = self.epoch_tracker.advance_state()
+            if not loop_actions:
+                break
+            actions.concat(loop_actions)
+
+        return actions
+
+    # --- message routing (reference state_machine.go:310-349) ---
+
+    def step(self, source: int, msg: Msg) -> Actions:
+        if isinstance(msg, (AckMsg, FetchRequest, ForwardRequest)):
+            return self.client_hash_disseminator.step(source, msg)
+        if isinstance(msg, CheckpointMsg):
+            self.checkpoint_tracker.step(source, msg)
+            return Actions()
+        if isinstance(msg, (FetchBatch, ForwardBatch)):
+            return self.batch_tracker.step(source, msg)
+        if isinstance(
+            msg,
+            (
+                Suspect,
+                EpochChange,
+                EpochChangeAck,
+                NewEpoch,
+                NewEpochEcho,
+                NewEpochReady,
+                Preprepare,
+                Prepare,
+                Commit,
+            ),
+        ):
+            return self.epoch_tracker.step(source, msg)
+        raise AssertionError(f"unexpected message type {type(msg).__name__}")
+
+    # --- hash results: the TPU return path (reference :351-371) ---
+
+    def _process_hash_result(self, event: st.EventHashResult) -> Actions:
+        origin = event.origin
+        if isinstance(origin, st.BatchOrigin):
+            self.batch_tracker.add_batch(
+                origin.seq_no, event.digest, origin.request_acks
+            )
+            return self.epoch_tracker.apply_batch_hash_result(
+                origin.epoch, origin.seq_no, event.digest
+            )
+        if isinstance(origin, st.EpochChangeOrigin):
+            return self.epoch_tracker.apply_epoch_change_digest(
+                origin, event.digest
+            )
+        if isinstance(origin, st.VerifyBatchOrigin):
+            actions = Actions()
+            self.batch_tracker.apply_verify_batch_hash_result(event.digest, origin)
+            from .epoch_target import EpochTargetState
+
+            if (
+                not self.batch_tracker.has_fetch_in_flight()
+                and self.epoch_tracker.current_epoch.state
+                == EpochTargetState.FETCHING
+            ):
+                actions.concat(
+                    self.epoch_tracker.current_epoch.fetch_new_epoch_state()
+                )
+            return actions
+        raise AssertionError("no hash origin type set")
+
+    # --- checkpoint results (reference :373-401) ---
+
+    def _process_checkpoint_result(self, result: st.EventCheckpointResult) -> Actions:
+        actions = Actions()
+        if result.seq_no < self.commit_state.low_watermark:
+            return actions  # stale result after state transfer
+
+        expected = (
+            self.commit_state.low_watermark
+            + self.commit_state.active_state.config.checkpoint_interval
+        )
+        if expected != result.seq_no:
+            raise AssertionError(
+                "checkpoint results must be exactly one interval after the last"
+            )
+
+        prev_stop = self.commit_state.stop_at_seq_no
+        actions.concat(self.commit_state.apply_checkpoint_result(result))
+        if prev_stop < self.commit_state.stop_at_seq_no:
+            self.client_tracker.allocate(result.seq_no, result.network_state)
+            actions.concat(
+                self.client_hash_disseminator.allocate(
+                    result.seq_no, result.network_state
+                )
+            )
+        return actions

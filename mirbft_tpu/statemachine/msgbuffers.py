@@ -1,0 +1,151 @@
+"""Byte-bounded per-node message buffering.
+
+Rebuild of reference ``pkg/statemachine/msgbuffers.go``: each peer node gets a
+byte-budgeted ``NodeBuffer`` (EventInitialParameters.buffer_size) shared by all
+per-component ``MsgBuffer``s; when over capacity the storing buffer drops its
+own oldest messages first (:145-164).  Classification of buffered messages is
+4-way: PAST (drop), CURRENT (apply), FUTURE (keep), INVALID (drop).
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from .. import wire
+from ..messages import Msg
+from ..state import EventInitialParameters
+
+
+class Applyable(enum.IntEnum):
+    PAST = 0
+    CURRENT = 1
+    FUTURE = 2
+    INVALID = 3
+
+
+FilterFn = Callable[[int, Msg], Applyable]
+ApplyFn = Callable[[int, Msg], None]
+
+
+def msg_size(msg: Msg) -> int:
+    """Wire size of a message; the unit of buffer accounting (the reference
+    uses proto.Size)."""
+    return len(wire.encode(msg))
+
+
+class NodeBuffers:
+    """Registry of per-peer buffers (reference msgbuffers.go:20-44)."""
+
+    __slots__ = ("my_config", "logger", "node_map")
+
+    def __init__(self, my_config: EventInitialParameters, logger=None):
+        self.my_config = my_config
+        self.logger = logger
+        self.node_map: Dict[int, "NodeBuffer"] = {}
+
+    def node_buffer(self, source: int) -> "NodeBuffer":
+        nb = self.node_map.get(source)
+        if nb is None:
+            nb = NodeBuffer(source, self.my_config, self.logger)
+            self.node_map[source] = nb
+        return nb
+
+
+class NodeBuffer:
+    """Aggregate byte budget for one peer (reference msgbuffers.go:64-77)."""
+
+    __slots__ = ("id", "my_config", "logger", "total_size", "msg_bufs")
+
+    def __init__(self, node_id: int, my_config: EventInitialParameters, logger=None):
+        self.id = node_id
+        self.my_config = my_config
+        self.logger = logger
+        self.total_size = 0
+        self.msg_bufs: List["MsgBuffer"] = []  # for status reporting only
+
+    def over_capacity(self) -> bool:
+        return self.total_size > self.my_config.buffer_size
+
+    def _msg_stored(self, size: int) -> None:
+        self.total_size += size
+
+    def _msg_removed(self, size: int) -> None:
+        self.total_size -= size
+
+
+class MsgBuffer:
+    """One component's buffer of not-yet-applyable messages from one peer
+    (reference msgbuffers.go:121-226)."""
+
+    __slots__ = ("component", "buffer", "node_buffer")
+
+    def __init__(self, component: str, node_buffer: NodeBuffer):
+        self.component = component
+        # deque of (msg, cached wire size)
+        self.buffer: Deque[Tuple[Msg, int]] = deque()
+        self.node_buffer = node_buffer
+
+    def store(self, msg: Msg) -> None:
+        # Over budget: drop our own oldest first (see reference's fairness
+        # note, msgbuffers.go:146-151).
+        while self.node_buffer.over_capacity() and self.buffer:
+            old_msg, old_size = self.buffer.popleft()
+            self.node_buffer._msg_removed(old_size)
+            self._deregister_if_empty()
+            if self.node_buffer.logger is not None:
+                self.node_buffer.logger.warn(
+                    "dropping buffered msg",
+                    component=self.component,
+                    type=type(old_msg).__name__,
+                )
+        size = msg_size(msg)
+        if not self.buffer:
+            self.node_buffer.msg_bufs.append(self)
+        self.buffer.append((msg, size))
+        self.node_buffer._msg_stored(size)
+
+    def _deregister_if_empty(self) -> None:
+        if not self.buffer:
+            try:
+                self.node_buffer.msg_bufs.remove(self)
+            except ValueError:
+                pass
+
+    def next(self, filter_fn: FilterFn) -> Optional[Msg]:
+        """Pop the first CURRENT message, dropping PAST/INVALID along the way;
+        FUTURE messages are skipped in place (reference msgbuffers.go:178-204)."""
+        i = 0
+        while i < len(self.buffer):
+            msg, size = self.buffer[i]
+            verdict = filter_fn(self.node_buffer.id, msg)
+            if verdict == Applyable.FUTURE:
+                i += 1
+                continue
+            del self.buffer[i]
+            self.node_buffer._msg_removed(size)
+            self._deregister_if_empty()
+            if verdict == Applyable.CURRENT:
+                return msg
+            # PAST / INVALID: dropped; continue scanning at same index
+        return None
+
+    def iterate(self, filter_fn: FilterFn, apply_fn: ApplyFn) -> None:
+        """Apply every CURRENT message, dropping PAST/INVALID, keeping FUTURE
+        (reference msgbuffers.go:206-226)."""
+        i = 0
+        while i < len(self.buffer):
+            msg, size = self.buffer[i]
+            verdict = filter_fn(self.node_buffer.id, msg)
+            if verdict == Applyable.FUTURE:
+                i += 1
+                continue
+            del self.buffer[i]
+            self.node_buffer._msg_removed(size)
+            self._deregister_if_empty()
+            if verdict == Applyable.CURRENT:
+                apply_fn(self.node_buffer.id, msg)
+
+    def __len__(self) -> int:
+        return len(self.buffer)

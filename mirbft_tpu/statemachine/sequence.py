@@ -1,0 +1,293 @@
+"""Per-sequence-number three-phase-commit state machine.
+
+Rebuild of reference ``pkg/statemachine/sequence.go``: the lifecycle
+``UNINITIALIZED → ALLOCATED → PENDING_REQUESTS → READY → PREPREPARED →
+PREPARED → COMMITTED`` (sequence.go:18-26), batch-digest hashing on
+allocation (:142-177) — the hash request is the unit of work the TPU batcher
+aggregates — QEntry-persist-then-send on preprepare (:203-255), and the
+intersection-quorum prepare/commit rules (:276-355).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional, Set
+
+from .. import state as st
+from ..messages import (
+    Commit,
+    NetworkConfig,
+    PEntry,
+    Preprepare,
+    Prepare,
+    QEntry,
+    RequestAck,
+)
+from .actions import Actions
+from .persisted import PersistedLog
+from .stateless import intersection_quorum
+
+
+class SeqState(enum.IntEnum):
+    UNINITIALIZED = 0
+    ALLOCATED = 1
+    PENDING_REQUESTS = 2
+    READY = 3
+    PREPREPARED = 4
+    PREPARED = 5
+    COMMITTED = 6
+
+
+class NodeSeqState(enum.IntEnum):
+    UNINITIALIZED = 0
+    PREPREPARED = 1
+    PREPARED = 2
+
+
+class _NodeChoice:
+    __slots__ = ("state", "digest")
+
+    def __init__(self):
+        self.state = NodeSeqState.UNINITIALIZED
+        self.digest: Optional[bytes] = None
+
+
+class Sequence:
+    """One in-flight sequence number within an active epoch."""
+
+    __slots__ = (
+        "owner",
+        "seq_no",
+        "epoch",
+        "my_id",
+        "network_config",
+        "persisted",
+        "state",
+        "q_entry",
+        "client_requests",
+        "batch",
+        "outstanding_reqs",
+        "digest",
+        "node_choices",
+        "prepares",
+        "commits",
+    )
+
+    def __init__(
+        self,
+        owner: int,
+        epoch: int,
+        seq_no: int,
+        persisted: PersistedLog,
+        network_config: NetworkConfig,
+        my_id: int,
+    ):
+        self.owner = owner
+        self.seq_no = seq_no
+        self.epoch = epoch
+        self.my_id = my_id
+        self.network_config = network_config
+        self.persisted = persisted
+        self.state = SeqState.UNINITIALIZED
+        self.q_entry: Optional[QEntry] = None
+        self.client_requests: List = []  # ClientRequest-like (has .ack, .agreements)
+        self.batch: List[RequestAck] = []
+        self.outstanding_reqs: Optional[Set[RequestAck]] = None
+        self.digest: Optional[bytes] = None
+        self.node_choices: Dict[int, _NodeChoice] = {}
+        self.prepares: Dict[bytes, int] = {}
+        self.commits: Dict[bytes, int] = {}
+
+    def _node_choice(self, source: int) -> _NodeChoice:
+        choice = self.node_choices.get(source)
+        if choice is None:
+            choice = _NodeChoice()
+            self.node_choices[source] = choice
+        return choice
+
+    # --- driver ---
+
+    def advance_state(self) -> Actions:
+        """Iterate phase transitions to fixpoint (reference sequence.go:101-125)."""
+        actions = Actions()
+        while True:
+            old_state = self.state
+            if self.state == SeqState.PENDING_REQUESTS:
+                self._check_requests()
+            elif self.state == SeqState.READY:
+                if self.digest is not None or not self.batch:
+                    actions.concat(self._prepare())
+            elif self.state == SeqState.PREPREPARED:
+                actions.concat(self._check_prepare_quorum())
+            elif self.state == SeqState.PREPARED:
+                self._check_commit_quorum()
+            if self.state == old_state:
+                return actions
+
+    # --- allocation ---
+
+    def allocate_as_owner(self, client_requests: List) -> Actions:
+        """Owner-side allocation from proposer-selected client requests
+        (reference sequence.go:127-137)."""
+        self.client_requests = client_requests
+        return self.allocate([cr.ack for cr in client_requests], None)
+
+    def allocate(
+        self,
+        request_acks: List[RequestAck],
+        outstanding_reqs: Optional[Set[RequestAck]],
+    ) -> Actions:
+        """Reserve this sequence for a batch; emits the batch-digest hash
+        request (the TPU hot-path action) unless the batch is empty
+        (reference sequence.go:139-177)."""
+        if self.state != SeqState.UNINITIALIZED:
+            raise AssertionError(
+                f"seq_no={self.seq_no} must be uninitialized to allocate, "
+                f"was {self.state.name}"
+            )
+        self.state = SeqState.ALLOCATED
+        self.batch = request_acks
+        self.outstanding_reqs = outstanding_reqs
+
+        if not request_acks:
+            # Null batch: no digest to compute.
+            self.state = SeqState.READY
+            return self.apply_batch_hash_result(None)
+
+        actions = Actions().hash(
+            [ack.digest for ack in request_acks],
+            st.BatchOrigin(
+                source=self.owner,
+                epoch=self.epoch,
+                seq_no=self.seq_no,
+                request_acks=tuple(request_acks),
+            ),
+        )
+        self.state = SeqState.PENDING_REQUESTS
+        return actions.concat(self.advance_state())
+
+    def satisfy_outstanding(self, ack: RequestAck) -> Actions:
+        """A request this sequence was waiting on became locally available
+        (reference sequence.go:179-188)."""
+        if self.outstanding_reqs is None or ack not in self.outstanding_reqs:
+            raise AssertionError(
+                f"told request {ack.digest.hex()} was ready but we weren't "
+                "waiting for it"
+            )
+        self.outstanding_reqs.discard(ack)
+        return self.advance_state()
+
+    def _check_requests(self) -> None:
+        if self.outstanding_reqs:
+            return
+        self.state = SeqState.READY
+
+    # --- three-phase commit ---
+
+    def apply_batch_hash_result(self, digest: Optional[bytes]) -> Actions:
+        """Record the batch digest (computed on TPU) and treat it as the
+        owner's implicit prepare (reference sequence.go:190-194)."""
+        self.digest = digest
+        return self.apply_prepare_msg(self.owner, digest)
+
+    def _prepare(self) -> Actions:
+        """Persist the QEntry, then send Preprepare (owner) or Prepare
+        (follower) — WAL-before-send (reference sequence.go:196-255)."""
+        self.q_entry = QEntry(
+            seq_no=self.seq_no,
+            digest=self.digest if self.digest is not None else b"",
+            requests=tuple(self.batch),
+        )
+        self.state = SeqState.PREPREPARED
+
+        actions = self.persisted.add_q_entry(self.q_entry)
+
+        if self.owner == self.my_id:
+            # Forward each request to nodes that have not acked it, so
+            # followers can satisfy their outstanding-request checks.
+            for cr in self.client_requests:
+                missing = [
+                    node
+                    for node in self.network_config.nodes
+                    if node not in cr.agreements
+                ]
+                if missing:
+                    actions.forward_request(missing, cr.ack)
+            actions.send(
+                self.network_config.nodes,
+                Preprepare(
+                    seq_no=self.seq_no, epoch=self.epoch, batch=tuple(self.batch)
+                ),
+            )
+        else:
+            actions.send(
+                self.network_config.nodes,
+                Prepare(
+                    seq_no=self.seq_no,
+                    epoch=self.epoch,
+                    digest=self.digest if self.digest is not None else b"",
+                ),
+            )
+        return actions
+
+    def apply_prepare_msg(self, source: int, digest: Optional[bytes]) -> Actions:
+        """Reference sequence.go:257-274, with one deviation: duplicate
+        prepares are dropped for the owner too.  In the reference, the owner's
+        artificial prepare (from the batch hash result) and its own Preprepare
+        loopback BOTH increment the prepare count (its dup-check is
+        ``source != owner`` only), letting a leader count itself twice toward
+        the 2f+1 prepare certificate.  We count each node at most once."""
+        choice = self._node_choice(source)
+        if choice.state > NodeSeqState.UNINITIALIZED:
+            return Actions()
+        choice.state = NodeSeqState.PREPREPARED
+        choice.digest = digest
+        key = digest if digest is not None else b""
+        self.prepares[key] = self.prepares.get(key, 0) + 1
+        return self.advance_state()
+
+    def _check_prepare_quorum(self) -> Actions:
+        """2f+1 prepares (leader's preprepare counts) + own prepare persisted
+        → persist PEntry, send Commit (reference sequence.go:276-318)."""
+        my_key = self.digest if self.digest is not None else b""
+        agreements = self.prepares.get(my_key, 0)
+
+        my_choice = self._node_choice(self.my_id)
+        if my_choice.state < NodeSeqState.PREPREPARED:
+            # Have not sent our own prepare → QEntry may not be persisted.
+            return Actions()
+        my_digest = my_choice.digest if my_choice.digest is not None else b""
+        if my_digest != my_key:
+            # Network's correct digest differs from ours; do not prepare.
+            return Actions()
+
+        if agreements < intersection_quorum(self.network_config):
+            return Actions()
+
+        self.state = SeqState.PREPARED
+        p_entry = PEntry(seq_no=self.seq_no, digest=my_key)
+        return self.persisted.add_p_entry(p_entry).send(
+            self.network_config.nodes,
+            Commit(seq_no=self.seq_no, epoch=self.epoch, digest=my_key),
+        )
+
+    def apply_commit_msg(self, source: int, digest: Optional[bytes]) -> Actions:
+        """Reference sequence.go:320-337."""
+        choice = self._node_choice(source)
+        if choice.state > NodeSeqState.PREPREPARED:
+            return Actions()  # duplicate commit
+        choice.state = NodeSeqState.PREPARED
+        key = digest if digest is not None else b""
+        self.commits[key] = self.commits.get(key, 0) + 1
+        return self.advance_state()
+
+    def _check_commit_quorum(self) -> None:
+        """Reference sequence.go:339-355."""
+        my_key = self.digest if self.digest is not None else b""
+        agreements = self.commits.get(my_key, 0)
+        my_choice = self._node_choice(self.my_id)
+        if my_choice.state < NodeSeqState.PREPARED:
+            return  # our own Commit (and thus PEntry persist) not sent yet
+        if agreements < intersection_quorum(self.network_config):
+            return
+        self.state = SeqState.COMMITTED

@@ -1,0 +1,117 @@
+"""Tests for the persisted-log mirror: append/persist actions, truncation,
+and epoch-change derivation from the WAL."""
+
+import pytest
+
+from mirbft_tpu import messages as m
+from mirbft_tpu import state as s
+from mirbft_tpu.statemachine.persisted import PersistedLog
+
+
+def genesis_ns():
+    return m.NetworkState(
+        config=m.NetworkConfig(
+            nodes=(0, 1, 2, 3),
+            checkpoint_interval=5,
+            max_epoch_length=200,
+            number_of_buckets=4,
+            f=1,
+        ),
+        clients=(),
+    )
+
+
+def seeded_log():
+    log = PersistedLog()
+    log.append_initial_load(
+        1, m.CEntry(seq_no=0, checkpoint_value=b"genesis", network_state=genesis_ns())
+    )
+    log.append_initial_load(
+        2,
+        m.FEntry(ends_epoch_config=m.EpochConfig(0, (0, 1, 2, 3), 0)),
+    )
+    return log
+
+
+def test_append_emits_persist_with_sequential_indexes():
+    log = seeded_log()
+    a1 = log.add_q_entry(m.QEntry(seq_no=1, digest=b"q1", requests=()))
+    a2 = log.add_p_entry(m.PEntry(seq_no=1, digest=b"q1"))
+    assert a1.items == [s.ActionPersist(3, m.QEntry(1, b"q1", ()))]
+    assert a2.items == [s.ActionPersist(4, m.PEntry(1, b"q1"))]
+
+
+def test_initial_load_index_gap_rejected():
+    log = seeded_log()
+    with pytest.raises(AssertionError):
+        log.append_initial_load(7, m.ECEntry(epoch_number=1))
+
+
+def test_append_to_unseeded_log_rejected():
+    with pytest.raises(AssertionError):
+        PersistedLog().add_ec_entry(m.ECEntry(epoch_number=1))
+
+
+def test_truncate_moves_head_to_anchor():
+    log = seeded_log()
+    ec = m.EpochConfig(1, (0, 1, 2, 3), 100)
+    log.add_n_entry(m.NEntry(seq_no=1, epoch_config=ec))  # idx 3
+    log.add_q_entry(m.QEntry(1, b"d1", ()))  # idx 4
+    log.add_c_entry(m.CEntry(5, b"cp5", genesis_ns()))  # idx 5
+    log.add_n_entry(m.NEntry(seq_no=6, epoch_config=ec))  # idx 6
+
+    # low watermark 5: first anchor is CEntry(5) at idx 5
+    acts = log.truncate(5)
+    assert acts.items == [s.ActionTruncate(5)]
+    assert log.entries[0][0] == 5
+    # truncating again at same watermark: anchor already at head → no action
+    assert log.truncate(5).items == []
+
+
+def test_truncate_no_anchor_is_noop():
+    log = seeded_log()
+    assert log.truncate(100).items == []
+
+
+def test_construct_epoch_change_basic():
+    log = seeded_log()
+    ec0 = m.EpochConfig(0, (0, 1, 2, 3), 100)
+    log.add_n_entry(m.NEntry(seq_no=1, epoch_config=ec0))
+    log.add_q_entry(m.QEntry(1, b"d1", ()))
+    log.add_p_entry(m.PEntry(1, b"d1"))
+    log.add_q_entry(m.QEntry(2, b"d2", ()))
+
+    change = log.construct_epoch_change(1)
+    assert change.new_epoch == 1
+    assert change.checkpoints == (m.CheckpointMsg(0, b"genesis"),)
+    assert change.p_set == (m.EpochChangeSetEntry(0, 1, b"d1"),)
+    assert change.q_set == (
+        m.EpochChangeSetEntry(0, 1, b"d1"),
+        m.EpochChangeSetEntry(0, 2, b"d2"),
+    )
+
+
+def test_construct_epoch_change_keeps_only_last_p_entry_per_seq():
+    log = seeded_log()
+    ec0 = m.EpochConfig(0, (0, 1, 2, 3), 100)
+    log.add_n_entry(m.NEntry(seq_no=1, epoch_config=ec0))
+    log.add_p_entry(m.PEntry(1, b"old"))
+    # same seq re-prepared (e.g. across an in-log epoch boundary at same #)
+    log.add_p_entry(m.PEntry(1, b"new"))
+
+    change = log.construct_epoch_change(1)
+    assert change.p_set == (m.EpochChangeSetEntry(0, 1, b"new"),)
+
+
+def test_construct_epoch_change_stops_at_target_epoch():
+    log = seeded_log()
+    ec0 = m.EpochConfig(0, (0,), 100)
+    ec2 = m.EpochConfig(2, (0,), 100)
+    log.add_n_entry(m.NEntry(seq_no=1, epoch_config=ec0))
+    log.add_q_entry(m.QEntry(1, b"in-epoch-0", ()))
+    log.add_n_entry(m.NEntry(seq_no=5, epoch_config=ec2))
+    log.add_q_entry(m.QEntry(5, b"in-epoch-2", ()))
+
+    change = log.construct_epoch_change(2)
+    # entries logged at epoch ≥ 2 must not appear
+    assert change.q_set == (m.EpochChangeSetEntry(0, 1, b"in-epoch-0"),)
