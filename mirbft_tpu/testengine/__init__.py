@@ -1,0 +1,45 @@
+"""Deterministic discrete-event simulation harness (L6).
+
+Rebuild of reference ``pkg/testengine``: N in-process nodes (full state
+machine + processor stacks) share one time-ordered event queue with a
+per-category latency model and seeded randomness — multi-node consensus
+without a cluster, bit-for-bit reproducible.  The mangler DSL injects
+network faults (drop/delay/jitter/duplicate/crash-restart) at the queue.
+"""
+
+from .queue import EventQueue, SimEvent
+from .recorder import (
+    ClientConfig,
+    NodeConfig,
+    Recorder,
+    Recording,
+    ReconfigPoint,
+    RuntimeParameters,
+    Spec,
+)
+from .manglers import (
+    After,
+    Conditional,
+    EventMangling,
+    For,
+    Until,
+    matching,
+)
+
+__all__ = [
+    "After",
+    "ClientConfig",
+    "Conditional",
+    "EventMangling",
+    "EventQueue",
+    "For",
+    "NodeConfig",
+    "ReconfigPoint",
+    "Recorder",
+    "Recording",
+    "RuntimeParameters",
+    "SimEvent",
+    "Spec",
+    "Until",
+    "matching",
+]

@@ -1,0 +1,280 @@
+"""Fault-injection DSL for the deterministic test engine.
+
+Rebuild of reference ``pkg/testengine/manglers.go``.  The reference builds
+its fluent matcher API via reflection over struct fields; here matchers are
+plain chained predicates.  Usage reads the same::
+
+    matching.msgs().from_nodes(1, 3).at_percent(10).drop()
+    Until(matching.msgs().of_type(Commit).with_sequence(20)).delay(500)
+    For(matching.msgs().from_self()).crash_and_restart_after(100, init_parms)
+
+Filters apply first-to-last; order matters (reference manglers.go:26-34).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Type
+
+from ..messages import (
+    CheckpointMsg,
+    Commit,
+    EpochChange,
+    EpochChangeAck,
+    FetchBatch,
+    ForwardBatch,
+    Msg,
+    NewEpoch,
+    NewEpochEcho,
+    NewEpochReady,
+    Preprepare,
+    Prepare,
+    Suspect,
+)
+from ..state import EventInitialParameters
+from .queue import SimEvent
+
+
+@dataclass
+class MangleResult:
+    event: SimEvent
+    remangle: bool = False
+
+
+Predicate = Callable[[int, SimEvent], bool]
+
+
+def _msg_epoch(msg: Msg) -> Optional[int]:
+    if isinstance(msg, (Preprepare, Prepare, Commit, Suspect)):
+        return msg.epoch
+    if isinstance(msg, EpochChange):
+        return msg.new_epoch
+    if isinstance(msg, EpochChangeAck):
+        return msg.epoch_change.new_epoch
+    if isinstance(msg, NewEpoch):
+        return msg.new_config.config.number
+    if isinstance(msg, (NewEpochEcho, NewEpochReady)):
+        return msg.config.config.number
+    return None
+
+
+def _msg_seq_no(msg: Msg) -> Optional[int]:
+    if isinstance(
+        msg, (Preprepare, Prepare, Commit, CheckpointMsg, FetchBatch, ForwardBatch)
+    ):
+        return msg.seq_no
+    return None
+
+
+class Conditional:
+    """A chainable conjunction of predicates (the reference's ``matching``)."""
+
+    def __init__(self, predicates: Sequence[Predicate]):
+        self._predicates = list(predicates)
+
+    def matches(self, random: int, event: SimEvent) -> bool:
+        return all(p(random, event) for p in self._predicates)
+
+    def _and(self, predicate: Predicate) -> "Conditional":
+        return Conditional(self._predicates + [predicate])
+
+    # --- message-scoped filters ---
+
+    def from_self(self) -> "Conditional":
+        return self._and(
+            lambda r, e: e.msg_received is not None
+            and e.msg_received[0] == e.target
+        )
+
+    def from_node(self, node_id: int) -> "Conditional":
+        return self.from_nodes(node_id)
+
+    def from_nodes(self, *node_ids: int) -> "Conditional":
+        # Ignores self-referential messages (links to self must stay reliable).
+        return self._and(
+            lambda r, e: e.msg_received is not None
+            and e.msg_received[0] != e.target
+            and e.msg_received[0] in node_ids
+        )
+
+    def to_node(self, node_id: int) -> "Conditional":
+        return self.to_nodes(node_id)
+
+    def to_nodes(self, *node_ids: int) -> "Conditional":
+        return self._and(lambda r, e: e.target in node_ids)
+
+    # synonyms used for startup matching
+    for_node = to_node
+    for_nodes = to_nodes
+
+    def at_percent(self, percent: int) -> "Conditional":
+        return self._and(lambda r, e: r % 100 <= percent)
+
+    def with_sequence(self, seq_no: int) -> "Conditional":
+        return self._and(
+            lambda r, e: e.msg_received is not None
+            and _msg_seq_no(e.msg_received[1]) == seq_no
+        )
+
+    def with_epoch(self, epoch: int) -> "Conditional":
+        return self._and(
+            lambda r, e: e.msg_received is not None
+            and _msg_epoch(e.msg_received[1]) == epoch
+        )
+
+    def of_type(self, *msg_types: Type) -> "Conditional":
+        return self._and(
+            lambda r, e: e.msg_received is not None
+            and isinstance(e.msg_received[1], msg_types)
+        )
+
+    def from_client(self, client_id: int) -> "Conditional":
+        return self._and(
+            lambda r, e: e.client_proposal is not None
+            and e.client_proposal[0] == client_id
+        )
+
+    # --- terminal constructors (sugar for For(self).X()) ---
+
+    def drop(self) -> "EventMangling":
+        return For(self).drop()
+
+    def jitter(self, max_delay: int) -> "EventMangling":
+        return For(self).jitter(max_delay)
+
+    def duplicate(self, max_delay: int) -> "EventMangling":
+        return For(self).duplicate(max_delay)
+
+    def delay(self, delay: int) -> "EventMangling":
+        return For(self).delay(delay)
+
+    def crash_and_restart_after(
+        self, delay: int, init_parms: EventInitialParameters
+    ) -> "EventMangling":
+        return For(self).crash_and_restart_after(delay, init_parms)
+
+
+class _MatchingNamespace:
+    """Entry points (reference MatchMsgs / MatchNodeStartup /
+    MatchClientProposal)."""
+
+    @staticmethod
+    def msgs() -> Conditional:
+        return Conditional([lambda r, e: e.msg_received is not None])
+
+    @staticmethod
+    def node_startup() -> Conditional:
+        return Conditional([lambda r, e: e.initialize is not None])
+
+    @staticmethod
+    def client_proposal() -> Conditional:
+        return Conditional([lambda r, e: e.client_proposal is not None])
+
+
+matching = _MatchingNamespace()
+
+
+# ---------------------------------------------------------------------------
+# Concrete manglers (reference manglers.go:604-679).
+# ---------------------------------------------------------------------------
+
+
+class EventMangling:
+    """A conditional mangler: applies ``action`` when the filter matches,
+    passes the event through untouched otherwise."""
+
+    def __init__(self, filter_: Conditional, action: Callable[[int, SimEvent], List[MangleResult]]):
+        self.filter = filter_
+        self.action = action
+
+    def mangle(self, random: int, event: SimEvent) -> List[MangleResult]:
+        if not self.filter.matches(random, event):
+            return [MangleResult(event)]
+        return self.action(random, event)
+
+
+class _Mangling:
+    """Builder bound to a filter (the reference's ``Mangling``)."""
+
+    def __init__(self, filter_: Conditional):
+        self.filter = filter_
+
+    def do(self, action) -> EventMangling:
+        return EventMangling(self.filter, action)
+
+    def drop(self) -> EventMangling:
+        return self.do(lambda r, e: [])
+
+    def jitter(self, max_delay: int) -> EventMangling:
+        def action(r: int, e: SimEvent) -> List[MangleResult]:
+            e.time += r % max_delay
+            return [MangleResult(e)]
+
+        return self.do(action)
+
+    def duplicate(self, max_delay: int) -> EventMangling:
+        def action(r: int, e: SimEvent) -> List[MangleResult]:
+            clone = copy.copy(e)
+            clone.time += r % max_delay
+            return [MangleResult(e), MangleResult(clone)]
+
+        return self.do(action)
+
+    def delay(self, delay: int) -> EventMangling:
+        def action(r: int, e: SimEvent) -> List[MangleResult]:
+            e.time += delay
+            # remangle: a delayed event may be delayed again on next touch
+            return [MangleResult(e, remangle=True)]
+
+        return self.do(action)
+
+    def crash_and_restart_after(
+        self, delay: int, init_parms: EventInitialParameters
+    ) -> EventMangling:
+        def action(r: int, e: SimEvent) -> List[MangleResult]:
+            return [
+                MangleResult(e),
+                MangleResult(
+                    SimEvent(
+                        target=init_parms.id,
+                        time=e.time + delay,
+                        initialize=init_parms,
+                    )
+                ),
+            ]
+
+        return self.do(action)
+
+
+def For(matcher: Conditional) -> _Mangling:
+    """Apply whenever the condition matches (reference manglers.go:74-79)."""
+    return _Mangling(matcher)
+
+
+def Until(matcher: Conditional) -> _Mangling:
+    """Apply until the condition first matches (reference manglers.go:41-56)."""
+    state = {"matched": False}
+
+    def predicate(random: int, event: SimEvent) -> bool:
+        if state["matched"] or matcher.matches(random, event):
+            state["matched"] = True
+            return False
+        return True
+
+    return _Mangling(Conditional([predicate]))
+
+
+def After(matcher: Conditional) -> _Mangling:
+    """Apply only after the condition first matches
+    (reference manglers.go:59-71)."""
+    state = {"matched": False}
+
+    def predicate(random: int, event: SimEvent) -> bool:
+        if state["matched"] or matcher.matches(random, event):
+            state["matched"] = True
+            return True
+        return False
+
+    return _Mangling(Conditional([predicate]))

@@ -1,0 +1,605 @@
+"""Simulated nodes, in-memory fakes, and the single-threaded scheduler.
+
+Rebuild of reference ``pkg/testengine/recorder.go``: in-memory WAL/request
+store, a Link that enqueues MsgReceived with latency, a hashing NodeState app
+with snapshot chaining + reconfig points + state-transfer log, the
+per-category latency model, and ``Recording.step()`` replicating the
+concurrency rules of the node runtime single-threadedly (one in-flight batch
+per work category).  ``drain_clients`` runs the simulation until every
+client's requests commit on every node.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import processor as proc
+from .. import wire
+from ..config import standard_initial_network_state
+from ..messages import (
+    CEntry,
+    EpochConfig,
+    FEntry,
+    NetworkState,
+    Persistent,
+    QEntry,
+    Reconfiguration,
+    RequestAck,
+)
+from ..ops import CpuHasher
+from ..state import Event, EventInitialParameters
+from ..statemachine.actions import Actions, Events
+from ..statemachine.machine import StateMachine
+from .queue import EventQueue, SimEvent
+
+
+def _u64(value: int) -> bytes:
+    return value.to_bytes(8, "big")
+
+
+# ---------------------------------------------------------------------------
+# In-memory fakes (reference recorder.go:39-201).
+# ---------------------------------------------------------------------------
+
+
+class SimLink:
+    """Enqueues MsgReceived with link latency (reference recorder.go:39-47)."""
+
+    def __init__(self, source: int, event_queue: EventQueue, delay: int):
+        self.source = source
+        self.event_queue = event_queue
+        self.delay = delay
+
+    def send(self, dest: int, msg) -> None:
+        self.event_queue.insert_msg_received(dest, self.source, msg, self.delay)
+
+
+class SimReqStore:
+    """Map-backed request store (reference recorder.go:87-124)."""
+
+    def __init__(self):
+        self.requests: Dict[RequestAck, bytes] = {}
+        self.allocations: Dict[Tuple[int, int], bytes] = {}
+
+    def put_request(self, ack: RequestAck, data: bytes) -> None:
+        self.requests[ack] = data
+
+    def get_request(self, ack: RequestAck) -> Optional[bytes]:
+        return self.requests.get(ack)
+
+    def put_allocation(self, client_id: int, req_no: int, digest: bytes) -> None:
+        self.allocations[(client_id, req_no)] = digest
+
+    def get_allocation(self, client_id: int, req_no: int) -> Optional[bytes]:
+        return self.allocations.get((client_id, req_no))
+
+    def sync(self) -> None:
+        pass
+
+
+class SimWAL:
+    """List-backed WAL with strict index accounting
+    (reference recorder.go:126-201)."""
+
+    def __init__(self, initial_state: NetworkState, initial_cp: bytes):
+        self.low_index = 1
+        self.entries: List[Persistent] = [
+            CEntry(
+                seq_no=0,
+                checkpoint_value=initial_cp,
+                network_state=initial_state,
+            ),
+            FEntry(
+                ends_epoch_config=EpochConfig(
+                    number=0,
+                    leaders=initial_state.config.nodes,
+                    planned_expiration=0,
+                )
+            ),
+        ]
+
+    def write(self, index: int, entry: Persistent) -> None:
+        expected = self.low_index + len(self.entries)
+        if index != expected:
+            raise AssertionError(
+                f"WAL out of order: expected next index {expected}, got {index}"
+            )
+        self.entries.append(entry)
+
+    def truncate(self, index: int) -> None:
+        if index < self.low_index:
+            raise AssertionError(
+                f"truncate to {index} below low index {self.low_index}"
+            )
+        to_remove = index - self.low_index
+        if to_remove >= len(self.entries):
+            raise AssertionError(
+                f"truncate to {index} beyond highest index "
+                f"{self.low_index + len(self.entries)}"
+            )
+        del self.entries[:to_remove]
+        self.low_index = index
+
+    def load_all(self, for_each: Callable[[int, Persistent], None]) -> None:
+        for i, entry in enumerate(self.entries):
+            for_each(self.low_index + i, entry)
+
+    def sync(self) -> None:
+        pass
+
+
+class NodeState:
+    """The simulated replicated app: hash-chained commit log with snapshot
+    values encoding the network state (reference recorder.go:272-359)."""
+
+    def __init__(self, req_store: SimReqStore, reconfig_points: List["ReconfigPoint"]):
+        self.req_store = req_store
+        self.reconfig_points = list(reconfig_points)
+        self.pending_reconfigurations: List[Reconfiguration] = []
+        self.last_seq_no = 0
+        self.active_hash = hashlib.sha256()
+        self.checkpoint_seq_no = 0
+        self.checkpoint_hash = b""
+        self.checkpoint_state: Optional[NetworkState] = None
+        self.state_transfers: List[int] = []  # for test assertions
+
+    def snap(self, network_config, client_states):
+        pending = tuple(self.pending_reconfigurations)
+        self.pending_reconfigurations = []
+
+        self.checkpoint_seq_no = self.last_seq_no
+        self.checkpoint_state = NetworkState(
+            config=network_config,
+            clients=tuple(client_states),
+            pending_reconfigurations=pending,
+        )
+        self.checkpoint_hash = self.active_hash.digest()
+        self.active_hash = hashlib.sha256()
+        self.active_hash.update(self.checkpoint_hash)
+
+        # Test convenience (as in the reference): the value carries the full
+        # network state so state transfer needs no cross-node lookup.
+        value = self.checkpoint_hash + wire.encode(self.checkpoint_state)
+        return value, pending
+
+    def transfer_to(self, seq_no: int, snap: bytes) -> NetworkState:
+        self.state_transfers.append(seq_no)
+        network_state = wire.decode(snap[32:])
+        if not isinstance(network_state, NetworkState):
+            raise ValueError("snapshot does not encode a NetworkState")
+        self.last_seq_no = seq_no
+        self.checkpoint_seq_no = seq_no
+        self.checkpoint_state = network_state
+        self.checkpoint_hash = snap[:32]
+        self.active_hash = hashlib.sha256()
+        self.active_hash.update(self.checkpoint_hash)
+        return network_state
+
+    def apply(self, batch: QEntry) -> None:
+        self.last_seq_no += 1
+        if batch.seq_no != self.last_seq_no:
+            raise AssertionError(
+                f"out-of-order commit: expected {self.last_seq_no}, got "
+                f"{batch.seq_no}"
+            )
+        for request in batch.requests:
+            data = self.req_store.get_request(request)
+            if data is None:
+                raise AssertionError(
+                    "reqstore must have a request we are committing"
+                )
+            self.active_hash.update(request.digest)
+            for point in self.reconfig_points:
+                if (
+                    point.client_id == request.client_id
+                    and point.req_no == request.req_no
+                ):
+                    self.pending_reconfigurations.append(point.reconfiguration)
+
+
+# ---------------------------------------------------------------------------
+# Configuration (reference recorder.go:49-65, 361-385, 725-790).
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RuntimeParameters:
+    """Per-category simulated latencies (reference recorder.go:54-65)."""
+
+    tick_interval: int = 500
+    link_latency: int = 100
+    process_wal_latency: int = 100
+    process_net_latency: int = 15
+    process_hash_latency: int = 25
+    process_client_latency: int = 15
+    process_app_latency: int = 30
+    process_req_store_latency: int = 150
+    process_events_latency: int = 10
+
+
+@dataclass
+class NodeConfig:
+    init_parms: EventInitialParameters
+    runtime_parms: RuntimeParameters
+
+
+@dataclass
+class ClientConfig:
+    """Reference recorder.go:361-385 (its dead ``MaxInFlight`` knob is
+    dropped: proposals are sequential per node in both implementations)."""
+
+    id: int
+    total: int
+    ignore_nodes: Tuple[int, ...] = ()
+
+    def should_skip(self, node_id: int) -> bool:
+        return node_id in self.ignore_nodes
+
+
+@dataclass
+class ReconfigPoint:
+    client_id: int
+    req_no: int
+    reconfiguration: Reconfiguration
+
+
+class SimClient:
+    """Deterministic request generator (reference recorder.go:246-263)."""
+
+    def __init__(self, config: ClientConfig):
+        self.config = config
+
+    def request_by_req_no(self, req_no: int) -> Optional[bytes]:
+        if req_no >= self.config.total:
+            return None
+        return _u64(self.config.id) + b"-" + _u64(req_no)
+
+
+# ---------------------------------------------------------------------------
+# Node + Recording (reference recorder.go:203-244, 387-723).
+# ---------------------------------------------------------------------------
+
+
+class SimNode:
+    def __init__(
+        self,
+        node_id: int,
+        config: NodeConfig,
+        wal: SimWAL,
+        link: SimLink,
+        req_store: SimReqStore,
+        state: NodeState,
+        interceptor=None,
+    ):
+        self.id = node_id
+        self.config = config
+        self.wal = wal
+        self.link = link
+        self.req_store = req_store
+        self.state = state
+        self.interceptor = interceptor
+        self.hasher = CpuHasher()
+        self.work_items: Optional[proc.WorkItems] = None
+        self.clients: Optional[proc.Clients] = None
+        self.state_machine: Optional[StateMachine] = None
+        self.pending: Dict[str, bool] = {}
+
+    def initialize(self, init_parms: EventInitialParameters) -> None:
+        """(Re)boot the node from its WAL (reference recorder.go:222-244)."""
+        self.work_items = proc.WorkItems()
+        self.clients = proc.Clients(self.hasher, self.req_store)
+        self.state_machine = StateMachine()
+        self.pending = {}
+        events = proc.recover_wal_for_existing_node(self.wal, init_parms)
+        self.work_items.result_events.concat(events)
+
+
+class Recorder:
+    """Builds Recordings (reference recorder.go:387-470)."""
+
+    def __init__(
+        self,
+        network_state: NetworkState,
+        node_configs: List[NodeConfig],
+        client_configs: List[ClientConfig],
+        reconfig_points: Optional[List[ReconfigPoint]] = None,
+        mangler=None,
+        random_seed: int = 0,
+        event_log_writer=None,
+    ):
+        self.network_state = network_state
+        self.node_configs = node_configs
+        self.client_configs = client_configs
+        self.reconfig_points = reconfig_points or []
+        self.mangler = mangler
+        self.random_seed = random_seed
+        self.event_log_writer = event_log_writer
+
+    def recording(self) -> "Recording":
+        event_queue = EventQueue(seed=self.random_seed, mangler=self.mangler)
+
+        nodes = []
+        for i, node_config in enumerate(self.node_configs):
+            req_store = SimReqStore()
+            node_state = NodeState(req_store, self.reconfig_points)
+            checkpoint_value, _ = node_state.snap(
+                self.network_state.config, self.network_state.clients
+            )
+            wal = SimWAL(self.network_state, checkpoint_value)
+            link = SimLink(
+                i, event_queue, node_config.runtime_parms.link_latency
+            )
+
+            interceptor = None
+            if self.event_log_writer is not None:
+                writer = self.event_log_writer
+                interceptor = _Interceptor(i, event_queue, writer)
+
+            nodes.append(
+                SimNode(
+                    i, node_config, wal, link, req_store, node_state, interceptor
+                )
+            )
+            event_queue.insert_initialize(i, node_config.init_parms, 0)
+
+        clients = [SimClient(cc) for cc in self.client_configs]
+        return Recording(event_queue, nodes, clients)
+
+
+class _Interceptor:
+    def __init__(self, node_id: int, event_queue: EventQueue, writer):
+        self.node_id = node_id
+        self.event_queue = event_queue
+        self.writer = writer
+
+    def intercept(self, event: Event) -> None:
+        from ..state import RecordedEvent
+
+        wire.write_framed(
+            self.writer,
+            RecordedEvent(
+                node_id=self.node_id,
+                time=self.event_queue.fake_time,
+                state_event=event,
+            ),
+        )
+
+
+class Recording:
+    """Reference recorder.go:472-723."""
+
+    def __init__(self, event_queue: EventQueue, nodes: List[SimNode], clients: List[SimClient]):
+        self.event_queue = event_queue
+        self.nodes = nodes
+        self.clients = clients
+
+    def step(self) -> None:
+        """Consume one simulation event, replicating the scheduling rules of
+        the concurrent node runtime single-threadedly
+        (reference recorder.go:484-677)."""
+        if not len(self.event_queue):
+            raise AssertionError("event queue is empty, nothing to do")
+
+        event = self.event_queue.consume()
+        node = self.nodes[event.target]
+        parms = node.config.runtime_parms
+        queue = self.event_queue
+
+        if event.initialize is not None:
+            # Restart: clear any outstanding events for this node first.
+            queue.remove_events_for(node.id)
+            node.initialize(event.initialize)
+            queue.insert_tick(node.id, parms.tick_interval)
+            for client_state in node.state.checkpoint_state.clients:
+                client = self.clients[client_state.id]
+                if client.config.should_skip(node.id):
+                    continue
+                data = client.request_by_req_no(client_state.low_watermark)
+                if data is not None:
+                    queue.insert_client_proposal(
+                        node.id,
+                        client_state.id,
+                        client_state.low_watermark,
+                        data,
+                        parms.process_client_latency,
+                    )
+        elif event.msg_received is not None:
+            if node.state_machine is not None:
+                source, msg = event.msg_received
+                node.work_items.result_events.step(source, msg)
+        elif event.client_proposal is not None:
+            client_id, req_no, data = event.client_proposal
+            client = node.clients.client(client_id)
+            try:
+                next_req_no = client.next_req_no_value()
+            except proc.clients.ClientNotExistError:
+                # Client window not allocated yet; retry later.
+                queue.insert_client_proposal(
+                    node.id,
+                    client_id,
+                    req_no,
+                    data,
+                    parms.process_client_latency * 100,
+                )
+            else:
+                sim_client = self.clients[client_id]
+                if sim_client.config.should_skip(node.id):
+                    raise AssertionError(
+                        f"node {node.id} should be skipped by client {client_id}"
+                    )
+                if next_req_no != req_no:
+                    next_data = sim_client.request_by_req_no(next_req_no)
+                    if next_data is not None:
+                        queue.insert_client_proposal(
+                            node.id,
+                            client_id,
+                            next_req_no,
+                            next_data,
+                            parms.process_client_latency,
+                        )
+                else:
+                    events = client.propose(req_no, data)
+                    node.work_items.add_client_results(events)
+                    next_data = sim_client.request_by_req_no(req_no + 1)
+                    if next_data is not None:
+                        queue.insert_client_proposal(
+                            node.id,
+                            client_id,
+                            req_no + 1,
+                            next_data,
+                            parms.process_client_latency,
+                        )
+        elif event.tick:
+            node.work_items.result_events.tick_elapsed()
+            queue.insert_tick(node.id, parms.tick_interval)
+        elif event.process_req_store_events is not None:
+            node.work_items.add_req_store_results(
+                proc.process_reqstore_events(
+                    node.req_store, event.process_req_store_events
+                )
+            )
+            node.pending["req_store"] = False
+        elif event.process_result_events is not None:
+            actions = proc.process_state_machine_events(
+                node.state_machine, node.interceptor, event.process_result_events
+            )
+            node.work_items.add_state_machine_results(actions)
+            node.pending["result"] = False
+        elif event.process_wal_actions is not None:
+            node.work_items.add_wal_results(
+                proc.process_wal_actions(node.wal, event.process_wal_actions)
+            )
+            node.pending["wal"] = False
+        elif event.process_net_actions is not None:
+            node.work_items.add_net_results(
+                proc.process_net_actions(
+                    node.id, node.link, event.process_net_actions
+                )
+            )
+            node.pending["net"] = False
+        elif event.process_hash_actions is not None:
+            node.work_items.add_hash_results(
+                proc.process_hash_actions(node.hasher, event.process_hash_actions)
+            )
+            node.pending["hash"] = False
+        elif event.process_client_actions is not None:
+            node.work_items.add_client_results(
+                node.clients.process_client_actions(event.process_client_actions)
+            )
+            node.pending["client"] = False
+        elif event.process_app_actions is not None:
+            node.work_items.add_app_results(
+                proc.process_app_actions(node.state, event.process_app_actions)
+            )
+            node.pending["app"] = False
+        else:
+            raise AssertionError("unknown simulation event")
+
+        if node.work_items is None:
+            return
+
+        # Schedule processing for any non-empty work category with no batch
+        # already in flight (reference recorder.go:616-677).
+        work = node.work_items
+        for key, attr, event_field, latency, empty in (
+            ("wal", "wal_actions", "process_wal_actions", parms.process_wal_latency, Actions),
+            ("net", "net_actions", "process_net_actions", parms.process_net_latency, Actions),
+            ("client", "client_actions", "process_client_actions", parms.process_client_latency, Actions),
+            ("hash", "hash_actions", "process_hash_actions", parms.process_hash_latency, Actions),
+            ("app", "app_actions", "process_app_actions", parms.process_app_latency, Actions),
+            ("req_store", "req_store_events", "process_req_store_events", parms.process_req_store_latency, Events),
+            ("result", "result_events", "process_result_events", parms.process_events_latency, Events),
+        ):
+            batch = getattr(work, attr)
+            if not node.pending.get(key) and len(batch) > 0:
+                node.pending[key] = True
+                queue.insert_process(node.id, event_field, batch, latency)
+                setattr(work, attr, empty())
+
+    def drain_clients(self, timeout: int) -> int:
+        """Run until every client's requests commit on every node
+        (reference recorder.go:682-723).  Returns the step count."""
+        target_reqs = {c.config.id: c.config.total for c in self.clients}
+        count = 0
+        while True:
+            count += 1
+            self.step()
+
+            all_done = True
+            for node in self.nodes:
+                for client_state in node.state.checkpoint_state.clients:
+                    if target_reqs[client_state.id] != client_state.low_watermark:
+                        all_done = False
+                        break
+                if not all_done:
+                    break
+            if all_done:
+                return count
+
+            if count > timeout:
+                details = []
+                for node in self.nodes:
+                    for cs in node.state.checkpoint_state.clients:
+                        if target_reqs[cs.id] != cs.low_watermark:
+                            details.append(
+                                f"node{node.id} client {cs.id} at "
+                                f"{cs.low_watermark}/{target_reqs[cs.id]}"
+                            )
+                raise TimeoutError(
+                    f"timed out after {count} steps: {'; '.join(details)}"
+                )
+
+
+# ---------------------------------------------------------------------------
+# Spec: convenience constructor (reference recorder.go:725-790).
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Spec:
+    node_count: int
+    client_count: int
+    reqs_per_client: int
+    batch_size: int = 1
+    clients_ignore: Tuple[int, ...] = ()
+    tweak_recorder: Optional[Callable[[Recorder], None]] = None
+
+    def recorder(self) -> Recorder:
+        node_configs = [
+            NodeConfig(
+                init_parms=EventInitialParameters(
+                    id=i,
+                    heartbeat_ticks=2,
+                    suspect_ticks=4,
+                    new_epoch_timeout_ticks=8,
+                    buffer_size=5 * 1024 * 1024,
+                    batch_size=self.batch_size,
+                ),
+                runtime_parms=RuntimeParameters(),
+            )
+            for i in range(self.node_count)
+        ]
+
+        network_state = standard_initial_network_state(
+            self.node_count, *range(self.client_count)
+        )
+
+        client_configs = [
+            ClientConfig(
+                id=client.id,
+                total=self.reqs_per_client,
+                ignore_nodes=self.clients_ignore,
+            )
+            for client in network_state.clients
+        ]
+
+        recorder = Recorder(
+            network_state=network_state,
+            node_configs=node_configs,
+            client_configs=client_configs,
+        )
+        if self.tweak_recorder is not None:
+            self.tweak_recorder(recorder)
+        return recorder
