@@ -7,7 +7,6 @@ Rebuild of reference ``config.go`` and ``mirbft.go:104-133``
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
 
 from .messages import ClientState, NetworkConfig, NetworkState
 from .state import EventInitialParameters

@@ -1,0 +1,11 @@
+"""Event-log recording and replay (L5 observability).
+
+Rebuild of reference ``pkg/eventlog``: every event entering a state machine
+is tapped through an ``EventInterceptor`` and appended — with node id and
+fake/wall time — to a gzip-compressed stream of length-prefixed canonical
+records, enabling byte-exact deterministic replay (``mirbft_tpu.tools.mircat``).
+"""
+
+from .record import Recorder, read_event_log, write_recorded_event
+
+__all__ = ["Recorder", "read_event_log", "write_recorded_event"]

@@ -16,7 +16,7 @@ Reconfiguration variants.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional, Tuple, Union
+from typing import Tuple, Union
 
 # ---------------------------------------------------------------------------
 # Network state (consensused configuration).  Reference: msgs.proto:18-111.

@@ -1,0 +1,227 @@
+"""Batched SHA-256 on TPU (pure JAX, fixed shapes, vmapped).
+
+The crypto hot path of the framework (BASELINE.json north star): every
+``ActionHashRequest`` of a processing iteration — batch digests, batch
+verification, epoch-change hashing, request digests — becomes one row of a
+fixed-shape uint32 array and the whole batch is digested in a single device
+dispatch.  The reference computes these one at a time on host CPU through a
+streaming hasher (``pkg/processor/serial.go:180-198``); here the work is
+data-parallel over the message dimension, which is the axis that scales with
+replica count and load.
+
+Design notes (TPU-first):
+* SHA-256 is pure uint32 bitwise/add arithmetic — no MXU, but VPU-friendly:
+  the batch dimension vectorizes across lanes.  All ops are `jnp.uint32`
+  with wrap-around addition, exactly matching the spec.
+* **Static shapes via dual bucketing**: messages are padded to per-bucket
+  block counts (powers of two) and the batch dimension is padded to powers
+  of two, so the number of compiled variants is O(log(max_len) ·
+  log(max_batch)) and steady-state traffic never recompiles.
+* **Variable length inside a fixed shape**: compression runs as a
+  `lax.scan` over the block dimension; rows whose real block count is
+  shorter carry their state through unchanged (`jnp.where` on the block
+  index), so one shape serves every message length in the bucket.
+* Both the message schedule and the 64 rounds run as `lax.scan`s inside the
+  scanned block step, keeping the traced program small — compile time per
+  bucket shape stays in seconds while the vmapped batch dimension supplies
+  the vector parallelism.
+
+Digest-equality against hashlib is pinned in tests (CPU and TPU backends are
+interchangeable implementations of ``processor.Hasher``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# Round constants (FIPS 180-4).
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5,
+        0x3956C25B, 0x59F111F1, 0x923F82A4, 0xAB1C5ED5,
+        0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174,
+        0xE49B69C1, 0xEFBE4786, 0x0FC19DC6, 0x240CA1CC,
+        0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7,
+        0xC6E00BF3, 0xD5A79147, 0x06CA6351, 0x14292967,
+        0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85,
+        0xA2BFE8A1, 0xA81A664B, 0xC24B8B70, 0xC76C51A3,
+        0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5,
+        0x391C0CB3, 0x4ED8AA4A, 0x5B9CCA4F, 0x682E6FF3,
+        0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_H0 = np.array(
+    [
+        0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+        0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+    ],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _compress_block(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """One SHA-256 compression: state [8] uint32, block [16] uint32 -> [8].
+
+    Both the message schedule and the 64 rounds run as `lax.scan`s (not
+    unrolled) so the traced program stays small — compile time per bucket
+    shape is then dominated by neither; the batch dimension (vmapped one
+    level up) provides the vector parallelism."""
+
+    # Message schedule: rolling 16-word window, 48 scanned steps.
+    def schedule_step(window, _):
+        s0 = _rotr(window[1], 7) ^ _rotr(window[1], 18) ^ (window[1] >> np.uint32(3))
+        s1 = _rotr(window[14], 17) ^ _rotr(window[14], 19) ^ (
+            window[14] >> np.uint32(10)
+        )
+        new = window[0] + s0 + window[9] + s1
+        return jnp.concatenate([window[1:], new[None]]), new
+
+    _, w_tail = jax.lax.scan(schedule_step, block, None, length=48)
+    w = jnp.concatenate([block, w_tail])  # [64]
+
+    def round_step(carry, wk):
+        a, b, c, d, e, f, g, h = carry
+        w_t, k_t = wk
+        big_s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        temp1 = h + big_s1 + ch + k_t + w_t
+        big_s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        temp2 = big_s0 + maj
+        return (temp1 + temp2, a, b, c, d + temp1, e, f, g), None
+
+    carry0 = tuple(state[i] for i in range(8))
+    final, _ = jax.lax.scan(round_step, carry0, (w, jnp.asarray(_K)))
+    return state + jnp.stack(final)
+
+
+def _sha256_padded(blocks: jnp.ndarray, n_blocks: jnp.ndarray) -> jnp.ndarray:
+    """Digest one padded message: blocks [L, 16] uint32, n_blocks scalar.
+    Blocks at index >= n_blocks are padding and leave the state unchanged."""
+
+    def step(state, idx_block):
+        idx, block = idx_block
+        new_state = _compress_block(state, block)
+        state = jnp.where(idx < n_blocks, new_state, state)
+        return state, None
+
+    indices = jnp.arange(blocks.shape[0], dtype=jnp.uint32)
+    final, _ = jax.lax.scan(step, jnp.asarray(_H0), (indices, blocks))
+    return final  # [8] uint32, big-endian words
+
+
+@functools.partial(jax.jit, static_argnames=())
+def sha256_batch_kernel(blocks: jnp.ndarray, n_blocks: jnp.ndarray) -> jnp.ndarray:
+    """Digest a batch: blocks [B, L, 16] uint32, n_blocks [B] uint32 ->
+    [B, 8] uint32 digests.  One compiled variant per (B, L) bucket shape."""
+    return jax.vmap(_sha256_padded)(blocks, n_blocks)
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing: bytes -> padded uint32 block arrays.
+# ---------------------------------------------------------------------------
+
+
+def pad_message(message: bytes) -> np.ndarray:
+    """SHA-256 padding: message || 0x80 || zeros || 64-bit bit length,
+    as an [n_blocks, 16] uint32 (big-endian words) array."""
+    length = len(message)
+    n_blocks = (length + 8) // 64 + 1
+    buf = np.zeros(n_blocks * 64, dtype=np.uint8)
+    buf[:length] = np.frombuffer(message, dtype=np.uint8)
+    buf[length] = 0x80
+    bit_len = length * 8
+    buf[-8:] = np.frombuffer(bit_len.to_bytes(8, "big"), dtype=np.uint8)
+    return buf.view(">u4").astype(np.uint32).reshape(n_blocks, 16)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def digests_from_words(words: np.ndarray) -> List[bytes]:
+    """[B, 8] uint32 -> list of 32-byte digests."""
+    be = words.astype(">u4")
+    return [be[i].tobytes() for i in range(be.shape[0])]
+
+
+class TpuHasher:
+    """Batched SHA-256 ``processor.Hasher`` backed by the JAX kernel.
+
+    ``hash_batches`` groups the iteration's messages into (block-bucket,
+    batch-bucket) shaped dispatches and returns digests in input order —
+    determinism is by construction, independent of device timing.
+
+    ``min_device_batch``: below this many messages the hashlib path is used —
+    dispatch overhead dominates for tiny batches (the testengine's default
+    traffic) while large batches (the throughput path) go to the device.
+    """
+
+    def __init__(self, min_device_batch: int = 32, max_block_bucket: int = 1 << 14):
+        self.min_device_batch = min_device_batch
+        self.max_block_bucket = max_block_bucket
+        self._cpu = None
+
+    def _hash_cpu(self, batches: Sequence[Sequence[bytes]]) -> List[bytes]:
+        if self._cpu is None:
+            from .cpu import CpuHasher
+
+            self._cpu = CpuHasher()
+        return self._cpu.hash_batches(batches)
+
+    def hash_batches(self, batches: Sequence[Sequence[bytes]]) -> List[bytes]:
+        if len(batches) < self.min_device_batch:
+            return self._hash_cpu(batches)
+
+        messages = [b"".join(parts) for parts in batches]
+        padded = [pad_message(m) for m in messages]
+
+        # Group indices by power-of-two block bucket.
+        groups = {}
+        for i, blocks in enumerate(padded):
+            bucket = _next_pow2(blocks.shape[0])
+            if bucket > self.max_block_bucket:
+                # Degenerate huge message: hash on CPU rather than ship an
+                # outsized one-off shape to the device.
+                groups.setdefault("cpu", []).append(i)
+            else:
+                groups.setdefault(bucket, []).append(i)
+
+        out: List[Optional[bytes]] = [None] * len(messages)
+        for bucket, indices in sorted(
+            groups.items(), key=lambda kv: (kv[0] == "cpu", kv[0] if kv[0] != "cpu" else 0)
+        ):
+            if bucket == "cpu":
+                cpu_digests = self._hash_cpu([batches[i] for i in indices])
+                for i, d in zip(indices, cpu_digests):
+                    out[i] = d
+                continue
+            batch_size = _next_pow2(len(indices))
+            blocks = np.zeros((batch_size, bucket, 16), dtype=np.uint32)
+            n_blocks = np.zeros(batch_size, dtype=np.uint32)
+            for row, i in enumerate(indices):
+                nb = padded[i].shape[0]
+                blocks[row, :nb] = padded[i]
+                n_blocks[row] = nb
+            words = np.asarray(sha256_batch_kernel(blocks, n_blocks))
+            digests = digests_from_words(words[: len(indices)])
+            for i, d in zip(indices, digests):
+                out[i] = d
+        return out  # type: ignore[return-value]

@@ -12,7 +12,7 @@ import threading
 from typing import Dict, List, Optional
 
 from .. import state as st
-from ..messages import ClientState, NetworkState, RequestAck
+from ..messages import ClientState, RequestAck
 from ..statemachine.actions import Actions, Events
 from .interfaces import Hasher, RequestStore
 

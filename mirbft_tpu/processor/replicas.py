@@ -13,7 +13,7 @@ not yet implemented).
 
 from __future__ import annotations
 
-from typing import Dict, get_type_hints
+from typing import Dict
 
 from ..messages import (
     AckMsg,

@@ -10,7 +10,7 @@ Ordering guarantees preserved from the reference:
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 from .. import state as st
 from ..messages import CEntry, EpochConfig, FEntry, NetworkState, Persistent

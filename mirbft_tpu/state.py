@@ -16,7 +16,7 @@ SHA-256 dispatch on device (``mirbft_tpu.ops``).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Tuple, Union
+from typing import Tuple, Union
 
 from .messages import (
     ClientState,

@@ -13,7 +13,7 @@ the TPU batcher.
 from __future__ import annotations
 
 import enum
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, Optional, Set, Tuple
 
 from .. import state as st
 from ..messages import (

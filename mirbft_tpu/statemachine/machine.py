@@ -31,8 +31,6 @@ from ..messages import (
     ForwardBatch,
     ForwardRequest,
     Msg,
-    NetworkConfig,
-    NetworkState,
     NewEpoch,
     NewEpochEcho,
     NewEpochReady,

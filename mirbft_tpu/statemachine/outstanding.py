@@ -12,7 +12,7 @@ requests (stored + correct) to sequences waiting on them
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Set, TYPE_CHECKING
+from typing import Dict, List, Set, TYPE_CHECKING
 
 from ..messages import ClientState, NetworkState, RequestAck
 from .actions import Actions

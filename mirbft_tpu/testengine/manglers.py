@@ -14,7 +14,6 @@ Filters apply first-to-last; order matters (reference manglers.go:26-34).
 from __future__ import annotations
 
 import copy
-import dataclasses
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence, Type
 

@@ -12,7 +12,7 @@ client's requests commit on every node.
 from __future__ import annotations
 
 import hashlib
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import processor as proc
@@ -32,7 +32,7 @@ from ..ops import CpuHasher
 from ..state import Event, EventInitialParameters
 from ..statemachine.actions import Actions, Events
 from ..statemachine.machine import StateMachine
-from .queue import EventQueue, SimEvent
+from .queue import EventQueue
 
 
 def _u64(value: int) -> bytes:

@@ -1,0 +1,136 @@
+"""mircat — event-log viewer and deterministic replayer.
+
+Rebuild of reference ``cmd/mircat``: reads a recorded event log, filters by
+node / event type / step message type, and in ``--interactive`` mode replays
+each event through a fresh state machine per node, printing the resulting
+actions, optional per-index status snapshots, and per-node replay wall time
+(reference main.go:172-227, 429-446).
+
+Usage:
+    python -m mirbft_tpu.tools.mircat LOG.gz [--node N ...]
+        [--event-type TYPE ...] [--step-type TYPE ...]
+        [--interactive] [--status-index IDX ...] [--verbose-text]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Set
+
+from .. import state as st
+from .. import status as status_mod
+from ..eventlog import read_event_log
+from ..statemachine.machine import StateMachine
+from .textmarshal import compact_text
+
+_EVENT_TYPE_NAMES = {
+    "Initialize": st.EventInitialParameters,
+    "LoadPersistedEntry": st.EventLoadPersistedEntry,
+    "CompleteInitialization": st.EventLoadCompleted,
+    "HashResult": st.EventHashResult,
+    "CheckpointResult": st.EventCheckpointResult,
+    "RequestPersisted": st.EventRequestPersisted,
+    "StateTransferComplete": st.EventStateTransferComplete,
+    "StateTransferFailed": st.EventStateTransferFailed,
+    "Step": st.EventStep,
+    "TickElapsed": st.EventTickElapsed,
+    "ActionsReceived": st.EventActionsReceived,
+}
+
+
+def _parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="mircat", description="mirbft_tpu event-log viewer/replayer"
+    )
+    parser.add_argument("log", help="gzip event log file")
+    parser.add_argument(
+        "--node", type=int, action="append", help="only events for these node ids"
+    )
+    parser.add_argument(
+        "--event-type",
+        action="append",
+        choices=sorted(_EVENT_TYPE_NAMES),
+        help="only these event types",
+    )
+    parser.add_argument(
+        "--step-type",
+        action="append",
+        help="only Step events whose message type matches (e.g. Preprepare)",
+    )
+    parser.add_argument(
+        "--interactive",
+        action="store_true",
+        help="replay events through fresh state machines, printing actions",
+    )
+    parser.add_argument(
+        "--status-index",
+        type=int,
+        action="append",
+        help="print the node's status snapshot after this event index",
+    )
+    parser.add_argument(
+        "--verbose-text",
+        action="store_true",
+        help="print full event structures instead of compact text",
+    )
+    return parser.parse_args(argv)
+
+
+def _matches(record: st.RecordedEvent, args: argparse.Namespace) -> bool:
+    if args.node and record.node_id not in args.node:
+        return False
+    if args.event_type:
+        wanted = tuple(_EVENT_TYPE_NAMES[name] for name in args.event_type)
+        if not isinstance(record.state_event, wanted):
+            return False
+    if args.step_type:
+        if not isinstance(record.state_event, st.EventStep):
+            return False
+        if type(record.state_event.msg).__name__ not in args.step_type:
+            return False
+    return True
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parse_args(argv)
+
+    machines: Dict[int, StateMachine] = defaultdict(StateMachine)
+    replay_time: Dict[int, float] = defaultdict(float)
+    status_indexes: Set[int] = set(args.status_index or [])
+
+    with open(args.log, "rb") as f:
+        for index, record in enumerate(read_event_log(f)):
+            shown = _matches(record, args)
+            if shown:
+                text = (
+                    repr(record.state_event)
+                    if args.verbose_text
+                    else compact_text(record.state_event)
+                )
+                print(f"[{index}] node={record.node_id} time={record.time} {text}")
+
+            if args.interactive:
+                sm = machines[record.node_id]
+                start = time.perf_counter()
+                actions = sm.apply_event(record.state_event)
+                replay_time[record.node_id] += time.perf_counter() - start
+                if shown:
+                    for action in actions:
+                        print(f"        -> {compact_text(action)}")
+                if index in status_indexes:
+                    print(status_mod.snapshot(sm).pretty())
+
+    if args.interactive:
+        for node_id in sorted(replay_time):
+            print(
+                f"node {node_id} replay time: "
+                f"{replay_time[node_id] * 1000:.1f} ms"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

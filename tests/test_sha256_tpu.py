@@ -1,0 +1,91 @@
+"""Numerical-equality tests: JAX SHA-256 kernel vs hashlib (SURVEY.md §7
+stage 9 gate)."""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from mirbft_tpu.ops.sha256 import (
+    TpuHasher,
+    digests_from_words,
+    pad_message,
+    sha256_batch_kernel,
+)
+
+
+def ref_digest(parts):
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p)
+    return h.digest()
+
+
+@pytest.mark.parametrize(
+    "message",
+    [
+        b"",
+        b"abc",
+        b"a" * 55,   # exactly fits one block with padding
+        b"a" * 56,   # forces a second block
+        b"a" * 64,
+        b"a" * 119,
+        b"a" * 120,
+        b"a" * 1000,
+    ],
+    ids=lambda m: f"len{len(m)}",
+)
+def test_kernel_matches_hashlib_single(message):
+    blocks = pad_message(message)
+    batch = blocks[None, ...]
+    n = np.array([blocks.shape[0]], dtype=np.uint32)
+    words = np.asarray(sha256_batch_kernel(batch, n))
+    assert digests_from_words(words)[0] == hashlib.sha256(message).digest()
+
+
+def test_kernel_masks_padding_rows():
+    """Extra rows and extra blocks beyond n_blocks must not affect digests."""
+    m1, m2 = b"hello", b"x" * 200
+    b1, b2 = pad_message(m1), pad_message(m2)
+    L = max(b1.shape[0], b2.shape[0]) + 2  # deliberately oversized
+    batch = np.zeros((4, L, 16), dtype=np.uint32)
+    batch[0, : b1.shape[0]] = b1
+    batch[1, : b2.shape[0]] = b2
+    batch[2] = 0xFFFFFFFF  # garbage row, n_blocks=0
+    n = np.array([b1.shape[0], b2.shape[0], 0, 0], dtype=np.uint32)
+    words = np.asarray(sha256_batch_kernel(batch, n))
+    digests = digests_from_words(words)
+    assert digests[0] == hashlib.sha256(m1).digest()
+    assert digests[1] == hashlib.sha256(m2).digest()
+
+
+def test_hasher_randomized_equality():
+    rng = random.Random(42)
+    batches = []
+    for _ in range(100):
+        parts = [
+            rng.randbytes(rng.randint(0, 200))
+            for _ in range(rng.randint(1, 5))
+        ]
+        batches.append(parts)
+    hasher = TpuHasher(min_device_batch=1)
+    assert hasher.hash_batches(batches) == [ref_digest(b) for b in batches]
+
+
+def test_hasher_mixed_length_buckets():
+    hasher = TpuHasher(min_device_batch=1)
+    batches = [[b"a" * n] for n in (0, 1, 55, 56, 64, 119, 500, 5000, 3)]
+    assert hasher.hash_batches(batches) == [ref_digest(b) for b in batches]
+
+
+def test_hasher_small_batch_uses_cpu_path():
+    hasher = TpuHasher(min_device_batch=32)
+    batches = [[b"tiny"]]
+    assert hasher.hash_batches(batches) == [ref_digest(b) for b in batches]
+
+
+def test_hasher_giant_message_falls_back():
+    hasher = TpuHasher(min_device_batch=1, max_block_bucket=4)
+    batches = [[b"q" * 10_000], [b"small"]]
+    assert hasher.hash_batches(batches) == [ref_digest(b) for b in batches]
