@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Benchmark: committed request throughput of the in-process testengine.
+
+Runs the BASELINE.json-style configuration family (N-replica in-process
+testengine, SHA-256 hashing, batched ordering) and reports cluster-wide
+committed requests per wall-clock second, plus a TPU hash-dispatch measurement
+of the crypto hot path.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "req/s", "vs_baseline": N/100000}
+(vs_baseline is against the driver-set target of 100k committed req/s.)
+"""
+
+import json
+import sys
+import time
+
+BASELINE_REQ_PER_S = 100_000
+
+
+def bench_commit_throughput(node_count=4, client_count=4, reqs_per_client=500,
+                            batch_size=100):
+    from mirbft_tpu.testengine import Spec
+
+    spec = Spec(
+        node_count=node_count,
+        client_count=client_count,
+        reqs_per_client=reqs_per_client,
+        batch_size=batch_size,
+    )
+    recording = spec.recorder().recording()
+    total_reqs = client_count * reqs_per_client
+    start = time.perf_counter()
+    steps = recording.drain_clients(timeout=100_000_000)
+    elapsed = time.perf_counter() - start
+    # safety check: all nodes at the same checkpoint agree
+    by_seq = {}
+    for node in recording.nodes:
+        by_seq.setdefault(node.state.checkpoint_seq_no, set()).add(
+            node.state.checkpoint_hash
+        )
+    assert all(len(h) == 1 for h in by_seq.values()), "divergent state"
+    return total_reqs / elapsed, steps, elapsed
+
+
+def bench_tpu_hash_dispatch(batch=4096, msg_len=640):
+    """Wall time of one batched SHA-256 dispatch on the device (the unit of
+    work the processor offloads per iteration)."""
+    import numpy as np
+
+    from mirbft_tpu.ops.sha256 import pad_message, sha256_batch_kernel
+
+    rng = np.random.default_rng(0)
+    blocks_list = [
+        pad_message(rng.integers(0, 256, size=msg_len, dtype=np.uint8).tobytes())
+        for _ in range(batch)
+    ]
+    max_blocks = 16
+    blocks = np.zeros((batch, max_blocks, 16), dtype=np.uint32)
+    n_blocks = np.zeros(batch, dtype=np.uint32)
+    for i, padded in enumerate(blocks_list):
+        blocks[i, : padded.shape[0]] = padded
+        n_blocks[i] = padded.shape[0]
+
+    import jax
+
+    jb, jn = jax.device_put(blocks), jax.device_put(n_blocks)
+    np.asarray(sha256_batch_kernel(jb, jn))  # compile + warm
+    best = float("inf")
+    for _ in range(5):
+        start = time.perf_counter()
+        # Materialize on host: on tunneled platforms block_until_ready alone
+        # does not reliably wait, so the measurement includes D2H of the
+        # 32-byte digests — which the real processor pipeline pays anyway.
+        np.asarray(sha256_batch_kernel(jb, jn))
+        best = min(best, time.perf_counter() - start)
+    return batch / best
+
+
+def main():
+    req_per_s, steps, elapsed = bench_commit_throughput()
+    try:
+        hashes_per_s = bench_tpu_hash_dispatch()
+    except Exception:
+        hashes_per_s = None
+
+    result = {
+        "metric": "committed req/s (4-node testengine, batch=100)",
+        "value": round(req_per_s, 1),
+        "unit": "req/s",
+        "vs_baseline": round(req_per_s / BASELINE_REQ_PER_S, 4),
+        "detail": {
+            "sim_steps": steps,
+            "wall_s": round(elapsed, 2),
+            "tpu_hashes_per_s": round(hashes_per_s, 1) if hashes_per_s else None,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

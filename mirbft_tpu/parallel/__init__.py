@@ -1,0 +1,17 @@
+"""Multi-chip sharding of the crypto workload.
+
+Consensus messages are small and point-to-point (they ride the ``Link``
+abstraction over DCN); what scales with replica count and load is the crypto
+batch — digests and signature verifications.  This package shards that batch
+dimension over a ``jax.sharding.Mesh`` so one hash/verify dispatch spans all
+local chips, with XLA collectives (psum) aggregating verification verdicts
+over ICI.
+"""
+
+from .mesh import (
+    distributed_verify_step,
+    make_mesh,
+    sharded_sha256,
+)
+
+__all__ = ["distributed_verify_step", "make_mesh", "sharded_sha256"]

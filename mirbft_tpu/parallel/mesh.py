@@ -1,0 +1,97 @@
+"""Device-mesh sharding of the batched crypto kernels.
+
+The design follows the standard JAX recipe: pick a mesh, annotate shardings,
+let XLA insert the collectives.  The crypto batch is pure data parallelism —
+each row (message) is independent — so the batch dimension shards over the
+``"batch"`` axis and digests come back sharded the same way.  The
+distributed verify step adds the one genuine collective of the workload: a
+``psum`` over per-shard verification verdicts, so every chip learns the
+global "all batches verified" outcome without the host gathering digests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.sha256 import _H0, _compress_block
+
+BATCH_AXIS = "batch"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D mesh over the local devices; the crypto batch shards across it."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (BATCH_AXIS,))
+
+
+def _sha256_rows(blocks: jnp.ndarray, n_blocks: jnp.ndarray) -> jnp.ndarray:
+    """Local (per-shard) batched SHA-256: [b, L, 16] x [b] -> [b, 8]."""
+
+    def one(row_blocks, row_n):
+        def step(state, idx_block):
+            idx, block = idx_block
+            new_state = _compress_block(state, block)
+            return jnp.where(idx < row_n, new_state, state), None
+
+        indices = jnp.arange(row_blocks.shape[0], dtype=jnp.uint32)
+        final, _ = jax.lax.scan(step, jnp.asarray(_H0), (indices, row_blocks))
+        return final
+
+    return jax.vmap(one)(blocks, n_blocks)
+
+
+def sharded_sha256(mesh: Mesh):
+    """A jitted batched-SHA-256 whose batch dimension is sharded over the
+    mesh.  Inputs [B, L, 16] / [B]; B must divide by the mesh size."""
+    spec = P(BATCH_AXIS)
+    return jax.jit(
+        _sha256_rows,
+        in_shardings=(
+            NamedSharding(mesh, P(BATCH_AXIS, None, None)),
+            NamedSharding(mesh, spec),
+        ),
+        out_shardings=NamedSharding(mesh, P(BATCH_AXIS, None)),
+    )
+
+
+def distributed_verify_step(mesh: Mesh):
+    """The full distributed crypto step: hash every (padded) message shard-
+    locally, compare against expected digests, and ``psum`` the mismatch
+    count over ICI so every chip holds the global verdict.
+
+    This is the multi-chip shape of the epoch-change / forwarded-batch
+    verification flow (``VerifyBatchOrigin``): digests stay on-device; only
+    the 1-word verdict is exchanged.
+    """
+
+    def step(blocks, n_blocks, expected_words):
+        # blocks [b, L, 16], n_blocks [b], expected_words [b, 8] (per shard)
+        digests = _sha256_rows(blocks, n_blocks)
+        mismatches = jnp.sum(
+            jnp.any(digests != expected_words, axis=-1).astype(jnp.uint32)
+        )
+        total_mismatches = jax.lax.psum(mismatches, BATCH_AXIS)
+        return digests, total_mismatches
+
+    mapped = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(BATCH_AXIS, None, None), P(BATCH_AXIS), P(BATCH_AXIS, None)),
+        out_specs=(P(BATCH_AXIS, None), P()),
+        # The SHA-256 scan carries start from unvarying constants (_H0);
+        # varying-manual-axis checking would require pvary-ing every carry.
+        check_vma=False,
+    )
+    return jax.jit(mapped)

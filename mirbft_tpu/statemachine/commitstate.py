@@ -49,15 +49,36 @@ class CommittingClient:
         if req_no < self.last_state.low_watermark:
             return
         offset = req_no - self.last_state.low_watermark
-        self.committed_since_last_checkpoint[offset] = seq_no
+        committed = self.committed_since_last_checkpoint
+        if offset >= len(committed):
+            # Auto-grow up to the window bound.  The reference's fixed-width
+            # slice panics here when a large batch commits a client's entire
+            # remaining window within one checkpoint interval
+            # (commitstate.go:292-298); the window invariant is the real
+            # bound, not the slice length.
+            if offset >= self.last_state.width:
+                raise AssertionError(
+                    f"commit for req_no {req_no} beyond client window "
+                    f"[{self.last_state.low_watermark}, "
+                    f"{self.last_state.low_watermark + self.last_state.width - 1}]"
+                )
+            committed.extend([None] * (offset + 1 - len(committed)))
+        committed[offset] = seq_no
 
     def create_checkpoint_state(self) -> ClientState:
         """Roll the client window forward at a checkpoint boundary
         (reference commitstate.go:302-366)."""
         old = self.last_state
+        committed = self.committed_since_last_checkpoint
         first_uncommitted: Optional[int] = None
         last_committed: Optional[int] = None
-        for i, seq in enumerate(self.committed_since_last_checkpoint):
+        # Scan the FULL window [lw, lw+width-1]: the tracking list may be
+        # shorter than the window (it shrinks as checkpoints consume it, and
+        # grows on demand); slots beyond it are uncommitted.  The reference
+        # scans only its slice, wrongly concluding "all committed" when a
+        # client stops submitting mid-window (commitstate.go:306-315).
+        for i in range(old.width):
+            seq = committed[i] if i < len(committed) else None
             req_no = old.low_watermark + i
             if seq is not None:
                 last_committed = req_no
@@ -76,34 +97,22 @@ class CommittingClient:
             return new_state
 
         if first_uncommitted is None:
-            high_watermark = (
-                old.low_watermark
-                + old.width
-                - old.width_consumed_last_checkpoint
-                - 1
-            )
-            if last_committed != high_watermark:
-                raise AssertionError(
-                    "if no client reqs are uncommitted, all through the high "
-                    f"watermark should be committed: {last_committed} != "
-                    f"{high_watermark}"
-                )
-            self.committed_since_last_checkpoint = []
-            new_state = ClientState(
-                id=old.id,
-                width=old.width,
-                width_consumed_last_checkpoint=old.width,
-                low_watermark=last_committed + 1,
-                committed_mask=b"",
-            )
-            self.last_state = new_state
-            return new_state
+            # Whole window committed: the generic roll below handles it with
+            # first_uncommitted one past the end.  (The reference special-
+            # cases this with an assertion that mis-fires when the last
+            # checkpoint's consumed slots commit within a later interval,
+            # commitstate.go:306-315.)
+            first_uncommitted = last_committed + 1
 
         width_consumed = first_uncommitted - old.low_watermark
+        # Shift out the consumed prefix and cap at the window width — the
+        # scan above only ever reads `width` slots, and the reference's
+        # uncapped reshaping (old[c:] + width-c fresh slots) grows without
+        # bound for a slow client (commitstate.go:334-336).
         self.committed_since_last_checkpoint = (
             self.committed_since_last_checkpoint[width_consumed:]
-            + [None] * (old.width - width_consumed)
-        )
+            + [None] * old.width
+        )[: old.width]
 
         mask_bytes = b""
         if last_committed != first_uncommitted:

@@ -283,15 +283,20 @@ class Client:
         actions = Actions()
         old_req_nos = self.req_nos
 
+        # Window is exactly `width` slots, [lw, lw+width-1]; the portion
+        # usable before the next checkpoint excludes what the previous
+        # checkpoint consumed.  (The reference exposes one extra slot here —
+        # see stateless.is_committed docstring.)
         intermediate_high = (
             client_state.low_watermark
             + client_state.width
             - client_state.width_consumed_last_checkpoint
+            - 1
         )
         self.network_config = network_config
         self.client_state = client_state
         self.high_watermark = (
-            client_state.low_watermark + client_state.width
+            client_state.low_watermark + client_state.width - 1
             if not reconfiguring
             else intermediate_high
         )
@@ -329,7 +334,10 @@ class Client:
         """Roll the window forward after a checkpoint (reference :745-804)."""
         actions = Actions()
         intermediate_high = (
-            state.low_watermark + state.width - state.width_consumed_last_checkpoint
+            state.low_watermark
+            + state.width
+            - state.width_consumed_last_checkpoint
+            - 1
         )
         if intermediate_high != self.high_watermark:
             raise AssertionError(
@@ -337,7 +345,9 @@ class Client:
                 f"watermark for client {state.id}"
             )
         new_high = (
-            state.low_watermark + state.width if not reconfiguring else intermediate_high
+            state.low_watermark + state.width - 1
+            if not reconfiguring
+            else intermediate_high
         )
 
         if state.low_watermark > self.next_ready_mark:

@@ -96,10 +96,15 @@ class Bitmask:
 
 
 def is_committed(req_no: int, client_state: ClientState) -> bool:
-    """Reference stateless.go:18-30."""
+    """Reference stateless.go:18-30, with the window bound made exclusive:
+    the client window is exactly ``width`` slots [lw, lw+width-1].  The
+    reference exposes width+1 slots here (``> lw+width``) while its
+    committing-client bookkeeping tracks width slots, which overflows its
+    fixed slice and trips its full-window assertions once a large batch
+    commits an entire client window within one checkpoint interval."""
     if req_no < client_state.low_watermark:
         return True
-    if req_no > client_state.low_watermark + client_state.width:
+    if req_no >= client_state.low_watermark + client_state.width:
         return False
     offset = req_no - client_state.low_watermark
     return Bitmask(client_state.committed_mask).is_bit_set(offset)

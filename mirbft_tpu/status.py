@@ -426,7 +426,7 @@ def pretty(s: StateMachineStatus) -> str:
         for mb in nb.msg_buffers:
             w(
                 f"  -  Bytes={mb.size:<8d} Messages={mb.msgs:<5d} "
-                f"Component={mb.component}"
+                f"Component={mb.component}\n"
             )
     w("\n\nDone\n")
     return buf.getvalue()

@@ -1,11 +1,12 @@
 """Test configuration: force an 8-device virtual CPU mesh so multi-chip
-sharding paths compile and execute without TPU hardware."""
+sharding paths compile and execute without TPU hardware.
 
-import os
+This machine's interpreter boot (sitecustomize) registers a TPU PJRT plugin
+and pins JAX_PLATFORMS before any test code runs, so env vars alone are too
+late — the jax config must be overridden before backends initialize.
+"""
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
