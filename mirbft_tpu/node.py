@@ -1,0 +1,318 @@
+"""L3 node runtime: the concurrent production event loop.
+
+Rebuild of reference ``mirbft.go``: one worker thread per work category
+(WAL / client / hash / net / app / reqstore / state-machine) connected to a
+central coordinator that owns the ``WorkItems`` router — the same
+one-in-flight-batch-per-category scheduling the deterministic test engine
+replicates single-threadedly.  The hash worker is the TPU dispatch path:
+batches leave the coordinator, run on device, and return as events without
+ever blocking the event loop.
+
+Concurrency translation (Go → Python): channels/select become per-worker
+handoff queues plus one coordinator inbox; the ``workErrNotifier`` failure
+latch becomes an event + status snapshot.  Backpressure is preserved: a
+category with a batch in flight accumulates further work in ``WorkItems``
+until its worker returns.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import processor as proc
+from . import status as status_mod
+from .config import Config
+from .messages import Msg, NetworkState
+from .statemachine.actions import Actions, Events
+from .statemachine.machine import StateMachine
+
+
+class StoppedError(RuntimeError):
+    """Raised when the node was stopped at the caller's request."""
+
+
+@dataclass
+class ProcessorConfig:
+    """Pluggable processor backends (reference mirbft.go:407-414)."""
+
+    link: proc.Link
+    hasher: proc.Hasher
+    app: proc.App
+    wal: proc.WAL
+    request_store: proc.RequestStore
+    interceptor: Optional[proc.EventInterceptor] = None
+
+
+class _WorkErrNotifier:
+    """Failure latch shared by the workers (reference mirbft.go:572-624)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._err: Optional[BaseException] = None
+        self.exit_event = threading.Event()
+        self.exit_status_event = threading.Event()
+        self.exit_status = None
+
+    def err(self) -> Optional[BaseException]:
+        with self._lock:
+            return self._err
+
+    def fail(self, err: BaseException) -> None:
+        with self._lock:
+            if self._err is None:
+                self._err = err
+        self.exit_event.set()
+
+    def set_exit_status(self, status) -> None:
+        self.exit_status = status
+        self.exit_status_event.set()
+
+
+class Client:
+    """Thread-safe proposal handle (reference mirbft.go:44-69)."""
+
+    def __init__(self, client: proc.Client, inbox: "queue.Queue", notifier: _WorkErrNotifier):
+        self._client = client
+        self._inbox = inbox
+        self._notifier = notifier
+
+    def next_req_no(self) -> int:
+        return self._client.next_req_no_value()
+
+    def propose(self, req_no: int, data: bytes) -> None:
+        events = self._client.propose(req_no, data)
+        if self._notifier.exit_event.is_set():
+            raise self._notifier.err() or StoppedError()
+        if events:
+            self._inbox.put(("client_results", events))
+
+
+class Node:
+    """Reference mirbft.go:75-176."""
+
+    _CATEGORIES: Tuple[Tuple[str, str], ...] = (
+        # (work-items attribute, inbox result tag)
+        ("wal_actions", "wal"),
+        ("net_actions", "net"),
+        ("hash_actions", "hash"),
+        ("client_actions", "client"),
+        ("app_actions", "app"),
+        ("req_store_events", "req_store"),
+        ("result_events", "result"),
+    )
+
+    def __init__(self, node_id: int, config: Config, processor_config: ProcessorConfig):
+        self.id = node_id
+        self.config = config
+        self.processor_config = processor_config
+        self.state_machine = StateMachine(config.logger)
+        self.work_items = proc.WorkItems()
+        self.clients = proc.Clients(
+            processor_config.hasher, processor_config.request_store
+        )
+        self.replicas = proc.Replicas()
+        self.notifier = _WorkErrNotifier()
+        # Coordinator inbox: tagged results/ingress/control messages.
+        self.inbox: "queue.Queue" = queue.Queue()
+        # One handoff slot per category worker.
+        self._work_queues: Dict[str, "queue.Queue"] = {
+            tag: queue.Queue(maxsize=1) for _, tag in self._CATEGORIES
+        }
+        self._pending: Dict[str, bool] = {tag: False for _, tag in self._CATEGORIES}
+        self._threads: List[threading.Thread] = []
+        self._tick_thread: Optional[threading.Thread] = None
+        self._started = False
+
+    # --- boot (reference mirbft.go:436-464) ---
+
+    def process_as_new_node(
+        self,
+        initial_network_state: NetworkState,
+        initial_checkpoint_value: bytes,
+        tick_interval: Optional[float] = None,
+    ) -> None:
+        """Seed a fresh WAL with genesis entries and start processing."""
+        events = proc.initialize_wal_for_new_node(
+            self.processor_config.wal,
+            self.config.initial_parameters(),
+            initial_network_state,
+            initial_checkpoint_value,
+        )
+        self.work_items.result_events.concat(events)
+        self._start(tick_interval)
+
+    def restart_processing(self, tick_interval: Optional[float] = None) -> None:
+        """Replay the existing WAL and resume processing."""
+        events = proc.recover_wal_for_existing_node(
+            self.processor_config.wal, self.config.initial_parameters()
+        )
+        self.work_items.result_events.concat(events)
+        self._start(tick_interval)
+
+    # --- ingress (reference mirbft.go:205-229) ---
+
+    def step(self, source: int, msg: Msg) -> None:
+        """Validated network ingress; thread-safe."""
+        events = self.replicas.replica(source).step(msg)
+        if self.notifier.exit_event.is_set():
+            raise self.notifier.err() or StoppedError()
+        if events:
+            self.inbox.put(("step_events", events))
+
+    def client(self, client_id: int) -> Client:
+        return Client(self.clients.client(client_id), self.inbox, self.notifier)
+
+    def tick(self) -> None:
+        self.inbox.put(("tick", None))
+
+    def status(self, timeout: float = 5.0):
+        """Snapshot of the state machine, taken on the coordinator thread."""
+        if self.notifier.exit_status_event.is_set():
+            return self.notifier.exit_status
+        reply: "queue.Queue" = queue.Queue(maxsize=1)
+        self.inbox.put(("status", reply))
+        try:
+            return reply.get(timeout=timeout)
+        except queue.Empty:
+            if self.notifier.exit_status_event.is_set():
+                return self.notifier.exit_status
+            raise
+
+    def stop(self) -> None:
+        self.notifier.fail(StoppedError())
+        self.inbox.put(("stop", None))
+        for thread in self._threads:
+            thread.join(timeout=5)
+        if not self.notifier.exit_status_event.is_set():
+            self.notifier.set_exit_status(
+                status_mod.snapshot(self.state_machine)
+            )
+
+    # --- workers (reference mirbft.go:231-434) ---
+
+    def _worker(self, tag: str, handler: Callable) -> None:
+        while not self.notifier.exit_event.is_set():
+            try:
+                batch = self._work_queues[tag].get(timeout=0.05)
+            except queue.Empty:
+                continue
+            try:
+                result = handler(batch)
+            except BaseException as e:
+                if tag == "result":
+                    self.notifier.set_exit_status(
+                        status_mod.snapshot(self.state_machine)
+                    )
+                self.notifier.fail(e)
+                return
+            self.inbox.put((f"{tag}_results", result))
+
+    def _handlers(self) -> Dict[str, Callable]:
+        pc = self.processor_config
+        return {
+            "wal": lambda actions: proc.process_wal_actions(pc.wal, actions),
+            "net": lambda actions: proc.process_net_actions(
+                self.id, pc.link, actions
+            ),
+            "hash": lambda actions: proc.process_hash_actions(pc.hasher, actions),
+            "client": lambda actions: self.clients.process_client_actions(actions),
+            "app": lambda actions: proc.process_app_actions(pc.app, actions),
+            "req_store": lambda events: proc.process_reqstore_events(
+                pc.request_store, events
+            ),
+            "result": lambda events: proc.process_state_machine_events(
+                self.state_machine, pc.interceptor, events
+            ),
+        }
+
+    # --- coordinator (reference mirbft.go:465-565) ---
+
+    def _start(self, tick_interval: Optional[float]) -> None:
+        if self._started:
+            raise AssertionError("node already started")
+        self._started = True
+        handlers = self._handlers()
+        for _, tag in self._CATEGORIES:
+            thread = threading.Thread(
+                target=self._worker,
+                args=(tag, handlers[tag]),
+                name=f"node{self.id}-{tag}",
+                daemon=True,
+            )
+            thread.start()
+            self._threads.append(thread)
+
+        coordinator = threading.Thread(
+            target=self._run_coordinator, name=f"node{self.id}-coord", daemon=True
+        )
+        coordinator.start()
+        self._threads.append(coordinator)
+
+        if tick_interval is not None:
+            def ticker():
+                while not self.notifier.exit_event.is_set():
+                    time.sleep(tick_interval)
+                    self.inbox.put(("tick", None))
+
+            self._tick_thread = threading.Thread(
+                target=ticker, name=f"node{self.id}-tick", daemon=True
+            )
+            self._tick_thread.start()
+
+    def _dispatch_ready_work(self) -> None:
+        """Hand any non-empty category with no batch in flight to its worker
+        (the nil-able-channel pattern of the reference select loop)."""
+        work = self.work_items
+        for attr, tag in self._CATEGORIES:
+            batch = getattr(work, attr)
+            if not self._pending[tag] and len(batch) > 0:
+                self._pending[tag] = True
+                setattr(work, attr, type(batch)())
+                self._work_queues[tag].put(batch)
+
+    def _run_coordinator(self) -> None:
+        work = self.work_items
+        add_result = {
+            "wal_results": work.add_wal_results,
+            "net_results": work.add_net_results,
+            "hash_results": work.add_hash_results,
+            "client_results": work.add_client_results,
+            "app_results": work.add_app_results,
+            "req_store_results": work.add_req_store_results,
+            "result_results": work.add_state_machine_results,
+        }
+        waiting_status: List["queue.Queue"] = []
+        try:
+            while not self.notifier.exit_event.is_set():
+                # Status may only be taken while no state-machine batch is in
+                # flight: the result worker mutates the machine off-thread.
+                if waiting_status and not self._pending["result"]:
+                    snap = status_mod.snapshot(self.state_machine)
+                    for reply in waiting_status:
+                        reply.put(snap)
+                    waiting_status.clear()
+                self._dispatch_ready_work()
+                try:
+                    tag, payload = self.inbox.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if tag == "stop":
+                    return
+                if tag == "tick":
+                    work.result_events.tick_elapsed()
+                elif tag == "status":
+                    waiting_status.append(payload)
+                elif tag == "step_events":
+                    work.result_events.concat(payload)
+                elif tag in add_result:
+                    base = tag[: -len("_results")]
+                    add_result[tag](payload)
+                    self._pending[base] = False
+                else:
+                    raise AssertionError(f"unknown inbox tag {tag}")
+        except BaseException as e:
+            self.notifier.fail(e)

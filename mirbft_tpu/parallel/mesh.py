@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.sha256 import _H0, _compress_block
+from ..ops.sha256 import _sha256_padded
 
 BATCH_AXIS = "batch"
 
@@ -38,18 +38,7 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 def _sha256_rows(blocks: jnp.ndarray, n_blocks: jnp.ndarray) -> jnp.ndarray:
     """Local (per-shard) batched SHA-256: [b, L, 16] x [b] -> [b, 8]."""
-
-    def one(row_blocks, row_n):
-        def step(state, idx_block):
-            idx, block = idx_block
-            new_state = _compress_block(state, block)
-            return jnp.where(idx < row_n, new_state, state), None
-
-        indices = jnp.arange(row_blocks.shape[0], dtype=jnp.uint32)
-        final, _ = jax.lax.scan(step, jnp.asarray(_H0), (indices, row_blocks))
-        return final
-
-    return jax.vmap(one)(blocks, n_blocks)
+    return jax.vmap(_sha256_padded)(blocks, n_blocks)
 
 
 def sharded_sha256(mesh: Mesh):

@@ -1,0 +1,239 @@
+"""Concurrent stress test of the L3 node runtime ("as real as possible",
+SURVEY.md §4 tier 4): real threads, durable WAL + request store on tmpdirs,
+a channel transport that drops on overflow; every request must commit
+exactly once per node (reference mirbft_test.go StressyTest)."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from mirbft_tpu.config import Config, standard_initial_network_state
+from mirbft_tpu.messages import QEntry
+from mirbft_tpu.node import Node, ProcessorConfig
+from mirbft_tpu.ops import CpuHasher
+from mirbft_tpu.reqstore import Store
+from mirbft_tpu.simplewal import WAL
+
+
+class FakeTransport:
+    """Buffered per-node delivery queues that drop on overflow
+    (reference mirbft_test.go:62-163)."""
+
+    def __init__(self, node_count: int, buffer: int = 10000):
+        self.queues = [queue.Queue(maxsize=buffer) for _ in range(node_count)]
+        self.nodes = [None] * node_count
+        self._threads = []
+        self._stop = threading.Event()
+
+    def link(self, source: int):
+        transport = self
+
+        class _Link:
+            def send(self, dest: int, msg) -> None:
+                try:
+                    transport.queues[dest].put_nowait((source, msg))
+                except queue.Full:
+                    pass  # drop; consensus tolerates loss
+
+        return _Link()
+
+    def start(self, nodes):
+        self.nodes = nodes
+        for i in range(len(nodes)):
+            thread = threading.Thread(
+                target=self._deliver, args=(i,), daemon=True
+            )
+            thread.start()
+            self._threads.append(thread)
+
+    def _deliver(self, dest: int) -> None:
+        while not self._stop.is_set():
+            try:
+                source, msg = self.queues[dest].get(timeout=0.05)
+            except queue.Empty:
+                continue
+            try:
+                self.nodes[dest].step(source, msg)
+            except Exception:
+                return  # node stopped
+
+    def stop(self):
+        self._stop.set()
+        for thread in self._threads:
+            thread.join(timeout=2)
+
+
+class CountingApp:
+    """Counts commits per (client, req_no); latest network state snapshot."""
+
+    def __init__(self):
+        self.commits = {}
+        self.lock = threading.Lock()
+        self.last_checkpoint = (0, b"")
+
+    def apply(self, entry: QEntry) -> None:
+        with self.lock:
+            for req in entry.requests:
+                key = (req.client_id, req.req_no)
+                self.commits[key] = self.commits.get(key, 0) + 1
+
+    def snap(self, network_config, client_states):
+        import hashlib
+
+        from mirbft_tpu import wire
+        from mirbft_tpu.messages import NetworkState
+
+        state = NetworkState(
+            config=network_config,
+            clients=tuple(client_states),
+            pending_reconfigurations=(),
+        )
+        encoded = wire.encode(state)
+        value = hashlib.sha256(encoded).digest() + encoded
+        return value, ()
+
+    def transfer_to(self, seq_no, snap):
+        from mirbft_tpu import wire
+
+        return wire.decode(snap[32:])
+
+
+@pytest.mark.parametrize("node_count,reqs", [(1, 30), (4, 30)])
+def test_stressy(tmp_path, node_count, reqs):
+    network_state = standard_initial_network_state(node_count, 0)
+    transport = FakeTransport(node_count)
+    nodes = []
+    apps = []
+
+    for i in range(node_count):
+        app = CountingApp()
+        apps.append(app)
+        node = Node(
+            i,
+            Config(id=i, batch_size=1),
+            ProcessorConfig(
+                link=transport.link(i),
+                hasher=CpuHasher(),
+                app=app,
+                wal=WAL(str(tmp_path / f"wal-{i}")),
+                request_store=Store(str(tmp_path / f"reqs-{i}.db")),
+            ),
+        )
+        nodes.append(node)
+
+    transport.start(nodes)
+    for node in nodes:
+        node.process_as_new_node(
+            network_state, b"initial", tick_interval=0.02
+        )
+
+    # propose to every node (all replicas see every request, like the
+    # reference's stress client)
+    def propose_all():
+        for req_no in range(reqs):
+            payload = b"stress-%d" % req_no
+            for node in nodes:
+                for _ in range(100):
+                    try:
+                        node.client(0).propose(req_no, payload)
+                        break
+                    except KeyError:
+                        time.sleep(0.02)  # client window not allocated yet
+
+    proposer = threading.Thread(target=propose_all, daemon=True)
+    proposer.start()
+
+    deadline = time.time() + 60
+    try:
+        while time.time() < deadline:
+            done = all(
+                all(app.commits.get((0, r), 0) >= 1 for r in range(reqs))
+                for app in apps
+            )
+            if done:
+                break
+            for node in nodes:
+                err = node.notifier.err()
+                if err is not None:
+                    pytest.fail(f"node {node.id} failed: {err!r}")
+            time.sleep(0.1)
+        else:
+            status = [
+                {r: app.commits.get((0, r), 0) for r in range(reqs)}
+                for app in apps
+            ]
+            pytest.fail(f"timed out; commit counts: {status}")
+
+        # every request committed exactly once per node
+        for app in apps:
+            for r in range(reqs):
+                assert app.commits.get((0, r)) == 1, (
+                    f"req {r} committed {app.commits.get((0, r))} times"
+                )
+    finally:
+        proposer.join(timeout=5)
+        for node in nodes:
+            node.stop()
+        transport.stop()
+
+
+def test_node_restart_from_durable_wal(tmp_path):
+    """Single node: commit requests, stop, restart from the on-disk WAL, and
+    keep committing (crash-recovery through the real L3/L4 stack)."""
+    network_state = standard_initial_network_state(1, 0)
+    transport = FakeTransport(1)
+
+    def make_node():
+        app = CountingApp()
+        node = Node(
+            0,
+            Config(id=0, batch_size=1),
+            ProcessorConfig(
+                link=transport.link(0),
+                hasher=CpuHasher(),
+                app=app,
+                wal=WAL(str(tmp_path / "wal")),
+                request_store=Store(str(tmp_path / "reqs.db")),
+            ),
+        )
+        return node, app
+
+    node, app = make_node()
+    transport.nodes = [node]
+    transport.start([node])
+    node.process_as_new_node(network_state, b"initial", tick_interval=0.02)
+
+    def wait_commits(app, expect, timeout=30):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(app.commits.get((0, r), 0) >= 1 for r in expect):
+                return
+            time.sleep(0.05)
+        pytest.fail(f"commits missing: {app.commits}")
+
+    def propose_retrying(node, req_no, payload):
+        for _ in range(200):
+            try:
+                node.client(0).propose(req_no, payload)
+                return
+            except KeyError:
+                time.sleep(0.02)  # client window not allocated yet
+        pytest.fail("client window never allocated")
+
+    for req_no in range(5):
+        propose_retrying(node, req_no, b"pre-%d" % req_no)
+    wait_commits(app, range(5))
+    node.stop()
+    node.processor_config.wal.close()
+    node.processor_config.request_store.close()
+
+    node2, app2 = make_node()
+    transport.nodes = [node2]
+    node2.restart_processing(tick_interval=0.02)
+    for req_no in range(5, 10):
+        propose_retrying(node2, req_no, b"post-%d" % req_no)
+    wait_commits(app2, range(5, 10))
+    node2.stop()
+    transport.stop()
