@@ -220,26 +220,21 @@ class CommitState:
 
     def reinitialize(self) -> Actions:
         last_c: Optional[CEntry] = None
-        second_to_last_c: Optional[CEntry] = None
         last_t: Optional[TEntry] = None
         for _, entry in self.persisted.entries:
             if isinstance(entry, CEntry):
-                second_to_last_c, last_c = last_c, entry
+                last_c = entry
             elif isinstance(entry, TEntry):
                 last_t = entry
 
         assert last_c is not None, "log must contain a CEntry"
 
-        if second_to_last_c is None or not (
-            second_to_last_c.network_state.pending_reconfigurations
-        ):
-            self.active_state = last_c.network_state
-            self.low_watermark = last_c.seq_no
-        else:
-            # The newest CEntry's state is post-reconfiguration; restart from
-            # the previous one until the epoch gracefully ends.
-            self.active_state = second_to_last_c.network_state
-            self.low_watermark = second_to_last_c.seq_no
+        # The machine's _complete_pending_reconfiguration guarantees that a
+        # CEntry applying a reconfiguration is followed by an FEntry before
+        # we get here, and log recovery then truncates its predecessors — so
+        # the newest CEntry is always the state to restart from.
+        self.active_state = last_c.network_state
+        self.low_watermark = last_c.seq_no
 
         actions = Actions().state_applied(self.low_watermark, self.active_state)
 
@@ -247,7 +242,9 @@ class CommitState:
         if not self.active_state.pending_reconfigurations:
             self.stop_at_seq_no = last_c.seq_no + 2 * ci
         else:
-            self.stop_at_seq_no = last_c.seq_no + ci
+            # Mid-reconfiguration: ordering halts at the next checkpoint,
+            # which is where the pending reconfiguration will apply.
+            self.stop_at_seq_no = self.low_watermark + ci
 
         self.last_applied_commit = last_c.seq_no
         self.highest_commit = last_c.seq_no
@@ -256,8 +253,8 @@ class CommitState:
         self.checkpoint_pending = False
 
         self.committing_clients = {
-            cs.id: CommittingClient(last_c.seq_no, cs)
-            for cs in last_c.network_state.clients
+            cs.id: CommittingClient(self.low_watermark, cs)
+            for cs in self.active_state.clients
         }
 
         if last_t is None or last_c.seq_no >= last_t.seq_no:
@@ -292,10 +289,17 @@ class CommitState:
                 f"{self.low_watermark + ci}"
             )
 
-        if not result.network_state.pending_reconfigurations:
+        completing_reconfiguration = bool(
+            self.active_state.pending_reconfigurations
+        )
+        if (
+            not result.network_state.pending_reconfigurations
+            and not completing_reconfiguration
+        ):
             self.stop_at_seq_no = result.seq_no + 2 * ci
-        # else: reconfiguration pending — do not extend the stop sequence; the
-        # epoch must end gracefully so the new config activates.
+        # else: a reconfiguration is pending (don't order past the next
+        # checkpoint) or this checkpoint just applied one (the epoch ends
+        # here; the machine reinitializes under the new config).
 
         self.active_state = result.network_state
         self.lower_half_commits = self.upper_half_commits

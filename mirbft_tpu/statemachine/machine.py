@@ -31,6 +31,7 @@ from ..messages import (
     ForwardBatch,
     ForwardRequest,
     Msg,
+    NEntry,
     NewEpoch,
     NewEpochEcho,
     NewEpochReady,
@@ -128,7 +129,8 @@ class StateMachine:
     def _reinitialize(self) -> Actions:
         """Shared by start, state transfer, and reconfiguration
         (reference state_machine.go:272-287)."""
-        actions = self._recover_log()
+        actions = self._complete_pending_reconfiguration()
+        actions.concat(self._recover_log())
         actions.concat(self.commit_state.reinitialize())
         self.client_tracker.reinitialize(self.commit_state.active_state)
         actions.concat(
@@ -139,6 +141,51 @@ class StateMachine:
         self.checkpoint_tracker.reinitialize()
         self.batch_tracker.reinitialize()
         return actions.concat(self.epoch_tracker.reinitialize())
+
+    def _complete_pending_reconfiguration(self) -> Actions:
+        """Close the epoch at a reconfiguration boundary.
+
+        When the checkpoint that APPLIES a pending reconfiguration has been
+        persisted (its predecessor CEntry still carries the pending list) but
+        no FEntry follows it yet, append the FEntry ending the current epoch
+        config.  The subsequent log recovery truncates through the new CEntry
+        and every tracker reinitializes under the post-reconfiguration
+        network state; the next epoch then starts via the graceful
+        epoch-change path.
+
+        The reference never implemented this step (its reconfiguration
+        "does not entirely work", reference README.md:35, and the in-epoch
+        variant dead-ends at epoch_target.go:333's panic); this follows the
+        flow reference docs/LogMovement.md describes.  Running it inside
+        ``_reinitialize`` makes the normal path and the
+        crashed-between-CEntry-and-FEntry recovery path identical.
+        """
+        prev_c = last_c = None
+        last_epoch_config = None
+        f_after_last_c = False
+        for _, entry in self.persisted.entries:
+            if isinstance(entry, CEntry):
+                prev_c, last_c = last_c, entry
+                f_after_last_c = False
+            elif isinstance(entry, FEntry):
+                f_after_last_c = True
+                last_epoch_config = entry.ends_epoch_config
+            elif isinstance(entry, NEntry):
+                last_epoch_config = entry.epoch_config
+        if (
+            last_c is None
+            or prev_c is None
+            or f_after_last_c
+            or not prev_c.network_state.pending_reconfigurations
+        ):
+            return Actions()
+        if last_epoch_config is None:
+            raise AssertionError(
+                "reconfiguration completed with no epoch config in the log"
+            )
+        return self.persisted.add_f_entry(
+            FEntry(ends_epoch_config=last_epoch_config)
+        )
 
     def _recover_log(self) -> Actions:
         """Truncate the WAL through the last CEntry preceding each FEntry
@@ -315,8 +362,17 @@ class StateMachine:
                 "checkpoint results must be exactly one interval after the last"
             )
 
+        completing_reconfiguration = bool(
+            self.commit_state.active_state.pending_reconfigurations
+        )
         prev_stop = self.commit_state.stop_at_seq_no
         actions.concat(self.commit_state.apply_checkpoint_result(result))
+        if completing_reconfiguration and not self.commit_state.transferring:
+            # This checkpoint applied a reconfiguration: the epoch ends here.
+            # _reinitialize appends the FEntry, truncates the log through the
+            # new CEntry, and restarts every tracker under the new network
+            # state (see _complete_pending_reconfiguration).
+            return actions.concat(self._reinitialize())
         if prev_stop < self.commit_state.stop_at_seq_no:
             self.client_tracker.allocate(result.seq_no, result.network_state)
             actions.concat(

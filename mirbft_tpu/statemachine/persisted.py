@@ -96,6 +96,13 @@ class PersistedLog:
     def add_ec_entry(self, entry: ECEntry) -> Actions:
         return self.append(entry)
 
+    def add_f_entry(self, entry: FEntry) -> Actions:
+        """Gracefully end the current epoch (reconfiguration boundary).  The
+        reference only ever seeds an FEntry at genesis; our reconfiguration
+        path appends one when the reconfiguring checkpoint lands, per
+        reference docs/LogMovement.md's intended flow."""
+        return self.append(entry)
+
     def add_t_entry(self, entry: TEntry) -> Actions:
         return self.append(entry)
 

@@ -144,6 +144,9 @@ class NodeState:
         self.checkpoint_hash = b""
         self.checkpoint_state: Optional[NetworkState] = None
         self.state_transfers: List[int] = []  # for test assertions
+        # Highest applied req_no + 1 per client — survives the client's
+        # removal by reconfiguration, unlike the checkpoint state.
+        self.committed_reqs: Dict[int, int] = {}
 
     def snap(self, network_config, client_states):
         pending = tuple(self.pending_reconfigurations)
@@ -191,6 +194,9 @@ class NodeState:
                     "reqstore must have a request we are committing"
                 )
             self.active_hash.update(request.digest)
+            prev = self.committed_reqs.get(request.client_id, 0)
+            if request.req_no + 1 > prev:
+                self.committed_reqs[request.client_id] = request.req_no + 1
             for point in self.reconfig_points:
                 if (
                     point.client_id == request.client_id
@@ -223,6 +229,10 @@ class RuntimeParameters:
 class NodeConfig:
     init_parms: EventInitialParameters
     runtime_parms: RuntimeParameters
+    # Simulated-clock delay before the node first initializes; a large value
+    # models a late-started replica that must state-transfer to catch up
+    # (reference integration_test.go "late-start" scenario).
+    start_delay: int = 0
 
 
 @dataclass
@@ -342,9 +352,11 @@ class Recorder:
                     i, node_config, wal, link, req_store, node_state, interceptor
                 )
             )
-            event_queue.insert_initialize(i, node_config.init_parms, 0)
+            event_queue.insert_initialize(
+                i, node_config.init_parms, node_config.start_delay
+            )
 
-        clients = [SimClient(cc) for cc in self.client_configs]
+        clients = {cc.id: SimClient(cc) for cc in self.client_configs}
         return Recording(event_queue, nodes, clients)
 
 
@@ -370,10 +382,10 @@ class _Interceptor:
 class Recording:
     """Reference recorder.go:472-723."""
 
-    def __init__(self, event_queue: EventQueue, nodes: List[SimNode], clients: List[SimClient]):
+    def __init__(self, event_queue: EventQueue, nodes: List[SimNode], clients: Dict[int, SimClient]):
         self.event_queue = event_queue
         self.nodes = nodes
-        self.clients = clients
+        self.clients = clients  # by client id (ids need not be dense)
 
     def step(self) -> None:
         """Consume one simulation event, replicating the scheduling rules of
@@ -392,16 +404,26 @@ class Recording:
             queue.remove_events_for(node.id)
             node.initialize(event.initialize)
             queue.insert_tick(node.id, parms.tick_interval)
-            for client_state in node.state.checkpoint_state.clients:
-                client = self.clients[client_state.id]
+            # Schedule proposals for every configured client, not just those
+            # in the checkpoint state: a client a pending reconfiguration is
+            # about to add has no window yet, and its proposals spin in the
+            # ClientNotExist retry path until the new config activates.
+            state_clients = {
+                cs.id: cs for cs in node.state.checkpoint_state.clients
+            }
+            for client in self.clients.values():
                 if client.config.should_skip(node.id):
                     continue
-                data = client.request_by_req_no(client_state.low_watermark)
+                client_state = state_clients.get(client.config.id)
+                start_req = (
+                    client_state.low_watermark if client_state is not None else 0
+                )
+                data = client.request_by_req_no(start_req)
                 if data is not None:
                     queue.insert_client_proposal(
                         node.id,
-                        client_state.id,
-                        client_state.low_watermark,
+                        client.config.id,
+                        start_req,
                         data,
                         parms.process_client_latency,
                     )
@@ -521,32 +543,59 @@ class Recording:
     def drain_clients(self, timeout: int) -> int:
         """Run until every client's requests commit on every node
         (reference recorder.go:682-723).  Returns the step count."""
-        target_reqs = {c.config.id: c.config.total for c in self.clients}
+        target_reqs = {
+            c.config.id: c.config.total for c in self.clients.values()
+        }
         count = 0
         while True:
             count += 1
             self.step()
 
+            # Done when (a) every client still in the network state is at its
+            # target watermark on every node, and (b) every configured client's
+            # full request set was applied by at least one node — (b) covers
+            # clients a reconfiguration removed (absent from the checkpoint
+            # state) or has not yet added (never present in it).
             all_done = True
             for node in self.nodes:
                 for client_state in node.state.checkpoint_state.clients:
-                    if target_reqs[client_state.id] != client_state.low_watermark:
+                    # Clients with no simulated driver (e.g. added by a
+                    # reconfiguration the test never proposes for) are skipped.
+                    target = target_reqs.get(client_state.id)
+                    if target is not None and target != client_state.low_watermark:
                         all_done = False
                         break
                 if not all_done:
                     break
             if all_done:
-                return count
+                finished = {
+                    cid
+                    for cid, total in target_reqs.items()
+                    if total == 0
+                    or any(
+                        node.state.committed_reqs.get(cid, 0) >= total
+                        for node in self.nodes
+                    )
+                }
+                if finished >= set(target_reqs):
+                    return count
 
             if count > timeout:
                 details = []
                 for node in self.nodes:
                     for cs in node.state.checkpoint_state.clients:
-                        if target_reqs[cs.id] != cs.low_watermark:
+                        target = target_reqs.get(cs.id)
+                        if target is not None and target != cs.low_watermark:
                             details.append(
                                 f"node{node.id} client {cs.id} at "
-                                f"{cs.low_watermark}/{target_reqs[cs.id]}"
+                                f"{cs.low_watermark}/{target}"
                             )
+                for cid, total in sorted(target_reqs.items()):
+                    if total > 0 and not any(
+                        node.state.committed_reqs.get(cid, 0) >= total
+                        for node in self.nodes
+                    ):
+                        details.append(f"client {cid} never reached its target")
                 raise TimeoutError(
                     f"timed out after {count} steps: {'; '.join(details)}"
                 )
