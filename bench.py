@@ -77,12 +77,61 @@ def bench_tpu_hash_dispatch(batch=4096, msg_len=640):
     return batch / best
 
 
+def bench_tpu_verify_dispatch(batch=1024, n_keys=64, dispatches=5):
+    """Batched Ed25519 verification: throughput and per-dispatch p99 latency
+    (BASELINE config 2: 64 clients, Ed25519-signed requests)."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier
+    from mirbft_tpu.processor.verify import seal, signing_payload
+    from mirbft_tpu.processor.verify import RequestAuthenticator
+
+    auth = RequestAuthenticator(verifier=Ed25519BatchVerifier())
+    keys = []
+    for cid in range(n_keys):
+        key = Ed25519PrivateKey.from_private_bytes(
+            (cid + 1).to_bytes(4, "big") * 8
+        )
+        keys.append(key)
+        auth.register(
+            cid,
+            key.public_key().public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            ),
+        )
+    items = []
+    for i in range(batch):
+        cid = i % n_keys
+        payload = b"bench-request-%d" % i
+        sig = keys[cid].sign(signing_payload(cid, i, payload))
+        items.append((cid, i, seal(payload, sig)))
+
+    warm = auth.authenticate_batch(items)  # compile + warm
+    if not warm.all():
+        raise RuntimeError("verify warm-up dispatch rejected valid signatures")
+    auth.dispatch_seconds.clear()
+    total = 0
+    start = time.perf_counter()
+    for _ in range(dispatches):
+        ok = auth.authenticate_batch(items)
+        total += int(ok.sum())
+    elapsed = time.perf_counter() - start
+    return total / elapsed, auth.p99_dispatch_seconds()
+
+
 def main():
     req_per_s, steps, elapsed = bench_commit_throughput()
     try:
         hashes_per_s = bench_tpu_hash_dispatch()
     except Exception:
         hashes_per_s = None
+    try:
+        sigs_per_s, verify_p99 = bench_tpu_verify_dispatch()
+    except Exception:
+        sigs_per_s, verify_p99 = None, None
 
     result = {
         "metric": "committed req/s (4-node testengine, batch=100)",
@@ -93,6 +142,8 @@ def main():
             "sim_steps": steps,
             "wall_s": round(elapsed, 2),
             "tpu_hashes_per_s": round(hashes_per_s, 1) if hashes_per_s else None,
+            "tpu_sig_verifies_per_s": round(sigs_per_s, 1) if sigs_per_s else None,
+            "sig_verify_p99_ms": round(verify_p99 * 1e3, 2) if verify_p99 else None,
         },
     }
     print(json.dumps(result))

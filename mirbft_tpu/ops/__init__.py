@@ -10,4 +10,18 @@ hashlib-based references used for numerical-equality testing and small runs.
 
 from .cpu import CpuHasher
 
-__all__ = ["CpuHasher"]
+__all__ = ["CpuHasher", "Ed25519BatchVerifier", "TpuHasher"]
+
+
+def __getattr__(name):
+    # Lazy: importing the JAX-backed modules pulls in jax, which small
+    # host-only embedders (and the mircat CLI) should not pay for.
+    if name == "TpuHasher":
+        from .sha256 import TpuHasher
+
+        return TpuHasher
+    if name == "Ed25519BatchVerifier":
+        from .ed25519 import Ed25519BatchVerifier
+
+        return Ed25519BatchVerifier
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,116 @@
+"""Signed-client-request authentication (extended BASELINE configs 2-5).
+
+The reference keeps signatures off the consensus hot path entirely and
+delegates request authentication to the embedder (reference
+``docs/Design.md`` "Network Ingress", ``README.md:7-9``).  This component is
+that embedder-side layer, built TPU-first: replicas verify client signatures
+over (domain || client_id || req_no || payload) in batched device dispatches
+(``ops.ed25519``) before a request may be persisted and acknowledged, so a
+forged proposal can never enter dissemination.
+
+Envelope format (transport-level, not part of the consensused schema): the
+request body carried through the system is ``payload || 64-byte signature``;
+the consensus layers treat it as opaque bytes — digests, batching, ordering
+and the application all see the envelope unchanged, preserving the
+reference's digest-only consensus property.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+DOMAIN = b"mirbft-tpu/req/v1\x00"
+SIGNATURE_LEN = 64
+
+
+def signing_payload(client_id: int, req_no: int, payload: bytes) -> bytes:
+    """The byte string a client signs: domain-separated and position-bound,
+    so a signature cannot be replayed for another client or request number."""
+    return (
+        DOMAIN
+        + client_id.to_bytes(8, "big")
+        + req_no.to_bytes(8, "big")
+        + payload
+    )
+
+
+def seal(payload: bytes, signature: bytes) -> bytes:
+    if len(signature) != SIGNATURE_LEN:
+        raise ValueError("ed25519 signatures are 64 bytes")
+    return payload + signature
+
+
+def unseal(envelope: bytes) -> Optional[Tuple[bytes, bytes]]:
+    """Split an envelope into (payload, signature); None if too short."""
+    if len(envelope) < SIGNATURE_LEN:
+        return None
+    return envelope[:-SIGNATURE_LEN], envelope[-SIGNATURE_LEN:]
+
+
+class RequestAuthenticator:
+    """Batched signature checking against a registered client-key set.
+
+    One instance per replica.  ``authenticate_batch`` verifies a whole
+    iteration's proposals in one device dispatch (or the CPU path for tiny
+    batches) and records per-dispatch wall times for the verify-latency
+    percentile the benchmark reports.
+    """
+
+    def __init__(self, verifier=None):
+        if verifier is None:
+            from ..ops.ed25519 import Ed25519BatchVerifier
+
+            verifier = Ed25519BatchVerifier()
+        self.verifier = verifier
+        self.keys: Dict[int, bytes] = {}
+        self.dispatch_seconds: List[float] = []
+        self.verified_count = 0
+
+    def register(self, client_id: int, public_key: bytes) -> None:
+        if len(public_key) != 32:
+            raise ValueError("ed25519 public keys are 32 bytes")
+        self.keys[client_id] = public_key
+
+    def remove(self, client_id: int) -> None:
+        self.keys.pop(client_id, None)
+
+    def authenticate_batch(
+        self, items: Sequence[Tuple[int, int, bytes]]
+    ) -> np.ndarray:
+        """items: (client_id, req_no, envelope) triples -> bool per item."""
+        if not items:
+            return np.zeros(0, dtype=bool)
+        ok = np.zeros(len(items), dtype=bool)
+        pubs: List[bytes] = []
+        msgs: List[bytes] = []
+        sigs: List[bytes] = []
+        rows: List[int] = []
+        for i, (client_id, req_no, envelope) in enumerate(items):
+            pub = self.keys.get(client_id)
+            parts = unseal(envelope)
+            if pub is None or parts is None:
+                continue
+            payload, signature = parts
+            pubs.append(pub)
+            msgs.append(signing_payload(client_id, req_no, payload))
+            sigs.append(signature)
+            rows.append(i)
+        if rows:
+            start = time.perf_counter()
+            verdicts = self.verifier.verify_batch(pubs, msgs, sigs)
+            self.dispatch_seconds.append(time.perf_counter() - start)
+            self.verified_count += len(rows)
+            for row, verdict in zip(rows, verdicts):
+                ok[row] = bool(verdict)
+        return ok
+
+    def authenticate(self, client_id: int, req_no: int, envelope: bytes) -> bool:
+        return bool(self.authenticate_batch([(client_id, req_no, envelope)])[0])
+
+    def p99_dispatch_seconds(self) -> float:
+        if not self.dispatch_seconds:
+            return 0.0
+        return float(np.percentile(np.array(self.dispatch_seconds), 99))

@@ -238,11 +238,16 @@ class NodeConfig:
 @dataclass
 class ClientConfig:
     """Reference recorder.go:361-385 (its dead ``MaxInFlight`` knob is
-    dropped: proposals are sequential per node in both implementations)."""
+    dropped: proposals are sequential per node in both implementations).
+
+    ``signed`` enables the extended Ed25519-signed-request mode (BASELINE
+    configs 2-5, no reference counterpart): the client signs every request
+    and replicas authenticate before persisting/acking."""
 
     id: int
     total: int
     ignore_nodes: Tuple[int, ...] = ()
+    signed: bool = False
 
     def should_skip(self, node_id: int) -> bool:
         return node_id in self.ignore_nodes
@@ -256,15 +261,50 @@ class ReconfigPoint:
 
 
 class SimClient:
-    """Deterministic request generator (reference recorder.go:246-263)."""
+    """Deterministic request generator (reference recorder.go:246-263).
+    In signed mode each request is sealed with a deterministic per-client
+    Ed25519 key (``processor.verify`` envelope format)."""
 
     def __init__(self, config: ClientConfig):
         self.config = config
+        self._key = None
+        self._sealed: Dict[int, bytes] = {}
+
+    def _signing_key(self):
+        if self._key is None:
+            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                Ed25519PrivateKey,
+            )
+
+            seed = hashlib.sha256(
+                b"mirbft-tpu-sim-client-" + _u64(self.config.id)
+            ).digest()
+            self._key = Ed25519PrivateKey.from_private_bytes(seed)
+        return self._key
+
+    def public_key(self) -> bytes:
+        from cryptography.hazmat.primitives import serialization
+
+        return self._signing_key().public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
 
     def request_by_req_no(self, req_no: int) -> Optional[bytes]:
         if req_no >= self.config.total:
             return None
-        return _u64(self.config.id) + b"-" + _u64(req_no)
+        payload = _u64(self.config.id) + b"-" + _u64(req_no)
+        if not self.config.signed:
+            return payload
+        sealed = self._sealed.get(req_no)
+        if sealed is None:
+            from ..processor.verify import seal, signing_payload
+
+            signature = self._signing_key().sign(
+                signing_payload(self.config.id, req_no, payload)
+            )
+            sealed = seal(payload, signature)
+            self._sealed[req_no] = sealed
+        return sealed
 
 
 # ---------------------------------------------------------------------------
@@ -282,6 +322,7 @@ class SimNode:
         req_store: SimReqStore,
         state: NodeState,
         interceptor=None,
+        authenticator=None,
     ):
         self.id = node_id
         self.config = config
@@ -290,6 +331,7 @@ class SimNode:
         self.req_store = req_store
         self.state = state
         self.interceptor = interceptor
+        self.authenticator = authenticator
         self.hasher = CpuHasher()
         self.work_items: Optional[proc.WorkItems] = None
         self.clients: Optional[proc.Clients] = None
@@ -330,6 +372,13 @@ class Recorder:
     def recording(self) -> "Recording":
         event_queue = EventQueue(seed=self.random_seed, mangler=self.mangler)
 
+        clients = {cc.id: SimClient(cc) for cc in self.client_configs}
+        signed_pubs = {
+            cc.id: clients[cc.id].public_key()
+            for cc in self.client_configs
+            if cc.signed
+        }
+
         nodes = []
         for i, node_config in enumerate(self.node_configs):
             req_store = SimReqStore()
@@ -347,16 +396,30 @@ class Recorder:
                 writer = self.event_log_writer
                 interceptor = _Interceptor(i, event_queue, writer)
 
+            authenticator = None
+            if signed_pubs:
+                from ..processor.verify import RequestAuthenticator
+
+                authenticator = RequestAuthenticator()
+                for client_id, pub in signed_pubs.items():
+                    authenticator.register(client_id, pub)
+
             nodes.append(
                 SimNode(
-                    i, node_config, wal, link, req_store, node_state, interceptor
+                    i,
+                    node_config,
+                    wal,
+                    link,
+                    req_store,
+                    node_state,
+                    interceptor,
+                    authenticator,
                 )
             )
             event_queue.insert_initialize(
                 i, node_config.init_parms, node_config.start_delay
             )
 
-        clients = {cc.id: SimClient(cc) for cc in self.client_configs}
         return Recording(event_queue, nodes, clients)
 
 
@@ -462,6 +525,16 @@ class Recording:
                             parms.process_client_latency,
                         )
                 else:
+                    if sim_client.config.signed and not (
+                        node.authenticator is not None
+                        and node.authenticator.authenticate(
+                            client_id, req_no, data
+                        )
+                    ):
+                        # Forged or corrupt proposal: reject before it can be
+                        # persisted or acked.  The legitimate client's own
+                        # proposal chain is scheduled independently.
+                        return
                     events = client.propose(req_no, data)
                     node.work_items.add_client_results(events)
                     next_data = sim_client.request_by_req_no(req_no + 1)
@@ -613,6 +686,7 @@ class Spec:
     reqs_per_client: int
     batch_size: int = 1
     clients_ignore: Tuple[int, ...] = ()
+    signed_requests: bool = False
     tweak_recorder: Optional[Callable[[Recorder], None]] = None
 
     def recorder(self) -> Recorder:
@@ -640,6 +714,7 @@ class Spec:
                 id=client.id,
                 total=self.reqs_per_client,
                 ignore_nodes=self.clients_ignore,
+                signed=self.signed_requests,
             )
             for client in network_state.clients
         ]

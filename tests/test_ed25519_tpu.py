@@ -1,0 +1,206 @@
+"""Ed25519 batched TPU verification vs known vectors and the cryptography lib.
+
+The reference has no signature path (request authentication is delegated to
+the embedder, reference docs/Design.md "Network Ingress"); this covers the
+extended BASELINE.json configs 2-5 (Ed25519-signed client requests).
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from mirbft_tpu.ops import ed25519 as e
+
+try:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover
+    HAVE_CRYPTOGRAPHY = False
+
+
+# RFC 8032 section 7.1 test vectors (secret key, public key, message, sig).
+RFC_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+def sign_batch(count: int, seed: int = 0):
+    """Deterministic signed batch via the cryptography library."""
+    rng = random.Random(seed)
+    pubs, msgs, sigs = [], [], []
+    for i in range(count):
+        key = Ed25519PrivateKey.from_private_bytes(
+            rng.getrandbits(256).to_bytes(32, "little")
+        )
+        pub = key.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        msg = b"request-%d-" % i + rng.getrandbits(256).to_bytes(32, "big")
+        pubs.append(pub)
+        msgs.append(msg)
+        sigs.append(key.sign(msg))
+    return pubs, msgs, sigs
+
+
+# ---------------------------------------------------------------------------
+# Field arithmetic vs Python ints.
+# ---------------------------------------------------------------------------
+
+
+def test_field_ops_match_python_ints():
+    import jax.numpy as jnp
+
+    rng = random.Random(7)
+    P = e.P
+    for _ in range(25):
+        a = rng.getrandbits(256) % P
+        b = rng.getrandbits(256) % P
+        al = jnp.asarray(e.int_to_limbs(a)[None, :])
+        bl = jnp.asarray(e.int_to_limbs(b)[None, :])
+        assert e.limbs_to_int(np.asarray(e._mul(al, bl))[0]) % P == a * b % P
+        assert e.limbs_to_int(np.asarray(e._add(al, bl))[0]) % P == (a + b) % P
+        assert e.limbs_to_int(np.asarray(e._sub(al, bl))[0]) % P == (a - b) % P
+        frozen = e.limbs_to_int(np.asarray(e._freeze(e._sub(al, bl)))[0])
+        assert frozen == (a - b) % P
+        if a:
+            inv = e.limbs_to_int(np.asarray(e._inv(al))[0]) % P
+            assert inv == pow(a, P - 2, P)
+
+
+def test_field_ops_survive_chained_operations():
+    """Limb-bound stress: long chains of add/sub feeding mul must stay exact
+    (the loose-limb invariant |l| <= 511)."""
+    import jax.numpy as jnp
+
+    rng = random.Random(11)
+    P = e.P
+    vals = [rng.getrandbits(255) % P for _ in range(6)]
+    arrs = [jnp.asarray(e.int_to_limbs(v)[None, :]) for v in vals]
+    acc_int, acc = vals[0], arrs[0]
+    for i in range(1, 6):
+        acc = e._mul(e._add(acc, arrs[i]), e._sub(acc, arrs[i]))
+        acc_int = (acc_int + vals[i]) * (acc_int - vals[i]) % P
+    assert e.limbs_to_int(np.asarray(e._freeze(acc))[0]) == acc_int
+
+
+# ---------------------------------------------------------------------------
+# RFC 8032 vectors.
+# ---------------------------------------------------------------------------
+
+
+def test_rfc8032_vectors_pure_python():
+    for _sk, pk, msg, sig in RFC_VECTORS:
+        assert e.verify_one(
+            bytes.fromhex(pk), bytes.fromhex(msg), bytes.fromhex(sig)
+        )
+
+
+def test_rfc8032_vectors_kernel():
+    verifier = e.Ed25519BatchVerifier(min_device_batch=1)
+    pubs = [bytes.fromhex(pk) for _, pk, _, _ in RFC_VECTORS]
+    msgs = [bytes.fromhex(m) for _, _, m, _ in RFC_VECTORS]
+    sigs = [bytes.fromhex(s) for _, _, _, s in RFC_VECTORS]
+    assert e.Ed25519BatchVerifier(min_device_batch=1).verify_batch(
+        pubs, msgs, sigs
+    ).all()
+    # and the batch path rejects a flipped message bit in the same dispatch
+    msgs[1] = bytes([msgs[1][0] ^ 1])
+    ok = verifier.verify_batch(pubs, msgs, sigs)
+    assert ok[0] and not ok[1] and ok[2]
+
+
+@pytest.mark.skipif(not HAVE_CRYPTOGRAPHY, reason="cryptography not installed")
+def test_randomized_equality_with_cryptography():
+    pubs, msgs, sigs = sign_batch(40, seed=3)
+    ok = e.Ed25519BatchVerifier(min_device_batch=1).verify_batch(
+        pubs, msgs, sigs
+    )
+    assert ok.all()
+    for pub, msg, sig in list(zip(pubs, msgs, sigs))[:5]:
+        assert e.verify_one(pub, msg, sig)
+
+
+@pytest.mark.skipif(not HAVE_CRYPTOGRAPHY, reason="cryptography not installed")
+def test_tampered_inputs_rejected():
+    pubs, msgs, sigs = sign_batch(24, seed=5)
+    pubs, msgs, sigs = list(pubs), list(msgs), list(sigs)
+    # tamper one of each: message, R half, S half, public key
+    msgs[0] = msgs[0] + b"!"
+    sigs[1] = bytes([sigs[1][0] ^ 0x40]) + sigs[1][1:]
+    sigs[2] = sigs[2][:33] + bytes([sigs[2][33] ^ 1]) + sigs[2][34:]
+    pubs[3] = bytes([pubs[3][0] ^ 2]) + pubs[3][1:]
+    ok = e.Ed25519BatchVerifier(min_device_batch=1).verify_batch(
+        pubs, msgs, sigs
+    )
+    assert not ok[:4].any()
+    assert ok[4:].all()
+
+
+@pytest.mark.skipif(not HAVE_CRYPTOGRAPHY, reason="cryptography not installed")
+def test_small_batch_cpu_path_agrees():
+    pubs, msgs, sigs = sign_batch(6, seed=9)
+    cpu = e.Ed25519BatchVerifier(min_device_batch=100).verify_batch(
+        pubs, msgs, sigs
+    )
+    dev = e.Ed25519BatchVerifier(min_device_batch=1).verify_batch(
+        pubs, msgs, sigs
+    )
+    assert cpu.tolist() == dev.tolist() == [True] * 6
+
+
+def test_malleable_s_rejected():
+    """S >= L (signature malleability) must be rejected on every path."""
+    _, pk, msg, sig = RFC_VECTORS[0]
+    pub, msg, sig = bytes.fromhex(pk), bytes.fromhex(msg), bytes.fromhex(sig)
+    s = int.from_bytes(sig[32:], "little")
+    malleated = sig[:32] + (s + e.L).to_bytes(32, "little")
+    assert not e.verify_one(pub, msg, malleated)
+    ok = e.Ed25519BatchVerifier(min_device_batch=1).verify_batch(
+        [pub], [msg], [malleated]
+    )
+    assert not ok[0]
+
+
+def test_invalid_pubkey_rejected():
+    # 2^255 - 10 is not the y of any curve point; decompression must fail.
+    bad_pub = (2**255 - 10).to_bytes(32, "little")
+    msg, sig = b"m", bytes(64)
+    assert not e.verify_one(bad_pub, msg, sig)
+    ok = e.Ed25519BatchVerifier(min_device_batch=1).verify_batch(
+        [bad_pub], [msg], [sig]
+    )
+    assert not ok[0]
+
+
+def test_key_cache_reuse():
+    verifier = e.Ed25519BatchVerifier(min_device_batch=1)
+    _, pk, msg, sig = RFC_VECTORS[0]
+    pub, msg, sig = bytes.fromhex(pk), bytes.fromhex(msg), bytes.fromhex(sig)
+    assert verifier.verify_batch([pub] * 3, [msg] * 3, [sig] * 3).all()
+    assert len(verifier._key_cache) == 1

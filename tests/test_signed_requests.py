@@ -1,0 +1,119 @@
+"""Signed-client-request mode (extended BASELINE configs 2-5).
+
+The reference delegates request authentication to the embedder (reference
+docs/Design.md "Network Ingress"); here it is a first-class processor-layer
+component (``processor.verify``) gating proposals before persistence/acks.
+"""
+
+import numpy as np
+
+from mirbft_tpu.processor.verify import (
+    RequestAuthenticator,
+    seal,
+    signing_payload,
+    unseal,
+)
+from mirbft_tpu.testengine import Spec
+
+
+def test_envelope_roundtrip():
+    payload, sig = b"some-request", bytes(range(64))
+    assert unseal(seal(payload, sig)) == (payload, sig)
+    assert unseal(b"short") is None
+
+
+def test_authenticator_accepts_valid_and_rejects_forged():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    auth = RequestAuthenticator()
+    key = Ed25519PrivateKey.from_private_bytes(bytes(range(32)))
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    auth.register(9, pub)
+
+    payload = b"the-request"
+    sig = key.sign(signing_payload(9, 3, payload))
+    envelope = seal(payload, sig)
+    assert auth.authenticate(9, 3, envelope)
+    # position binding: same envelope replayed for another req_no or client
+    assert not auth.authenticate(9, 4, envelope)
+    assert not auth.authenticate(8, 3, envelope)
+    auth.register(8, pub)
+    assert not auth.authenticate(8, 3, envelope)
+    # unknown client / garbage
+    assert not auth.authenticate(7, 0, envelope)
+    assert not auth.authenticate(9, 3, b"tiny")
+    assert auth.verified_count > 0
+
+
+def test_authenticator_batch_path_matches_device():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier
+
+    auth = RequestAuthenticator(verifier=Ed25519BatchVerifier(min_device_batch=1))
+    items = []
+    for cid in range(18):
+        key = Ed25519PrivateKey.from_private_bytes(
+            cid.to_bytes(1, "big") * 32
+        )
+        pub = key.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        auth.register(cid, pub)
+        payload = b"req-%d" % cid
+        sig = key.sign(signing_payload(cid, 0, payload))
+        items.append((cid, 0, seal(payload, sig)))
+    # corrupt two entries
+    cid, req_no, env = items[5]
+    items[5] = (cid, req_no, env[:-1] + bytes([env[-1] ^ 1]))
+    items[11] = (3, 0, items[11][2])  # signed by client 11's key, claimed by 3
+    ok = auth.authenticate_batch(items)
+    expected = np.ones(18, dtype=bool)
+    expected[5] = expected[11] = False
+    assert ok.tolist() == expected.tolist()
+    assert auth.p99_dispatch_seconds() > 0
+
+
+def test_signed_green_path_commits():
+    spec = Spec(
+        node_count=4, client_count=2, reqs_per_client=4, signed_requests=True
+    )
+    recording = spec.recorder().recording()
+    recording.drain_clients(timeout=20000)
+    hashes = {
+        n.state.checkpoint_hash
+        for n in recording.nodes
+        if n.state.checkpoint_seq_no
+        == max(x.state.checkpoint_seq_no for x in recording.nodes)
+    }
+    assert len(hashes) == 1
+
+
+def test_forged_proposal_rejected_but_network_progresses():
+    spec = Spec(
+        node_count=4, client_count=2, reqs_per_client=4, signed_requests=True
+    )
+    recording = spec.recorder().recording()
+    # An attacker injects forged proposals for client 1's future requests at
+    # every node, racing the legitimate client.
+    forged_payload = (1).to_bytes(8, "big") + b"-" + (2).to_bytes(8, "big")
+    forged = seal(forged_payload + b"<evil>", bytes(64))
+    for node in recording.nodes:
+        recording.event_queue.insert_client_proposal(node.id, 1, 2, forged, 5)
+    recording.drain_clients(timeout=30000)
+    # The forgery was never persisted: every node committed exactly the
+    # legitimate requests, and all nodes agree.
+    for node in recording.nodes:
+        assert node.state.committed_reqs.get(1) == 4
+        for ack, data in node.req_store.requests.items():
+            assert b"<evil>" not in data
+    hashes = {n.state.checkpoint_hash for n in recording.nodes}
+    assert len(hashes) == 1
