@@ -34,7 +34,8 @@ class Actions:
     # --- composition ---
 
     def concat(self, other: "Actions") -> "Actions":
-        self.items.extend(other.items)
+        if other.items:
+            self.items.extend(other.items)
         return self
 
     def push_back(self, action: s.Action) -> "Actions":

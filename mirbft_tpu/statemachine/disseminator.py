@@ -180,13 +180,13 @@ class ClientReqNo:
             return
         self.strong_requests[ack.digest] = req
 
-    def tick(self) -> Actions:
+    def tick(self, actions: Actions) -> None:
         """Null-promotion, proactive fetch, fetch retry, ack rebroadcast with
-        linear backoff (reference :507-629)."""
-        if self.committed:
-            return Actions()
+        linear backoff (reference :507-629).
 
-        actions = Actions()
+        Appends into the caller's accumulator: this runs once per in-window
+        req-no per tick, so avoiding a per-call ``Actions`` allocation
+        matters at scale."""
 
         # 1. Conflicting correct requests and no null yet → promote null.
         if b"" not in self.my_requests and len(self.weak_requests) > 1:
@@ -212,7 +212,7 @@ class ClientReqNo:
                     actions.concat(req.fetch())
 
         # 3. Fetches that timed out → retry (deterministic digest order).
-        to_fetch: List[ClientRequest] = []
+        to_fetch: Optional[List[ClientRequest]] = None
         for req in self.weak_requests.values():
             if not req.fetching:
                 continue
@@ -220,17 +220,20 @@ class ClientReqNo:
                 req.ticks_fetching += 1
                 continue
             req.fetching = False
+            if to_fetch is None:
+                to_fetch = []
             to_fetch.append(req)
-        to_fetch.sort(key=lambda r: r.ack.digest, reverse=True)
-        for req in to_fetch:
-            actions.concat(req.fetch())
+        if to_fetch is not None:
+            to_fetch.sort(key=lambda r: r.ack.digest, reverse=True)
+            for req in to_fetch:
+                actions.concat(req.fetch())
 
         # 4. Ack rebroadcast with linear backoff.
         if self.acks_sent == 0:
-            return actions
+            return
         if self.ticks_since_ack != self.acks_sent * ACK_RESEND_TICKS:
             self.ticks_since_ack += 1
-            return actions
+            return
 
         if len(self.my_requests) > 1:
             ack = self.my_requests[b""].ack
@@ -243,7 +246,6 @@ class ClientReqNo:
         self.acks_sent += 1
         self.ticks_since_ack = 0
         actions.send(self.network_config.nodes, AckMsg(ack=ack))
-        return actions
 
 
 class Client:
@@ -462,11 +464,10 @@ class Client:
             self.next_ack_mark = i + 1
         return actions
 
-    def tick(self) -> Actions:
-        actions = Actions()
+    def tick(self, actions: Actions) -> None:
         for crn in self.req_nos.values():
-            actions.concat(crn.tick())
-        return actions
+            if not crn.committed:
+                crn.tick(actions)
 
 
 class ClientHashDisseminator:
@@ -536,7 +537,7 @@ class ClientHashDisseminator:
     def tick(self) -> Actions:
         actions = Actions()
         for client_state in self.client_states:
-            actions.concat(self.clients[client_state.id].tick())
+            self.clients[client_state.id].tick(actions)
         return actions
 
     def filter(self, _source: int, msg: Msg) -> Applyable:

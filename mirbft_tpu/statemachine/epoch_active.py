@@ -330,6 +330,8 @@ class ActiveEpoch:
         actions = Actions()
         for bucket in range(len(self.buckets)):
             buffer = self.preprepare_buffers[bucket]
+            if not buffer.buffer:
+                continue
             source = self.buckets[bucket]
             next_msg = buffer.buffer.next(self.filter)
             if next_msg is None:
@@ -338,7 +340,10 @@ class ActiveEpoch:
             actions.concat(self.apply(source, next_msg))
 
         for node in self.network_config.nodes:
-            self.other_buffers[node].iterate(
+            other = self.other_buffers[node]
+            if not other:
+                continue
+            other.iterate(
                 self.filter,
                 lambda nid, msg: actions.concat(self.apply(nid, msg)),
             )
