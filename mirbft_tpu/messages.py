@@ -242,6 +242,21 @@ class AckMsg:
     ack: RequestAck
 
 
+@dataclass(frozen=True, slots=True)
+class AckBatch:
+    """Aggregated request acknowledgements: semantically identical to sending
+    each contained ack as its own ``AckMsg`` to the same targets, in order.
+
+    Extension over the reference, which broadcasts one message per ack
+    (``client_hash_disseminator.go:878-895``).  The ack flood is the
+    throughput-dominant traffic class — O(N²) messages per request across the
+    cluster — so aggregating the acks a replica generates in one step
+    amortizes per-message transport and dispatch cost over the whole batch
+    (the Mir paper itself batches dissemination)."""
+
+    acks: Tuple[RequestAck, ...]
+
+
 Msg = Union[
     Preprepare,
     Prepare,
@@ -258,6 +273,7 @@ Msg = Union[
     FetchRequest,
     ForwardRequest,
     AckMsg,
+    AckBatch,
 ]
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Dict
 
 from ..messages import (
+    AckBatch,
     AckMsg,
     CheckpointMsg,
     Commit,
@@ -53,6 +54,7 @@ _MSG_TYPES = (
     FetchRequest,
     ForwardRequest,
     AckMsg,
+    AckBatch,
 )
 
 
@@ -70,6 +72,14 @@ def pre_process(msg: Msg) -> None:
     if isinstance(msg, (FetchRequest, AckMsg)):
         if not isinstance(msg.ack, RequestAck):
             raise MessageValidationError("ack field must be a RequestAck")
+    elif isinstance(msg, AckBatch):
+        if not msg.acks:
+            raise MessageValidationError("AckBatch must carry at least one ack")
+        for ack in msg.acks:
+            if not isinstance(ack, RequestAck):
+                raise MessageValidationError(
+                    "AckBatch entries must be RequestAcks"
+                )
     elif isinstance(msg, ForwardRequest):
         if not isinstance(msg.request_ack, RequestAck):
             raise MessageValidationError(

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from .. import state as st
+from .. import messages as m
 from ..messages import CEntry, EpochConfig, FEntry, NetworkState, Persistent
 from ..statemachine.actions import Actions, Events
 from ..statemachine.machine import StateMachine
@@ -87,14 +88,54 @@ def process_wal_actions(wal: WAL, actions: Actions) -> Actions:
     return net_actions
 
 
-def process_net_actions(self_id: int, link: Link, actions: Actions) -> Events:
-    """Sends to self become local Step events (reference serial.go:158-178)."""
-    events = Events()
+def _coalesce_ack_sends(actions: Actions) -> List[st.ActionSend]:
+    """Merge every AckMsg/AckBatch send with identical targets in this batch
+    into one AckBatch, emitted at the position of the first merged send.
+
+    The ack flood is the dominant traffic class (O(N²) per request) but the
+    reference emits one send per ack as each request persists
+    (client_hash_disseminator.go:878-895).  Acks are order-insensitive
+    set-semantics messages and the network offers no cross-message ordering
+    guarantee, so coalescing within one net-processing iteration is
+    observationally equivalent — and deterministic, since grouping follows
+    action order."""
+    by_targets: dict = {}
+    out: List[st.ActionSend] = []
     for action in actions:
         if not isinstance(action, st.ActionSend):
             raise AssertionError(
                 f"unexpected Net action type {type(action).__name__}"
             )
+        msg = action.msg
+        if isinstance(msg, m.AckMsg):
+            acks = (msg.ack,)
+        elif isinstance(msg, m.AckBatch):
+            acks = msg.acks
+        else:
+            out.append(action)
+            continue
+        slot = by_targets.get(action.targets)
+        if slot is None:
+            # placeholder keeps the first-occurrence position
+            by_targets[action.targets] = (len(out), list(acks))
+            out.append(action)
+        else:
+            slot[1].extend(acks)
+    for targets, (index, acks) in by_targets.items():
+        if len(acks) == 1:
+            out[index] = st.ActionSend(targets=targets, msg=m.AckMsg(ack=acks[0]))
+        else:
+            out[index] = st.ActionSend(
+                targets=targets, msg=m.AckBatch(acks=tuple(acks))
+            )
+    return out
+
+
+def process_net_actions(self_id: int, link: Link, actions: Actions) -> Events:
+    """Sends to self become local Step events (reference serial.go:158-178).
+    Ack sends are coalesced per target set first (see _coalesce_ack_sends)."""
+    events = Events()
+    for action in _coalesce_ack_sends(actions):
         for replica in action.targets:
             if replica == self_id:
                 events.step(replica, action.msg)

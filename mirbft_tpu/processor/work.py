@@ -9,11 +9,11 @@ WAL-dependent unless the message type is safe to send before the WAL syncs
 from __future__ import annotations
 
 from .. import state as st
-from ..messages import AckMsg, CheckpointMsg, FetchBatch, ForwardBatch
+from ..messages import AckBatch, AckMsg, CheckpointMsg, FetchBatch, ForwardBatch
 from ..statemachine.actions import Actions, Events
 
 # Message types that may be sent without waiting for the WAL sync.
-_WAL_INDEPENDENT_SENDS = (AckMsg, CheckpointMsg, FetchBatch, ForwardBatch)
+_WAL_INDEPENDENT_SENDS = (AckMsg, AckBatch, CheckpointMsg, FetchBatch, ForwardBatch)
 
 
 class WorkItems:

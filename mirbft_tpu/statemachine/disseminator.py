@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..messages import (
+    AckBatch,
     AckMsg,
     ClientState,
     FetchRequest,
@@ -86,7 +87,8 @@ class ClientReqNo:
         "my_requests",
         "committed",
         "acks_sent",
-        "ticks_since_ack",
+        "acked_digest",
+        "resend_nonce",
     )
 
     def __init__(
@@ -109,7 +111,8 @@ class ClientReqNo:
         self.my_requests: Dict[bytes, ClientRequest] = {}  # locally persisted
         self.committed = False
         self.acks_sent = 0
-        self.ticks_since_ack = 0
+        self.acked_digest: Optional[bytes] = None  # digest our ack endorsed
+        self.resend_nonce = 0  # invalidates stale resend-schedule entries
 
     def reinitialize(self, network_config: NetworkConfig) -> None:
         """Re-derive quorum sets under a (possibly changed) config
@@ -154,8 +157,8 @@ class ClientReqNo:
             return None
         if len(self.my_requests) == 1:
             self.acks_sent = 1
-            self.ticks_since_ack = 0
             (req,) = self.my_requests.values()
+            self.acked_digest = req.ack.digest
             return AckMsg(ack=req.ack)
 
         # Multiple locally-known requests: ack the null request.
@@ -164,7 +167,7 @@ class ClientReqNo:
         null_req.stored = True
         self.my_requests[b""] = null_req
         self.acks_sent = 1
-        self.ticks_since_ack = 0
+        self.acked_digest = b""
         return AckMsg(ack=null_ack)
 
     def _apply_request_ack(self, source: int, ack: RequestAck) -> None:
@@ -180,15 +183,33 @@ class ClientReqNo:
             return
         self.strong_requests[ack.digest] = req
 
-    def tick(self, actions: Actions) -> None:
-        """Null-promotion, proactive fetch, fetch retry, ack rebroadcast with
-        linear backoff (reference :507-629).
+    def needs_attention(self) -> bool:
+        """Whether the per-tick scan (attention_tick) has work or counters to
+        advance for this req-no.  Mirrors exactly the conditions under which
+        the reference's per-req-no tick body (reference :507-629) mutates
+        state: a pending null promotion, a proactive-fetch countdown, or an
+        in-flight fetch timing out.  Ack-rebroadcast backoff is NOT included —
+        it is handled by the client's resend schedule."""
+        wr = self.weak_requests
+        if len(wr) > 1 and b"" not in self.my_requests:
+            return True  # null promotion pending
+        if len(wr) == 1:
+            (req,) = wr.values()
+            if not req.stored and not req.fetching:
+                return True  # counting down to a proactive fetch
+        for req in wr.values():
+            if req.fetching:
+                return True  # fetch-timeout counting
+        return False
 
-        Appends into the caller's accumulator: this runs once per in-window
-        req-no per tick, so avoiding a per-call ``Actions`` allocation
-        matters at scale."""
+    def attention_tick(self, actions: Actions) -> bool:
+        """Null-promotion, proactive fetch, and fetch retry — the per-tick
+        body of reference :507-614, minus ack rebroadcast (scheduled by the
+        owning Client).  Returns True when a null promotion fired (the client
+        must then schedule the promoted ack's first rebroadcast)."""
+        promoted = False
 
-        # 1. Conflicting correct requests and no null yet → promote null.
+        # 1. Conflicting correct requests and no null yet -> promote null.
         if b"" not in self.my_requests and len(self.weak_requests) > 1:
             null_ack = RequestAck(
                 client_id=self.client_id, req_no=self.req_no, digest=b""
@@ -197,12 +218,13 @@ class ClientReqNo:
             null_req.stored = True
             self.my_requests[b""] = null_req
             self.acks_sent = 1
-            self.ticks_since_ack = 0
+            self.acked_digest = b""
+            promoted = True
             actions.send(self.network_config.nodes, AckMsg(ack=null_ack)).correct_request(
                 null_ack
             )
 
-        # 2. Exactly one correct request we don't hold → proactively fetch.
+        # 2. Exactly one correct request we don't hold -> proactively fetch.
         if len(self.weak_requests) == 1:
             (req,) = self.weak_requests.values()
             if not req.stored and not req.fetching:
@@ -211,7 +233,7 @@ class ClientReqNo:
                 else:
                     actions.concat(req.fetch())
 
-        # 3. Fetches that timed out → retry (deterministic digest order).
+        # 3. Fetches that timed out -> retry (deterministic digest order).
         to_fetch: Optional[List[ClientRequest]] = None
         for req in self.weak_requests.values():
             if not req.fetching:
@@ -228,24 +250,7 @@ class ClientReqNo:
             for req in to_fetch:
                 actions.concat(req.fetch())
 
-        # 4. Ack rebroadcast with linear backoff.
-        if self.acks_sent == 0:
-            return
-        if self.ticks_since_ack != self.acks_sent * ACK_RESEND_TICKS:
-            self.ticks_since_ack += 1
-            return
-
-        if len(self.my_requests) > 1:
-            ack = self.my_requests[b""].ack
-        elif len(self.my_requests) == 1:
-            (req,) = self.my_requests.values()
-            ack = req.ack
-        else:
-            raise AssertionError("sent an ack for a request we do not have")
-
-        self.acks_sent += 1
-        self.ticks_since_ack = 0
-        actions.send(self.network_config.nodes, AckMsg(ack=ack))
+        return promoted
 
 
 class Client:
@@ -261,6 +266,10 @@ class Client:
         "next_ready_mark",
         "next_ack_mark",
         "req_nos",
+        "tick_count",
+        "attention",
+        "resend_schedule",
+        "resend_seq",
     )
 
     def __init__(self, my_config: EventInitialParameters, tracker: ClientTracker, logger=None):
@@ -273,6 +282,19 @@ class Client:
         self.next_ready_mark = 0
         self.next_ack_mark = 0
         self.req_nos: Dict[int, ClientReqNo] = {}  # insertion-ordered window
+        # Tick machinery: instead of scanning every in-window req-no each
+        # tick (O(window) per tick per client, reference :507-629), req-nos
+        # that have per-tick work register in `attention`, and ack
+        # rebroadcasts are scheduled by absolute tick number with a per-crn
+        # nonce guarding stale entries.  Observable behavior (which tick a
+        # given action fires on) is identical to the reference's counters.
+        self.tick_count = 0
+        self.attention: Set[int] = set()
+        self.resend_schedule: Dict[int, List[Tuple[int, int]]] = {}
+        # Nonces are unique across the client's lifetime so a schedule entry
+        # left by a dropped ClientReqNo can never match a later incarnation
+        # of the same req_no.
+        self.resend_seq = 0
 
     def reinitialize(
         self,
@@ -327,6 +349,11 @@ class Client:
             crn.reinitialize(network_config)
             self.req_nos[req_no] = crn
 
+        self.attention = {
+            rn
+            for rn, crn in self.req_nos.items()
+            if not crn.committed and crn.needs_attention()
+        }
         self.advance_ready()
         return actions
 
@@ -424,6 +451,7 @@ class Client:
             crn.strong_requests[ack.digest] = cr
             self.advance_ready()
 
+        self._update_attention(crn)
         return actions, cr
 
     def in_watermarks(self, req_no: int) -> bool:
@@ -454,20 +482,94 @@ class Client:
                 break
 
     def advance_acks(self) -> Actions:
-        """Reference :878-895."""
+        """Reference :878-895 — but acks generated in one pass are aggregated
+        into a single AckBatch broadcast (see messages.AckBatch)."""
         actions = Actions()
+        acks: List[RequestAck] = []
         for i in range(self.next_ack_mark, self.high_watermark + 1):
-            ack_msg = self.req_no(i).generate_ack()
+            crn = self.req_no(i)
+            ack_msg = crn.generate_ack()
             if ack_msg is None:
                 break
-            actions.send(self.network_config.nodes, ack_msg)
+            acks.append(ack_msg.ack)
+            # First rebroadcast is due after ACK_RESEND_TICKS full ticks have
+            # elapsed, firing on the tick after (reference backoff counter
+            # semantics, :614-629).
+            self._schedule_resend(crn, self.tick_count + ACK_RESEND_TICKS + 1)
+            self._update_attention(crn)
             self.next_ack_mark = i + 1
+        if len(acks) == 1:
+            actions.send(self.network_config.nodes, AckMsg(ack=acks[0]))
+        elif acks:
+            actions.send(self.network_config.nodes, AckBatch(acks=tuple(acks)))
         return actions
 
+    def _update_attention(self, crn: ClientReqNo) -> None:
+        if not crn.committed and crn.needs_attention():
+            self.attention.add(crn.req_no)
+        else:
+            self.attention.discard(crn.req_no)
+
+    def _schedule_resend(self, crn: ClientReqNo, due_tick: int) -> None:
+        self.resend_seq += 1
+        crn.resend_nonce = self.resend_seq
+        self.resend_schedule.setdefault(due_tick, []).append(
+            (crn.req_no, crn.resend_nonce)
+        )
+
+    def apply_new_request(self, ack: RequestAck) -> None:
+        crn = self.req_no(ack.req_no)
+        crn.apply_new_request(ack)
+        self._update_attention(crn)
+
+    def note_fetching(self, ack: RequestAck) -> None:
+        """A fetch was initiated outside the tick path (epoch-change request
+        recovery): make sure its timeout counting is attended to."""
+        crn = self.req_nos.get(ack.req_no)
+        if crn is not None:
+            self._update_attention(crn)
+
     def tick(self, actions: Actions) -> None:
-        for crn in self.req_nos.values():
-            if not crn.committed:
-                crn.tick(actions)
+        self.tick_count += 1
+
+        if self.attention:
+            for rn in sorted(self.attention):
+                crn = self.req_nos.get(rn)
+                if crn is None or crn.committed:
+                    self.attention.discard(rn)
+                    continue
+                if crn.attention_tick(actions):
+                    # Null promotion counts its first backoff window from
+                    # this very tick (the reference increments the fresh
+                    # counter in the same tick body, :614-617).
+                    self._schedule_resend(
+                        crn, self.tick_count + ACK_RESEND_TICKS
+                    )
+                self._update_attention(crn)
+
+        resend: List[RequestAck] = []
+        due = self.resend_schedule.pop(self.tick_count, None)
+        if due:
+            for rn, nonce in due:
+                crn = self.req_nos.get(rn)
+                if crn is None or crn.committed or crn.resend_nonce != nonce:
+                    continue
+                req = crn.my_requests.get(crn.acked_digest)
+                if req is None:
+                    raise AssertionError(
+                        "sent an ack for a request we do not have"
+                    )
+                ack = req.ack
+                crn.acks_sent += 1
+                resend.append(ack)
+                self._schedule_resend(
+                    crn,
+                    self.tick_count + crn.acks_sent * ACK_RESEND_TICKS + 1,
+                )
+        if len(resend) == 1:
+            actions.send(self.network_config.nodes, AckMsg(ack=resend[0]))
+        elif resend:
+            actions.send(self.network_config.nodes, AckBatch(acks=tuple(resend)))
 
 
 class ClientHashDisseminator:
@@ -557,6 +659,23 @@ class ClientHashDisseminator:
         raise AssertionError(f"unexpected client message type {type(msg).__name__}")
 
     def step(self, source: int, msg: Msg) -> Actions:
+        if isinstance(msg, AckBatch):
+            # Per-ack classification: a batch may straddle a window boundary.
+            # PAST acks are dropped, FUTURE acks are buffered individually
+            # (so later buffer iteration applies them one by one, exactly as
+            # if they had arrived as single AckMsgs), CURRENT acks apply now.
+            actions = Actions()
+            for ack in msg.acks:
+                single = AckMsg(ack=ack)
+                verdict = self.filter(source, single)
+                if verdict == Applyable.PAST:
+                    continue
+                if verdict == Applyable.FUTURE:
+                    self.msg_buffers[source].store(single)
+                    continue
+                ack_actions, _ = self.ack(source, ack)
+                actions.concat(ack_actions)
+            return actions
         verdict = self.filter(source, msg)
         if verdict == Applyable.PAST:
             return Actions()
@@ -584,7 +703,7 @@ class ClientHashDisseminator:
             return Actions()  # client removed since the request was processed
         if not client.in_watermarks(ack.req_no):
             return Actions()  # already committed
-        client.req_no(ack.req_no).apply_new_request(ack)
+        client.apply_new_request(ack)
         return client.advance_acks()
 
     def allocate(self, seq_no: int, network_state: NetworkState) -> Actions:
@@ -633,6 +752,12 @@ class ClientHashDisseminator:
                 "step filtering should delay reqs for non-existent clients"
             )
         return client.ack(source, ack, force=force)
+
+    def note_fetching(self, ack: RequestAck) -> None:
+        """See Client.note_fetching."""
+        client = self.clients.get(ack.client_id)
+        if client is not None:
+            client.note_fetching(ack)
 
     def client(self, client_id: int) -> Optional[Client]:
         return self.clients.get(client_id)

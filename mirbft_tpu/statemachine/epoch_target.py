@@ -267,6 +267,7 @@ class EpochTarget:
                     continue
                 fetch_pending = True
                 actions.concat(cr.fetch())
+                self.client_hash_disseminator.note_fetching(request_ack)
 
         if fetch_pending:
             return actions

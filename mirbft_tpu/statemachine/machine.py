@@ -19,6 +19,7 @@ from typing import Optional
 
 from .. import state as st
 from ..messages import (
+    AckBatch,
     AckMsg,
     CEntry,
     CheckpointMsg,
@@ -291,7 +292,7 @@ class StateMachine:
     # --- message routing (reference state_machine.go:310-349) ---
 
     def step(self, source: int, msg: Msg) -> Actions:
-        if isinstance(msg, (AckMsg, FetchRequest, ForwardRequest)):
+        if isinstance(msg, (AckMsg, AckBatch, FetchRequest, ForwardRequest)):
             return self.client_hash_disseminator.step(source, msg)
         if isinstance(msg, CheckpointMsg):
             self.checkpoint_tracker.step(source, msg)

@@ -137,6 +137,7 @@ _REGISTRY_ORDER: List[type] = [
     s.ActionStateTransfer,
     s.ActionStateApplied,
     s.RecordedEvent,
+    m.AckBatch,
 ]
 
 _TAG_OF: Dict[type, int] = {cls: i for i, cls in enumerate(_REGISTRY_ORDER)}
