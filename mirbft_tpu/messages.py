@@ -243,6 +243,22 @@ class AckMsg:
 
 
 @dataclass(frozen=True, slots=True)
+class MsgBatch:
+    """Transport envelope: a sequence of consensus messages from one sender
+    to the same targets, delivered and processed in order as if sent
+    individually.  Nesting is not allowed.
+
+    Extension over the reference, whose Link sends every protocol message as
+    its own transmission.  Consensus traffic is many tiny messages — at N
+    replicas each sequence costs O(N²) Prepares/Commits and each epoch change
+    O(N³) EpochChangeAcks — so aggregating everything a replica emits to the
+    same destination in one processing iteration amortizes per-message
+    transport and event dispatch."""
+
+    msgs: Tuple["Msg", ...]
+
+
+@dataclass(frozen=True, slots=True)
 class AckBatch:
     """Aggregated request acknowledgements: semantically identical to sending
     each contained ack as its own ``AckMsg`` to the same targets, in order.
@@ -274,6 +290,7 @@ Msg = Union[
     ForwardRequest,
     AckMsg,
     AckBatch,
+    MsgBatch,
 ]
 
 

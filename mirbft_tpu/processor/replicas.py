@@ -18,6 +18,7 @@ from typing import Dict
 from ..messages import (
     AckBatch,
     AckMsg,
+    MsgBatch,
     CheckpointMsg,
     Commit,
     EpochChange,
@@ -55,6 +56,7 @@ _MSG_TYPES = (
     ForwardRequest,
     AckMsg,
     AckBatch,
+    MsgBatch,
 )
 
 
@@ -80,6 +82,15 @@ def pre_process(msg: Msg) -> None:
                 raise MessageValidationError(
                     "AckBatch entries must be RequestAcks"
                 )
+    elif isinstance(msg, MsgBatch):
+        if not msg.msgs:
+            raise MessageValidationError(
+                "MsgBatch must carry at least one message"
+            )
+        for inner in msg.msgs:
+            if isinstance(inner, MsgBatch):
+                raise MessageValidationError("MsgBatch cannot nest")
+            pre_process(inner)
     elif isinstance(msg, ForwardRequest):
         if not isinstance(msg.request_ack, RequestAck):
             raise MessageValidationError(
@@ -115,6 +126,20 @@ class Replica:
             # Buffered outside the state machine (unimplemented, mirroring
             # the reference).
             return Events()
+        if isinstance(msg, MsgBatch):
+            # The interception above must also apply inside envelopes — the
+            # state machine's client message path does not accept
+            # ForwardRequest, so letting one through would crash on
+            # peer-controlled input.
+            kept = tuple(
+                inner
+                for inner in msg.msgs
+                if not isinstance(inner, ForwardRequest)
+            )
+            if not kept:
+                return Events()
+            if len(kept) != len(msg.msgs):
+                msg = kept[0] if len(kept) == 1 else MsgBatch(msgs=kept)
         return Events().step(self.id, msg)
 
 

@@ -88,54 +88,58 @@ def process_wal_actions(wal: WAL, actions: Actions) -> Actions:
     return net_actions
 
 
-def _coalesce_ack_sends(actions: Actions) -> List[st.ActionSend]:
-    """Merge every AckMsg/AckBatch send with identical targets in this batch
-    into one AckBatch, emitted at the position of the first merged send.
+def _coalesce_sends(actions: Actions) -> List[st.ActionSend]:
+    """Aggregate this iteration's sends per target set: AckMsg/AckBatch
+    sends merge into one AckBatch, and if a target set still has more than
+    one message the whole group is wrapped in a single MsgBatch envelope,
+    emitted at the position of the group's first send.
 
-    The ack flood is the dominant traffic class (O(N²) per request) but the
-    reference emits one send per ack as each request persists
-    (client_hash_disseminator.go:878-895).  Acks are order-insensitive
-    set-semantics messages and the network offers no cross-message ordering
-    guarantee, so coalescing within one net-processing iteration is
+    The reference transmits every protocol message individually; consensus
+    traffic is many tiny messages (O(N²) Prepares/Commits per sequence,
+    O(N³) EpochChangeAcks per epoch change, O(N²) acks per request), so
+    per-message transport and dispatch dominate at scale.  The network
+    offers no cross-message ordering guarantee and delivery order within
+    the envelope is preserved, so coalescing one iteration's output is
     observationally equivalent — and deterministic, since grouping follows
     action order."""
-    by_targets: dict = {}
-    out: List[st.ActionSend] = []
+    groups: dict = {}  # targets -> (first_index, msgs, acks)
+    out: List[Optional[st.ActionSend]] = []
     for action in actions:
         if not isinstance(action, st.ActionSend):
             raise AssertionError(
                 f"unexpected Net action type {type(action).__name__}"
             )
+        slot = groups.get(action.targets)
+        if slot is None:
+            slot = (len(out), [], [])
+            groups[action.targets] = slot
+            out.append(None)  # placeholder keeps first-occurrence position
         msg = action.msg
         if isinstance(msg, m.AckMsg):
-            acks = (msg.ack,)
+            slot[2].append(msg.ack)
         elif isinstance(msg, m.AckBatch):
-            acks = msg.acks
+            slot[2].extend(msg.acks)
         else:
-            out.append(action)
-            continue
-        slot = by_targets.get(action.targets)
-        if slot is None:
-            # placeholder keeps the first-occurrence position
-            by_targets[action.targets] = (len(out), list(acks))
-            out.append(action)
-        else:
-            slot[1].extend(acks)
-    for targets, (index, acks) in by_targets.items():
-        if len(acks) == 1:
-            out[index] = st.ActionSend(targets=targets, msg=m.AckMsg(ack=acks[0]))
-        else:
-            out[index] = st.ActionSend(
-                targets=targets, msg=m.AckBatch(acks=tuple(acks))
+            slot[1].append(msg)
+    for targets, (index, msgs, acks) in groups.items():
+        if acks:
+            msgs.append(
+                m.AckMsg(ack=acks[0])
+                if len(acks) == 1
+                else m.AckBatch(acks=tuple(acks))
             )
-    return out
+        out[index] = st.ActionSend(
+            targets=targets,
+            msg=msgs[0] if len(msgs) == 1 else m.MsgBatch(msgs=tuple(msgs)),
+        )
+    return [a for a in out if a is not None]
 
 
 def process_net_actions(self_id: int, link: Link, actions: Actions) -> Events:
     """Sends to self become local Step events (reference serial.go:158-178).
-    Ack sends are coalesced per target set first (see _coalesce_ack_sends)."""
+    Sends are coalesced per target set first (see _coalesce_sends)."""
     events = Events()
-    for action in _coalesce_ack_sends(actions):
+    for action in _coalesce_sends(actions):
         for replica in action.targets:
             if replica == self_id:
                 events.step(replica, action.msg)

@@ -270,6 +270,8 @@ class Client:
         "attention",
         "resend_schedule",
         "resend_seq",
+        "weak_quorum",
+        "strong_quorum",
     )
 
     def __init__(self, my_config: EventInitialParameters, tracker: ClientTracker, logger=None):
@@ -295,6 +297,8 @@ class Client:
         # left by a dropped ClientReqNo can never match a later incarnation
         # of the same req_no.
         self.resend_seq = 0
+        self.weak_quorum = 0  # f+1, cached at (re)initialization
+        self.strong_quorum = 0  # (n+f+2)//2, cached at (re)initialization
 
     def reinitialize(
         self,
@@ -305,6 +309,8 @@ class Client:
     ) -> Actions:
         """Reference :692-743."""
         actions = Actions()
+        self.weak_quorum = some_correct_quorum(network_config)
+        self.strong_quorum = intersection_quorum(network_config)
         old_req_nos = self.req_nos
 
         # Window is exactly `width` slots, [lw, lw+width-1]; the portion
@@ -408,9 +414,17 @@ class Client:
         return actions
 
     def ack(self, source: int, ack: RequestAck, force: bool = False) -> Tuple[Actions, ClientRequest]:
-        """Record a replica's ack; drive correct/available/ready transitions
-        (reference :806-840)."""
         actions = Actions()
+        cr = self.ack_into(actions, source, ack, force=force)
+        return actions, cr
+
+    def ack_into(
+        self, actions: Actions, source: int, ack: RequestAck, force: bool = False
+    ) -> ClientRequest:
+        """Record a replica's ack; drive correct/available/ready transitions
+        (reference :806-840).  Appends into the caller's accumulator — this
+        is the per-ack hot loop (O(N^2) calls per request across the
+        cluster), so per-call allocations are kept off it."""
         crn = self.req_nos.get(ack.req_no)
         if crn is None:
             raise AssertionError(
@@ -426,33 +440,35 @@ class Client:
             existing = crn.requests.get(ack.digest)
             already_voted_this = existing is not None and source in existing.agreements
             if source in crn.non_null_voters and not already_voted_this:
-                return actions, crn.client_req(ack)
+                return crn.client_req(ack)
 
         if ack.digest:
             crn.non_null_voters.add(source)
 
         cr = crn.client_req(ack)
         cr.agreements.add(source)
+        agreement_count = len(cr.agreements)
 
-        newly_correct = len(cr.agreements) == some_correct_quorum(self.network_config)
+        newly_correct = agreement_count == self.weak_quorum
         if newly_correct:
             crn.weak_requests[ack.digest] = cr
             if not cr.stored:
                 actions.correct_request(ack)
+            # Attention membership only changes when the weak set changes
+            # (stored/fetching/my_requests are not touched on this path).
+            self._update_attention(crn)
 
-        correct_and_my_ack = (
-            len(cr.agreements) >= some_correct_quorum(self.network_config)
-            and source == self.my_config.id
-        )
-        if cr.stored and (newly_correct or correct_and_my_ack):
+        if cr.stored and (
+            newly_correct
+            or (agreement_count >= self.weak_quorum and source == self.my_config.id)
+        ):
             self.client_tracker.add_available(ack)
 
-        if len(cr.agreements) == intersection_quorum(self.network_config):
+        if agreement_count == self.strong_quorum:
             crn.strong_requests[ack.digest] = cr
             self.advance_ready()
 
-        self._update_attention(crn)
-        return actions, cr
+        return cr
 
     def in_watermarks(self, req_no: int) -> bool:
         return self.client_state.low_watermark <= req_no <= self.high_watermark
@@ -664,17 +680,22 @@ class ClientHashDisseminator:
             # PAST acks are dropped, FUTURE acks are buffered individually
             # (so later buffer iteration applies them one by one, exactly as
             # if they had arrived as single AckMsgs), CURRENT acks apply now.
+            # Classification is inlined (same logic as filter's AckMsg arm):
+            # this is the cluster's hottest message path.
             actions = Actions()
+            clients = self.clients
             for ack in msg.acks:
-                single = AckMsg(ack=ack)
-                verdict = self.filter(source, single)
-                if verdict == Applyable.PAST:
+                client = clients.get(ack.client_id)
+                if client is None:
+                    self.msg_buffers[source].store(AckMsg(ack=ack))  # FUTURE
                     continue
-                if verdict == Applyable.FUTURE:
-                    self.msg_buffers[source].store(single)
+                req_no = ack.req_no
+                if client.client_state.low_watermark > req_no:
+                    continue  # PAST
+                if client.high_watermark < req_no:
+                    self.msg_buffers[source].store(AckMsg(ack=ack))  # FUTURE
                     continue
-                ack_actions, _ = self.ack(source, ack)
-                actions.concat(ack_actions)
+                client.ack_into(actions, source, ack)
             return actions
         verdict = self.filter(source, msg)
         if verdict == Applyable.PAST:

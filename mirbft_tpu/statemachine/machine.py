@@ -21,6 +21,7 @@ from .. import state as st
 from ..messages import (
     AckBatch,
     AckMsg,
+    MsgBatch,
     CEntry,
     CheckpointMsg,
     Commit,
@@ -292,6 +293,14 @@ class StateMachine:
     # --- message routing (reference state_machine.go:310-349) ---
 
     def step(self, source: int, msg: Msg) -> Actions:
+        if isinstance(msg, MsgBatch):
+            # Transport envelope: process contents in order as one event
+            # (the post-event fixpoint in apply_event runs once for the
+            # whole envelope, which is where the amortization comes from).
+            actions = Actions()
+            for inner in msg.msgs:
+                actions.concat(self.step(source, inner))
+            return actions
         if isinstance(msg, (AckMsg, AckBatch, FetchRequest, ForwardRequest)):
             return self.client_hash_disseminator.step(source, msg)
         if isinstance(msg, CheckpointMsg):

@@ -138,6 +138,7 @@ _REGISTRY_ORDER: List[type] = [
     s.ActionStateApplied,
     s.RecordedEvent,
     m.AckBatch,
+    m.MsgBatch,
 ]
 
 _TAG_OF: Dict[type, int] = {cls: i for i, cls in enumerate(_REGISTRY_ORDER)}
