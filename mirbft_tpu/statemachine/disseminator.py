@@ -41,6 +41,11 @@ FETCH_TIMEOUT_TICKS = 4
 ACK_RESEND_TICKS = 20
 
 
+def mask_to_nodes(mask: int) -> Tuple[int, ...]:
+    """Replica-id bitmask -> ascending id tuple."""
+    return tuple(i for i in range(mask.bit_length()) if (mask >> i) & 1)
+
+
 class ClientRequest:
     """One (client, req_no, digest) candidate (reference :631-668)."""
 
@@ -55,7 +60,7 @@ class ClientRequest:
 
     def __init__(self, ack: RequestAck):
         self.ack = ack
-        self.agreements: Set[int] = set()
+        self.agreements = 0  # bitmask of replica ids that acked this digest
         self.stored = False  # persisted locally
         self.fetching = False
         self.ticks_fetching = 0
@@ -67,7 +72,7 @@ class ClientRequest:
         self.fetching = True
         self.ticks_fetching = 0
         return Actions().send(
-            tuple(sorted(self.agreements)), FetchRequest(ack=self.ack)
+            mask_to_nodes(self.agreements), FetchRequest(ack=self.ack)
         )
 
 
@@ -104,7 +109,7 @@ class ClientReqNo:
         self.req_no = req_no
         self.network_config = network_config
         self.valid_after_seq_no = valid_after_seq_no
-        self.non_null_voters: Set[int] = set()
+        self.non_null_voters = 0  # bitmask of replicas that voted non-null
         self.requests: Dict[bytes, ClientRequest] = {}  # all observed
         self.weak_requests: Dict[bytes, ClientRequest] = {}  # correct
         self.strong_requests: Dict[bytes, ClientRequest] = {}  # proposable
@@ -119,7 +124,7 @@ class ClientReqNo:
         (reference :371-408)."""
         self.network_config = network_config
         old_requests = self.requests
-        self.non_null_voters = set()
+        self.non_null_voters = 0
         self.requests = {}
         self.weak_requests = {}
         self.strong_requests = {}
@@ -128,7 +133,7 @@ class ClientReqNo:
         for digest in sorted(old_requests):
             old_req = old_requests[digest]
             for node in network_config.nodes:
-                if node in old_req.agreements:
+                if (old_req.agreements >> node) & 1:
                     self._apply_request_ack(node, old_req.ack)
             if old_req.stored:
                 new_req = self.client_req(old_req.ack)
@@ -173,13 +178,14 @@ class ClientReqNo:
     def _apply_request_ack(self, source: int, ack: RequestAck) -> None:
         """Quorum bookkeeping used during reinitialize (reference :481-505)."""
         if ack.digest:
-            self.non_null_voters.add(source)
+            self.non_null_voters |= 1 << source
         req = self.client_req(ack)
-        req.agreements.add(source)
-        if len(req.agreements) < some_correct_quorum(self.network_config):
+        req.agreements |= 1 << source
+        count = req.agreements.bit_count()
+        if count < some_correct_quorum(self.network_config):
             return
         self.weak_requests[ack.digest] = req
-        if len(req.agreements) < intersection_quorum(self.network_config):
+        if count < intersection_quorum(self.network_config):
             return
         self.strong_requests[ack.digest] = req
 
@@ -436,18 +442,19 @@ class Client:
         # First-non-null-ack-is-binding rule (see module docstring): a replica
         # that already voted for a different non-null digest is ignored unless
         # the digest is known-correct (force).
+        bit = 1 << source
         if ack.digest and not force:
             existing = crn.requests.get(ack.digest)
-            already_voted_this = existing is not None and source in existing.agreements
-            if source in crn.non_null_voters and not already_voted_this:
+            already_voted_this = existing is not None and existing.agreements & bit
+            if crn.non_null_voters & bit and not already_voted_this:
                 return crn.client_req(ack)
 
         if ack.digest:
-            crn.non_null_voters.add(source)
+            crn.non_null_voters |= bit
 
         cr = crn.client_req(ack)
-        cr.agreements.add(source)
-        agreement_count = len(cr.agreements)
+        cr.agreements |= bit
+        agreement_count = cr.agreements.bit_count()
 
         newly_correct = agreement_count == self.weak_quorum
         if newly_correct:
@@ -759,7 +766,7 @@ class ClientHashDisseminator:
             return Actions()
         crn = client.req_no(req_no)
         data = crn.requests.get(digest)
-        if data is None or self.my_config.id not in data.agreements:
+        if data is None or not (data.agreements >> self.my_config.id) & 1:
             return Actions()
         return Actions().forward_request(
             (source,),

@@ -209,7 +209,7 @@ class Sequence:
                 missing = [
                     node
                     for node in self.network_config.nodes
-                    if node not in cr.agreements
+                    if not (cr.agreements >> node) & 1
                 ]
                 if missing:
                     actions.forward_request(missing, cr.ack)

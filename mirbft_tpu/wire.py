@@ -201,12 +201,27 @@ def _enc_obj(buf: bytearray, v: Any) -> None:
     codec.encode_fields(buf, v)
 
 
+_MAX_DECODE_DEPTH = 32  # deepest legitimate schema nesting is far shallower
+_decode_depth = 0
+
+
 def _dec_obj(view: memoryview, pos: int) -> Tuple[Any, int]:
+    # Depth guard: MsgBatch made the schema recursive (its element union
+    # contains Msg, which contains MsgBatch), so crafted bytes could
+    # otherwise nest thousands deep and surface as RecursionError instead of
+    # the ValueError ingress boundaries are hardened against.
+    global _decode_depth
     tag, pos = read_uvarint(view, pos)
     cls = _CLS_OF.get(tag)
     if cls is None:
         raise ValueError(f"unknown wire tag {tag}")
-    return _CODECS[cls].decode_fields(view, pos)
+    if _decode_depth >= _MAX_DECODE_DEPTH:
+        raise ValueError("wire object nesting exceeds permitted depth")
+    _decode_depth += 1
+    try:
+        return _CODECS[cls].decode_fields(view, pos)
+    finally:
+        _decode_depth -= 1
 
 
 def _make_checked_obj_codec(allowed: frozenset) -> Tuple[_Encoder, _Decoder]:

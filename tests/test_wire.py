@@ -171,3 +171,17 @@ def test_trailing_bytes_rejected():
     data = wire.encode(m.Suspect(epoch=1)) + b"\x00"
     with pytest.raises(ValueError):
         wire.decode(data)
+
+
+def test_deep_nesting_rejected():
+    # MsgBatch made the schema recursive; crafted bytes nesting thousands of
+    # envelopes must fail with ValueError (codec depth guard), not
+    # RecursionError.  Legitimate envelopes are depth 1.
+    tag = bytearray()
+    wire.write_uvarint(tag, wire._TAG_OF[m.MsgBatch])
+    tag.append(1)  # tuple count
+    payload = bytes(tag) * 3000 + wire.encode(m.Suspect(epoch=0))
+    with pytest.raises(ValueError):
+        wire.decode(payload)
+    env = m.MsgBatch(msgs=(m.Suspect(epoch=0),))
+    assert wire.decode(wire.encode(env)) == env
