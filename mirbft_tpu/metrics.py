@@ -1,0 +1,184 @@
+"""Lightweight runtime metrics: counters, gauges, and latency histograms.
+
+The reference has no metrics subsystem (its observability is the status
+snapshot and the event log; see ``pkg/status/status.go`` and
+``pkg/eventlog/``).  SURVEY.md §5 calls for adding counters here because the
+framework's headline numbers — committed req/s, crypto batch sizes, device
+dispatch latency — are continuous quantities a snapshot cannot capture.
+
+Design: a process-local registry of named instruments with zero hot-path
+allocation (counters are plain attribute increments; histograms append to a
+float list and summarize lazily).  No background threads, no exporters — a
+``snapshot()`` dict is the integration surface, consumable by tests, the
+bench harness, the node runtime's status output, or an external scraper.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, delta: int = 1) -> None:
+        self.value += delta
+
+
+class Gauge:
+    """Point-in-time value."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+
+class Histogram:
+    """Records observations; summarizes percentiles lazily.
+
+    Bounded: keeps the most recent ``max_samples`` observations (enough for
+    stable p50/p99 of a dispatch-latency stream without unbounded growth).
+    """
+
+    __slots__ = ("name", "samples", "max_samples", "total_count", "total_sum")
+
+    def __init__(self, name: str, max_samples: int = 4096):
+        self.name = name
+        self.samples: List[float] = []
+        self.max_samples = max_samples
+        self.total_count = 0
+        self.total_sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self.total_count += 1
+        self.total_sum += value
+        samples = self.samples
+        if len(samples) >= self.max_samples:
+            # Drop the oldest half in one slice (amortized O(1) per observe).
+            del samples[: self.max_samples // 2]
+        samples.append(value)
+
+    def percentile(self, q: float) -> float:
+        if not self.samples:
+            return 0.0
+        return float(np.percentile(np.asarray(self.samples), q))
+
+    def mean(self) -> float:
+        if self.total_count == 0:
+            return 0.0
+        return self.total_sum / self.total_count
+
+
+class Timer:
+    """Context manager recording elapsed seconds into a histogram."""
+
+    __slots__ = ("histogram", "_start")
+
+    def __init__(self, histogram: Histogram):
+        self.histogram = histogram
+        self._start = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.histogram.observe(time.perf_counter() - self._start)
+
+
+class Registry:
+    """Named instrument registry.  Instruments are created on first use and
+    shared thereafter; creation is locked, hot-path updates are not (CPython
+    attribute increments are atomic enough for monitoring data, matching the
+    design of mainstream client libraries)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
+    def histogram(self, name: str, max_samples: int = 4096) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(
+                    name, Histogram(name, max_samples)
+                )
+        return h
+
+    def timer(self, name: str) -> Timer:
+        return Timer(self.histogram(name))
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat name -> value dict; histograms expand to _mean/_p50/_p99/_count."""
+        out: Dict[str, float] = {}
+        for name, c in self._counters.items():
+            out[name] = c.value
+        for name, g in self._gauges.items():
+            out[name] = g.value
+        for name, h in self._histograms.items():
+            out[f"{name}_count"] = h.total_count
+            out[f"{name}_mean"] = h.mean()
+            out[f"{name}_p50"] = h.percentile(50)
+            out[f"{name}_p99"] = h.percentile(99)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+# Default process-wide registry (tests and embedders may build their own).
+default_registry = Registry()
+
+
+def counter(name: str) -> Counter:
+    return default_registry.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return default_registry.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return default_registry.histogram(name)
+
+
+def timer(name: str) -> Timer:
+    return default_registry.timer(name)
+
+
+def snapshot() -> Dict[str, float]:
+    return default_registry.snapshot()

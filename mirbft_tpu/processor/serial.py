@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from .. import metrics
 from .. import state as st
 from .. import messages as m
 from ..messages import CEntry, EpochConfig, FEntry, NetworkState, Persistent
@@ -165,7 +166,9 @@ def process_hash_actions(hasher: Hasher, actions: Actions) -> Events:
     events = Events()
     if not hash_actions:
         return events
-    digests = hasher.hash_batches([action.data for action in hash_actions])
+    metrics.histogram("hash_batch_size").observe(len(hash_actions))
+    with metrics.timer("hash_dispatch_seconds"):
+        digests = hasher.hash_batches([action.data for action in hash_actions])
     if len(digests) != len(hash_actions):
         raise AssertionError("hasher returned wrong number of digests")
     for action, digest in zip(hash_actions, digests):
@@ -177,9 +180,11 @@ def process_app_actions(app: App, actions: Actions) -> Events:
     """Commit / Checkpoint / StateTransfer execution
     (reference serial.go:200-244)."""
     events = Events()
+    committed = metrics.counter("committed_requests")
     for action in actions:
         if isinstance(action, st.ActionCommit):
             app.apply(action.batch)
+            committed.inc(len(action.batch.requests))
         elif isinstance(action, st.ActionCheckpoint):
             value, pending_reconfigs = app.snap(
                 action.network_config, action.client_states

@@ -477,6 +477,71 @@ class Client:
 
         return cr
 
+    def ack_run(
+        self, actions: Actions, source: int, acks: List[RequestAck], start: int
+    ) -> int:
+        """Apply a run of in-window acks from one source for this client,
+        beginning at ``acks[start]``; returns the index after the run.
+
+        Semantically a loop of ack_into; the common case (non-null digest,
+        source not previously bound elsewhere, no quorum crossing) is inlined
+        with hoisted locals because at N replicas this loop runs O(N²) times
+        per request cluster-wide."""
+        req_nos = self.req_nos
+        bit = 1 << source
+        weak_q = self.weak_quorum
+        strong_q = self.strong_quorum
+        low = self.client_state.low_watermark
+        high = self.high_watermark
+        client_id = acks[start].client_id
+        n = len(acks)
+        i = start
+        while i < n:
+            ack = acks[i]
+            if ack.client_id != client_id:
+                break
+            req_no = ack.req_no
+            if req_no < low or req_no > high:
+                break
+            i += 1
+            digest = ack.digest
+            crn = req_nos.get(req_no)
+            if digest and crn.non_null_voters & bit:
+                existing = crn.requests.get(digest)
+                if existing is None:
+                    # Bound to a different digest: vote ignored, but the
+                    # candidate is still registered (as in ack_into).
+                    crn.requests[digest] = ClientRequest(ack)
+                    continue
+                if not existing.agreements & bit:
+                    continue  # bound to a different digest: ignored
+                cr = existing
+            else:
+                if digest:
+                    crn.non_null_voters |= bit
+                cr = crn.requests.get(digest)
+                if cr is None:
+                    cr = ClientRequest(ack)
+                    crn.requests[digest] = cr
+            votes = cr.agreements | bit
+            cr.agreements = votes
+            count = votes.bit_count()
+            if count < weak_q:
+                continue
+            # Quorum-relevant tail: rare, shared with ack_into's logic.
+            newly_correct = count == weak_q
+            if newly_correct:
+                crn.weak_requests[digest] = cr
+                if not cr.stored:
+                    actions.correct_request(ack)
+                self._update_attention(crn)
+            if cr.stored and (newly_correct or source == self.my_config.id):
+                self.client_tracker.add_available(ack)
+            if count == strong_q:
+                crn.strong_requests[digest] = cr
+                self.advance_ready()
+        return i
+
     def in_watermarks(self, req_no: int) -> bool:
         return self.client_state.low_watermark <= req_no <= self.high_watermark
 
@@ -691,18 +756,27 @@ class ClientHashDisseminator:
             # this is the cluster's hottest message path.
             actions = Actions()
             clients = self.clients
-            for ack in msg.acks:
+            acks = msg.acks
+            n = len(acks)
+            i = 0
+            while i < n:
+                ack = acks[i]
                 client = clients.get(ack.client_id)
                 if client is None:
                     self.msg_buffers[source].store(AckMsg(ack=ack))  # FUTURE
+                    i += 1
                     continue
                 req_no = ack.req_no
                 if client.client_state.low_watermark > req_no:
+                    i += 1
                     continue  # PAST
                 if client.high_watermark < req_no:
                     self.msg_buffers[source].store(AckMsg(ack=ack))  # FUTURE
+                    i += 1
                     continue
-                client.ack_into(actions, source, ack)
+                # In-window: hand the whole same-client in-window run to the
+                # client's inlined loop.
+                i = client.ack_run(actions, source, acks, i)
             return actions
         verdict = self.filter(source, msg)
         if verdict == Applyable.PAST:

@@ -25,6 +25,7 @@ wire format: append only, never renumber.
 from __future__ import annotations
 
 import io
+import threading
 import typing
 from dataclasses import fields, is_dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -202,7 +203,7 @@ def _enc_obj(buf: bytearray, v: Any) -> None:
 
 
 _MAX_DECODE_DEPTH = 32  # deepest legitimate schema nesting is far shallower
-_decode_depth = 0
+_decode_state = threading.local()  # per-thread: concurrent decodes must not interact
 
 
 def _dec_obj(view: memoryview, pos: int) -> Tuple[Any, int]:
@@ -210,18 +211,18 @@ def _dec_obj(view: memoryview, pos: int) -> Tuple[Any, int]:
     # contains Msg, which contains MsgBatch), so crafted bytes could
     # otherwise nest thousands deep and surface as RecursionError instead of
     # the ValueError ingress boundaries are hardened against.
-    global _decode_depth
     tag, pos = read_uvarint(view, pos)
     cls = _CLS_OF.get(tag)
     if cls is None:
         raise ValueError(f"unknown wire tag {tag}")
-    if _decode_depth >= _MAX_DECODE_DEPTH:
+    depth = getattr(_decode_state, "depth", 0)
+    if depth >= _MAX_DECODE_DEPTH:
         raise ValueError("wire object nesting exceeds permitted depth")
-    _decode_depth += 1
+    _decode_state.depth = depth + 1
     try:
         return _CODECS[cls].decode_fields(view, pos)
     finally:
-        _decode_depth -= 1
+        _decode_state.depth = depth
 
 
 def _make_checked_obj_codec(allowed: frozenset) -> Tuple[_Encoder, _Decoder]:

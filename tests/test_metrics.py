@@ -1,0 +1,49 @@
+"""Metrics subsystem: instruments, snapshots, and hot-path integration."""
+
+from mirbft_tpu import metrics
+
+
+def test_counter_gauge_histogram():
+    reg = metrics.Registry()
+    reg.counter("a").inc()
+    reg.counter("a").inc(4)
+    reg.gauge("g").set(2.5)
+    h = reg.histogram("h")
+    for v in range(100):
+        h.observe(float(v))
+    snap = reg.snapshot()
+    assert snap["a"] == 5
+    assert snap["g"] == 2.5
+    assert snap["h_count"] == 100
+    assert snap["h_p50"] == 49.5
+    assert snap["h_mean"] == 49.5
+
+
+def test_histogram_bounded():
+    h = metrics.Histogram("x", max_samples=64)
+    for v in range(1000):
+        h.observe(float(v))
+    assert len(h.samples) <= 64
+    assert h.total_count == 1000
+    # recent window dominates the percentile
+    assert h.percentile(50) > 900
+
+
+def test_timer_records():
+    reg = metrics.Registry()
+    with reg.timer("t"):
+        pass
+    assert reg.snapshot()["t_count"] == 1
+
+
+def test_engine_run_populates_default_registry():
+    metrics.default_registry.reset()
+    from mirbft_tpu.testengine import Spec
+
+    spec = Spec(node_count=1, client_count=1, reqs_per_client=5, batch_size=1)
+    recording = spec.recorder().recording()
+    recording.drain_clients(timeout=100000)
+    snap = metrics.snapshot()
+    assert snap["committed_requests"] >= 5
+    assert snap["hash_batch_size_count"] > 0
+    assert snap["hash_dispatch_seconds_p99"] > 0
