@@ -1,0 +1,145 @@
+"""Batched SHA-256 as a Pallas TPU kernel.
+
+Drop-in alternative backend to the pure-JAX ``sha256_batch_kernel``
+(``mirbft_tpu/ops/sha256.py``): same [B, L, 16] uint32 blocks / [B] n_blocks
+contract, same digests.  Where the vmapped ``lax.scan`` version leaves
+scheduling to XLA, this kernel pins the whole compression loop into VMEM and
+runs the batch dimension across VPU lanes explicitly:
+
+* grid over batch tiles of ``TILE`` messages; each program holds its tile's
+  blocks (TILE × L × 16 words) and digest state entirely in VMEM — no HBM
+  traffic inside the round loop;
+* the eight working variables are (TILE,)-shaped uint32 vectors, so every
+  round is a handful of VPU ops over the full tile;
+* the per-message block count is handled with a ``jnp.where`` on the block
+  index (rows shorter than the bucket length carry their state unchanged),
+  exactly like the scan version, so one compiled variant serves a whole
+  (tile, L) bucket.
+
+SHA-256 is pure uint32 bitwise/rotate/add arithmetic — no MXU work — so the
+win over the XLA-scheduled version is locality (state never leaves VMEM) and
+the removal of scan/vmap loop machinery.
+
+Reference parity: replaces the streaming ``crypto.SHA256`` hasher behind the
+reference's ``Hasher`` interface (``pkg/processor/serial.go:21-23,180-198``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .sha256 import _H0, _K  # round constants / initial state (FIPS 180-4)
+
+TILE = 256  # messages per grid program; multiple of the 128-lane VPU width
+
+
+def _rotr(x: jnp.ndarray, r: int) -> jnp.ndarray:
+    return (x >> np.uint32(r)) | (x << np.uint32(32 - r))
+
+
+def _sha256_tile_kernel(blocks_ref, n_blocks_ref, out_ref, *, n_block_bucket):
+    """One tile: blocks_ref (TILE, L, 16) uint32 -> out_ref (TILE, 8).
+
+    The block dimension runs as a ``fori_loop`` (so the traced program holds
+    the 64 rounds exactly once regardless of bucket length); the 64 rounds
+    are unrolled with a rolling 16-word schedule window, so only 16 (TILE,)
+    vectors are live at a time."""
+    n_blocks = n_blocks_ref[:, 0]  # (TILE,) uint32
+
+    def block_step(b, state):
+        slab = pl.load(
+            blocks_ref, (slice(None), pl.ds(b, 1), slice(None))
+        )  # (TILE, 1, 16)
+        w2 = [slab[:, 0, t] for t in range(16)]
+        a, b_, c, d, e, f, g, h = state
+        for t in range(64):
+            if t < 16:
+                wt = w2[t]
+            else:
+                s0 = _rotr(w2[t - 15 & 15], 7) ^ _rotr(w2[t - 15 & 15], 18) ^ (
+                    w2[t - 15 & 15] >> np.uint32(3)
+                )
+                s1 = _rotr(w2[t - 2 & 15], 17) ^ _rotr(w2[t - 2 & 15], 19) ^ (
+                    w2[t - 2 & 15] >> np.uint32(10)
+                )
+                wt = w2[t & 15] + s0 + w2[t - 7 & 15] + s1
+                w2[t & 15] = wt
+            S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+            ch = (e & f) ^ (~e & g)
+            temp1 = h + S1 + ch + np.uint32(_K[t]) + wt
+            S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+            maj = (a & b_) ^ (a & c) ^ (b_ & c)
+            temp2 = S0 + maj
+            h = g
+            g = f
+            f = e
+            e = d + temp1
+            d = c
+            c = b_
+            b_ = a
+            a = temp1 + temp2
+        live = n_blocks > b.astype(jnp.uint32)  # short rows carry state
+        new = (a, b_, c, d, e, f, g, h)
+        return tuple(
+            jnp.where(live, state[i] + new[i], state[i]) for i in range(8)
+        )
+
+    state = tuple(
+        jnp.full((TILE,), np.uint32(_H0[i]), dtype=jnp.uint32) for i in range(8)
+    )
+    state = jax.lax.fori_loop(0, n_block_bucket, block_step, state)
+
+    for i in range(8):
+        out_ref[:, i] = state[i]
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled(batch: int, n_block_bucket: int, interpret: bool):
+    if batch % TILE != 0:
+        raise ValueError(f"batch {batch} must be a multiple of {TILE}")
+    grid = (batch // TILE,)
+    kernel = functools.partial(
+        _sha256_tile_kernel, n_block_bucket=n_block_bucket
+    )
+    return jax.jit(
+        pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (TILE, n_block_bucket, 16),
+                    lambda i: (i, 0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec((TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (TILE, 8), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((batch, 8), jnp.uint32),
+            interpret=interpret,
+        )
+    )
+
+
+def sha256_batch_kernel_pallas(
+    blocks: jnp.ndarray, n_blocks: jnp.ndarray, *, interpret: bool = False
+) -> jnp.ndarray:
+    """Pallas twin of ``sha256.sha256_batch_kernel``: blocks [B, L, 16]
+    uint32, n_blocks [B] -> [B, 8] digests.  B is padded up to a TILE
+    multiple internally; pass ``interpret=True`` off-TPU (tests)."""
+    batch = blocks.shape[0]
+    padded = ((batch + TILE - 1) // TILE) * TILE
+    if padded != batch:
+        blocks = jnp.pad(blocks, ((0, padded - batch), (0, 0), (0, 0)))
+        n_blocks = jnp.pad(n_blocks, (0, padded - batch))
+    fn = _compiled(padded, blocks.shape[1], interpret)
+    out = fn(blocks, n_blocks.reshape(padded, 1).astype(jnp.uint32))
+    return out[:batch]
