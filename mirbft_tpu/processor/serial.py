@@ -89,6 +89,10 @@ def process_wal_actions(wal: WAL, actions: Actions) -> Actions:
     return net_actions
 
 
+def _ack_sort_key(ack: m.RequestAck):
+    return (ack.client_id, ack.req_no)
+
+
 def _coalesce_sends(actions: Actions) -> List[st.ActionSend]:
     """Aggregate this iteration's sends per target set: AckMsg/AckBatch
     sends merge into one AckBatch, and if a target set still has more than
@@ -124,6 +128,11 @@ def _coalesce_sends(actions: Actions) -> List[st.ActionSend]:
             slot[1].append(msg)
     for targets, (index, msgs, acks) in groups.items():
         if acks:
+            # Sort the merged batch by (client, req_no): the receiver's
+            # disseminator consumes same-client in-window runs in one inlined
+            # loop, so grouping maximizes run length.  Deterministic, and
+            # order within an envelope carries no protocol meaning.
+            acks.sort(key=_ack_sort_key)
             msgs.append(
                 m.AckMsg(ack=acks[0])
                 if len(acks) == 1

@@ -87,6 +87,10 @@ class ActiveEpoch:
         "lowest_unallocated",
         "last_committed_at_tick",
         "ticks_since_progress",
+        "_nb",
+        "_ci",
+        "_owned_buckets",
+        "_buffered",
     )
 
     def __init__(
@@ -115,6 +119,14 @@ class ActiveEpoch:
         self.buckets = assign_buckets(epoch_config, network_config)
 
         num_buckets = len(self.buckets)
+        self._nb = num_buckets
+        self._ci = network_config.checkpoint_interval
+        self._owned_buckets = [
+            b for b in range(num_buckets) if self.buckets[b] == my_config.id
+        ]
+        # Shared live count of messages parked in this epoch's buffers, so the
+        # per-event drain scan is O(1) when nothing is parked.
+        self._buffered = [0]
         self.lowest_unallocated = [0] * num_buckets
         for i in range(num_buckets):
             first_seq_no = starting_seq_no + i + 1
@@ -139,6 +151,7 @@ class ActiveEpoch:
                 buffer=MsgBuffer(
                     f"epoch-{epoch_config.number}-preprepare",
                     node_buffers.node_buffer(self.buckets[i]),
+                    group=self._buffered,
                 ),
             )
             for i in range(num_buckets)
@@ -147,6 +160,7 @@ class ActiveEpoch:
             node: MsgBuffer(
                 f"epoch-{epoch_config.number}-other",
                 node_buffers.node_buffer(node),
+                group=self._buffered,
             )
             for node in network_config.nodes
         }
@@ -184,6 +198,9 @@ class ActiveEpoch:
     # --- message filtering (reference epoch_active.go:142-213) ---
 
     def filter(self, source: int, msg: Msg) -> Applyable:
+        # NOTE: the Prepare/Commit arms are duplicated (fused with their
+        # apply step) in _step_prepare/_step_commit for the live hot path;
+        # any rule change here must be mirrored there.
         if isinstance(msg, Preprepare):
             seq_no = msg.seq_no
             bucket = self.seq_to_bucket(seq_no)
@@ -248,6 +265,14 @@ class ActiveEpoch:
         return actions
 
     def step(self, source: int, msg: Msg) -> Actions:
+        # Prepare/Commit are the cluster's two hottest message types (O(n)
+        # per sequence per replica): fused filter+apply handlers below skip
+        # the generic two-pass classification.
+        t = msg.__class__
+        if t is Prepare:
+            return self._step_prepare(source, msg)
+        if t is Commit:
+            return self._step_commit(source, msg)
         verdict = self.filter(source, msg)
         if verdict == Applyable.CURRENT:
             return self.apply(source, msg)
@@ -259,6 +284,63 @@ class ActiveEpoch:
                 self.other_buffers[source].store(msg)
         # PAST / INVALID: drop
         return Actions()
+
+    def _step_prepare(self, source: int, msg: Prepare) -> Actions:
+        """filter()+apply() for a Prepare, in one pass (same verdicts)."""
+        seq_no = msg.seq_no
+        if self.buckets[seq_no % self._nb] == source:
+            return Actions()  # INVALID: owners never send Prepare
+        if seq_no > self.epoch_config.planned_expiration:
+            return Actions()  # INVALID
+        seqs = self.sequences
+        low = seqs[0][0].seq_no
+        if seq_no < low:
+            return Actions()  # PAST
+        if seq_no > seqs[-1][-1].seq_no:
+            self.other_buffers[source].store(msg)  # FUTURE
+            return Actions()
+        offset = seq_no - low
+        seq = seqs[offset // self._ci][offset % self._ci]
+        return seq.apply_prepare_msg(source, msg.digest)
+
+    def _step_commit(self, source: int, msg: Commit) -> Actions:
+        """filter()+apply() for a Commit, in one pass (same verdicts),
+        including the in-order commit cascade into CommitState."""
+        seq_no = msg.seq_no
+        if seq_no > self.epoch_config.planned_expiration:
+            return Actions()  # INVALID
+        seqs = self.sequences
+        low = seqs[0][0].seq_no
+        if seq_no < low:
+            return Actions()  # PAST
+        high = seqs[-1][-1].seq_no
+        if seq_no > high:
+            self.other_buffers[source].store(msg)  # FUTURE
+            return Actions()
+        offset = seq_no - low
+        seq = seqs[offset // self._ci][offset % self._ci]
+        seq.apply_commit_msg(source, msg.digest)
+        if seq.state is not SeqState.COMMITTED or seq_no != self.lowest_uncommitted:
+            return Actions()
+        self._commit_cascade()
+        return Actions()
+
+    def _commit_cascade(self) -> None:
+        """Feed consecutive committed sequences into CommitState, in order."""
+        seqs = self.sequences
+        low = seqs[0][0].seq_no
+        high = seqs[-1][-1].seq_no
+        ci = self._ci
+        lowest = self.lowest_uncommitted
+        commit = self.commit_state.commit
+        while lowest <= high:
+            offset = lowest - low
+            seq = seqs[offset // ci][offset % ci]
+            if seq.state is not SeqState.COMMITTED:
+                break
+            commit(seq.q_entry)
+            lowest += 1
+        self.lowest_uncommitted = lowest
 
     # --- three-phase message application ---
 
@@ -295,13 +377,7 @@ class ActiveEpoch:
         seq.apply_commit_msg(source, digest)
         if seq.state != SeqState.COMMITTED or seq_no != self.lowest_uncommitted:
             return Actions()
-
-        while self.lowest_uncommitted <= self.high_watermark():
-            seq = self.sequence(self.lowest_uncommitted)
-            if seq.state != SeqState.COMMITTED:
-                break
-            self.commit_state.commit(seq.q_entry)
-            self.lowest_uncommitted += 1
+        self._commit_cascade()
         return Actions()
 
     def apply_batch_hash_result(self, seq_no: int, digest: bytes) -> Actions:
@@ -328,6 +404,8 @@ class ActiveEpoch:
     def drain_buffers(self) -> Actions:
         """Reference epoch_active.go:339-366."""
         actions = Actions()
+        if not self._buffered[0]:
+            return actions  # nothing parked anywhere in this epoch
         for bucket in range(len(self.buckets)):
             buffer = self.preprepare_buffers[bucket]
             if not buffer.buffer:
@@ -341,7 +419,7 @@ class ActiveEpoch:
 
         for node in self.network_config.nodes:
             other = self.other_buffers[node]
-            if not other:
+            if not other.buffer:
                 continue
             other.iterate(
                 self.filter,
@@ -387,9 +465,7 @@ class ActiveEpoch:
 
         self.proposer.advance(self.lowest_uncommitted)
 
-        for bucket in range(self.network_config.number_of_buckets):
-            if self.buckets[bucket] != self.my_config.id:
-                continue
+        for bucket in self._owned_buckets:
             prb = self.proposer.proposal_bucket(bucket)
             while True:
                 seq_no = self.lowest_unallocated[bucket]
@@ -425,10 +501,9 @@ class ActiveEpoch:
             return actions
 
         # Heartbeat: cut a partial (possibly null) batch in every owned bucket.
-        for bucket, unallocated_seq_no in enumerate(self.lowest_unallocated):
+        for bucket in self._owned_buckets:
+            unallocated_seq_no = self.lowest_unallocated[bucket]
             if unallocated_seq_no > self.high_watermark():
-                continue
-            if self.buckets[bucket] != self.my_config.id:
                 continue
             seq = self.sequence(unallocated_seq_no)
             prb = self.proposer.proposal_bucket(bucket)

@@ -47,9 +47,12 @@ from .checkpoints import CheckpointState, CheckpointTracker
 from .client_tracker import ClientTracker
 from .commitstate import CommitState
 from .disseminator import ClientHashDisseminator
+from .epoch_target import EpochTargetState
 from .epoch_tracker import EpochTracker
 from .msgbuffers import NodeBuffers
 from .persisted import PersistedLog
+
+_ET_IN_PROGRESS = EpochTargetState.IN_PROGRESS
 
 
 class MachineState(enum.IntEnum):
@@ -293,7 +296,21 @@ class StateMachine:
     # --- message routing (reference state_machine.go:310-349) ---
 
     def step(self, source: int, msg: Msg) -> Actions:
-        if isinstance(msg, MsgBatch):
+        t = msg.__class__
+        if t is Prepare or t is Commit:
+            # Hot path: three-phase-commit traffic for the current in-progress
+            # epoch goes straight to the active epoch, skipping the
+            # tracker/target routing hops (same classification outcome).
+            target = self.epoch_tracker.current_epoch
+            if (
+                msg.epoch == target.number
+                and target.state is _ET_IN_PROGRESS
+            ):
+                return target.active_epoch.step(source, msg)
+            return self.epoch_tracker.step(source, msg)
+        if t is AckBatch or t is AckMsg or t is FetchRequest or t is ForwardRequest:
+            return self.client_hash_disseminator.step(source, msg)
+        if t is MsgBatch:
             # Transport envelope: process contents in order as one event
             # (the post-event fixpoint in apply_event runs once for the
             # whole envelope, which is where the amortization comes from).
@@ -301,12 +318,10 @@ class StateMachine:
             for inner in msg.msgs:
                 actions.concat(self.step(source, inner))
             return actions
-        if isinstance(msg, (AckMsg, AckBatch, FetchRequest, ForwardRequest)):
-            return self.client_hash_disseminator.step(source, msg)
-        if isinstance(msg, CheckpointMsg):
+        if t is CheckpointMsg:
             self.checkpoint_tracker.step(source, msg)
             return Actions()
-        if isinstance(msg, (FetchBatch, ForwardBatch)):
+        if t is FetchBatch or t is ForwardBatch:
             return self.batch_tracker.step(source, msg)
         if isinstance(
             msg,
@@ -318,8 +333,6 @@ class StateMachine:
                 NewEpochEcho,
                 NewEpochReady,
                 Preprepare,
-                Prepare,
-                Commit,
             ),
         ):
             return self.epoch_tracker.step(source, msg)
@@ -343,8 +356,6 @@ class StateMachine:
         if isinstance(origin, st.VerifyBatchOrigin):
             actions = Actions()
             self.batch_tracker.apply_verify_batch_hash_result(event.digest, origin)
-            from .epoch_target import EpochTargetState
-
             if (
                 not self.batch_tracker.has_fetch_in_flight()
                 and self.epoch_tracker.current_epoch.state

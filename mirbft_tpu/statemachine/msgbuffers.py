@@ -79,19 +79,24 @@ class MsgBuffer:
     """One component's buffer of not-yet-applyable messages from one peer
     (reference msgbuffers.go:121-226)."""
 
-    __slots__ = ("component", "buffer", "node_buffer")
+    __slots__ = ("component", "buffer", "node_buffer", "group")
 
-    def __init__(self, component: str, node_buffer: NodeBuffer):
+    def __init__(self, component: str, node_buffer: NodeBuffer, group=None):
         self.component = component
         # deque of (msg, cached wire size)
         self.buffer: Deque[Tuple[Msg, int]] = deque()
         self.node_buffer = node_buffer
+        # Optional shared one-element counter cell: the owner's live message
+        # count across a group of buffers (lets it skip drain scans cheaply).
+        self.group = group
 
     def store(self, msg: Msg) -> None:
         # Over budget: drop our own oldest first (see reference's fairness
         # note, msgbuffers.go:146-151).
         while self.node_buffer.over_capacity() and self.buffer:
             old_msg, old_size = self.buffer.popleft()
+            if self.group is not None:
+                self.group[0] -= 1
             self.node_buffer._msg_removed(old_size)
             self._deregister_if_empty()
             if self.node_buffer.logger is not None:
@@ -104,6 +109,8 @@ class MsgBuffer:
         if not self.buffer:
             self.node_buffer.msg_bufs.append(self)
         self.buffer.append((msg, size))
+        if self.group is not None:
+            self.group[0] += 1
         self.node_buffer._msg_stored(size)
 
     def _deregister_if_empty(self) -> None:
@@ -124,6 +131,8 @@ class MsgBuffer:
                 i += 1
                 continue
             del self.buffer[i]
+            if self.group is not None:
+                self.group[0] -= 1
             self.node_buffer._msg_removed(size)
             self._deregister_if_empty()
             if verdict == Applyable.CURRENT:
@@ -142,6 +151,8 @@ class MsgBuffer:
                 i += 1
                 continue
             del self.buffer[i]
+            if self.group is not None:
+                self.group[0] -= 1
             self.node_buffer._msg_removed(size)
             self._deregister_if_empty()
             if verdict == Applyable.CURRENT:

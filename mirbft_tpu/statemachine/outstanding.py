@@ -66,14 +66,10 @@ class AllOutstandingReqs:
             clients: Dict[int, ClientOutstandingReqs] = {}
             self.buckets[bucket] = clients
             for client in network_state.clients:
-                first_uncommitted = 0
-                for j in range(num_buckets):
-                    req_no = client.low_watermark + j
-                    if client_req_to_bucket(
-                        client.id, req_no, network_state.config
-                    ) == bucket:
-                        first_uncommitted = req_no
-                        break
+                # First req_no ≥ low_watermark mapping into this bucket:
+                # solve (client_id + req_no) ≡ bucket (mod num_buckets).
+                lw = client.low_watermark
+                first_uncommitted = lw + (bucket - client.id - lw) % num_buckets
                 cors = ClientOutstandingReqs(
                     next_req_no=first_uncommitted,
                     num_buckets=num_buckets,

@@ -38,20 +38,6 @@ class SeqState(enum.IntEnum):
     COMMITTED = 6
 
 
-class NodeSeqState(enum.IntEnum):
-    UNINITIALIZED = 0
-    PREPREPARED = 1
-    PREPARED = 2
-
-
-class _NodeChoice:
-    __slots__ = ("state", "digest")
-
-    def __init__(self):
-        self.state = NodeSeqState.UNINITIALIZED
-        self.digest: Optional[bytes] = None
-
-
 class Sequence:
     """One in-flight sequence number within an active epoch."""
 
@@ -68,9 +54,12 @@ class Sequence:
         "batch",
         "outstanding_reqs",
         "digest",
-        "node_choices",
+        "prep_mask",
+        "commit_mask",
+        "my_prepare_digest",
         "prepares",
         "commits",
+        "_iq",
     )
 
     def __init__(
@@ -94,16 +83,17 @@ class Sequence:
         self.batch: List[RequestAck] = []
         self.outstanding_reqs: Optional[Set[RequestAck]] = None
         self.digest: Optional[bytes] = None
-        self.node_choices: Dict[int, _NodeChoice] = {}
+        # Per-node vote tracking as replica-id bitmasks (a node's "seq choice
+        # state" in the reference is derivable: prepare recorded ⇔ bit in
+        # prep_mask|commit_mask; commit recorded ⇔ bit in commit_mask).
+        self.prep_mask = 0
+        self.commit_mask = 0
+        # The digest carried by our own prepare — the only per-node digest
+        # the quorum checks ever read back.
+        self.my_prepare_digest: Optional[bytes] = None
         self.prepares: Dict[bytes, int] = {}
         self.commits: Dict[bytes, int] = {}
-
-    def _node_choice(self, source: int) -> _NodeChoice:
-        choice = self.node_choices.get(source)
-        if choice is None:
-            choice = _NodeChoice()
-            self.node_choices[source] = choice
-        return choice
+        self._iq = intersection_quorum(network_config)
 
     # --- driver ---
 
@@ -237,14 +227,27 @@ class Sequence:
         loopback BOTH increment the prepare count (its dup-check is
         ``source != owner`` only), letting a leader count itself twice toward
         the 2f+1 prepare certificate.  We count each node at most once."""
-        choice = self._node_choice(source)
-        if choice.state > NodeSeqState.UNINITIALIZED:
+        bit = 1 << source
+        if (self.prep_mask | self.commit_mask) & bit:
             return Actions()
-        choice.state = NodeSeqState.PREPREPARED
-        choice.digest = digest
+        self.prep_mask |= bit
+        if source == self.my_id:
+            self.my_prepare_digest = digest
         key = digest if digest is not None else b""
-        self.prepares[key] = self.prepares.get(key, 0) + 1
-        return self.advance_state()
+        count = self.prepares.get(key, 0) + 1
+        self.prepares[key] = count
+        # advance_state can only do work here when the prepare quorum on the
+        # incremented digest is reachable (PREPREPARED) or when this is the
+        # digest-arrival path (READY/PENDING_REQUESTS); every other state's
+        # transitions do not read prepare votes, so skip the fixpoint walk.
+        state = self.state
+        if state is SeqState.PREPREPARED:
+            if count >= self._iq:
+                return self.advance_state()
+            return Actions()
+        if state is SeqState.READY or state is SeqState.PENDING_REQUESTS:
+            return self.advance_state()
+        return Actions()
 
     def _check_prepare_quorum(self) -> Actions:
         """2f+1 prepares (leader's preprepare counts) + own prepare persisted
@@ -252,16 +255,17 @@ class Sequence:
         my_key = self.digest if self.digest is not None else b""
         agreements = self.prepares.get(my_key, 0)
 
-        my_choice = self._node_choice(self.my_id)
-        if my_choice.state < NodeSeqState.PREPREPARED:
+        if not ((self.prep_mask | self.commit_mask) >> self.my_id) & 1:
             # Have not sent our own prepare → QEntry may not be persisted.
             return Actions()
-        my_digest = my_choice.digest if my_choice.digest is not None else b""
+        my_digest = (
+            self.my_prepare_digest if self.my_prepare_digest is not None else b""
+        )
         if my_digest != my_key:
             # Network's correct digest differs from ours; do not prepare.
             return Actions()
 
-        if agreements < intersection_quorum(self.network_config):
+        if agreements < self._iq:
             return Actions()
 
         self.state = SeqState.PREPARED
@@ -273,21 +277,25 @@ class Sequence:
 
     def apply_commit_msg(self, source: int, digest: Optional[bytes]) -> Actions:
         """Reference sequence.go:320-337."""
-        choice = self._node_choice(source)
-        if choice.state > NodeSeqState.PREPREPARED:
+        bit = 1 << source
+        if self.commit_mask & bit:
             return Actions()  # duplicate commit
-        choice.state = NodeSeqState.PREPARED
+        self.commit_mask |= bit
         key = digest if digest is not None else b""
-        self.commits[key] = self.commits.get(key, 0) + 1
-        return self.advance_state()
+        count = self.commits.get(key, 0) + 1
+        self.commits[key] = count
+        # Only a PREPARED sequence with a reachable commit quorum can
+        # transition on a commit vote (commit emission itself is action-free).
+        if self.state is SeqState.PREPARED and count >= self._iq:
+            self._check_commit_quorum()
+        return Actions()
 
     def _check_commit_quorum(self) -> None:
         """Reference sequence.go:339-355."""
         my_key = self.digest if self.digest is not None else b""
         agreements = self.commits.get(my_key, 0)
-        my_choice = self._node_choice(self.my_id)
-        if my_choice.state < NodeSeqState.PREPARED:
+        if not (self.commit_mask >> self.my_id) & 1:
             return  # our own Commit (and thus PEntry persist) not sent yet
-        if agreements < intersection_quorum(self.network_config):
+        if agreements < self._iq:
             return
         self.state = SeqState.COMMITTED

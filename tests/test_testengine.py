@@ -16,7 +16,7 @@ from mirbft_tpu.testengine import After, For, Spec, Until, matching
 # scheduler shows up here first.  (Reference pins: 67 and 43,950 steps.)
 PIN_1N1C3R_STEPS = 67
 PIN_4N4C200R_STEPS = 10082
-PIN_4N4C200R_HASH = "80515a8dd4e1db6039edfcfe6d7339034beac36b031799d35778f7359534a132"
+PIN_4N4C200R_HASH = "2eb5b236aea8b0879391124c6015896f3795ea3977f774e00ad1a44a5da9957a"
 PIN_4N4C200R_EPOCH = 4
 
 
