@@ -1,0 +1,74 @@
+"""Native (C++) hot-path planes, built on first import.
+
+The consensus state machine stays in Python (branchy protocol logic — see
+SURVEY.md §7), but the per-message vote-accumulation hot loops run O(N²)
+times per request cluster-wide and dominate wall-clock at 64+ replicas, so
+they are implemented natively.  Rules of engagement:
+
+* Pure-Python equivalents remain in ``mirbft_tpu/statemachine/`` and are the
+  semantic reference; differential tests assert byte-identical behavior.
+* The extension is optional: if no toolchain is available (or
+  ``MIRBFT_TPU_NATIVE=0``), everything runs pure-Python.
+* Built with a direct ``g++`` invocation (no setuptools machinery, no
+  pybind11 — neither is guaranteed in the image); the .so is cached next to
+  the source and rebuilt when the source is newer.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+available = False
+core = None
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "ackplane.cpp")
+_SO = os.path.join(_HERE, "_core.so")
+
+
+def _build() -> bool:
+    include = sysconfig.get_paths()["include"]
+    tmp = _SO + ".tmp"
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        "-I", include, _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return False
+    os.replace(tmp, _SO)  # atomic: concurrent builders race benignly
+    return True
+
+
+def _load() -> None:
+    global available, core
+    if os.environ.get("MIRBFT_TPU_NATIVE", "1") == "0":
+        return
+    try:
+        needs_build = (not os.path.exists(_SO)) or (
+            os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        )
+    except OSError:
+        needs_build = True
+    if needs_build and not _build():
+        return
+    try:
+        from . import _core as _core_mod  # type: ignore
+    except ImportError:
+        # A stale ABI-incompatible artifact: rebuild once.
+        if not _build():
+            return
+        try:
+            from . import _core as _core_mod  # type: ignore
+        except ImportError:
+            return
+    core = _core_mod
+    available = True
+
+
+_load()

@@ -1,0 +1,593 @@
+// Native ack-vote plane: the O(N^2)-per-request hot path of the client
+// request dissemination protocol (reference pkg/statemachine/
+// client_hash_disseminator.go:806-876), reduced to packed bitmask
+// accumulation in C.
+//
+// Design contract with mirbft_tpu/statemachine/disseminator.py:
+//
+//  * The plane owns vote accumulation ONLY for the green path of a
+//    (client, req_no): every observed ack carries the same single non-null
+//    digest ("canonical").  Anything else — a null digest, a second distinct
+//    digest, a forced ack, a buffered-replay ack — is returned to Python
+//    ("pyfall"), which EJECTS the slot (syncs the native mask into the
+//    Python ClientRequest objects and marks the slot ejected) and runs the
+//    exact reference semantics from then on.
+//
+//  * Quorum crossings are returned as records and REPLAYED by Python
+//    through the same tail logic as the pure-Python path, preserving action
+//    order and content exactly.  The crossing condition mirrors
+//    Client.ack_into / Client.ack_run: emit when count == weak_q, when
+//    count == strong_q, or when source == my_id and count >= weak_q —
+//    including for duplicate votes (a duplicate arriving while the count
+//    sits at a threshold re-runs the tail in the reference semantics, so it
+//    must here too).
+//
+//  * Digests are interned process-wide: digest bytes <-> int32 id.  Ids
+//    never leave the process and never enter hashes or the wire format.
+//
+// No external dependencies; CPython C API only (the environment provides no
+// pybind11 — see repo docs/tpu_plane.md).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Digest interning (module-global).
+
+struct BytesKey {
+    std::string data;
+    bool operator==(const BytesKey &o) const { return data == o.data; }
+};
+
+struct BytesKeyHash {
+    size_t operator()(const BytesKey &k) const {
+        return std::hash<std::string>()(k.data);
+    }
+};
+
+struct InternTable {
+    std::unordered_map<BytesKey, int32_t, BytesKeyHash> ids;
+    std::vector<PyObject *> objects;  // id -> bytes object (owned ref)
+    size_t cap = 1u << 20;  // bound on distinct digests held native
+
+    // Returns the digest id, or -1 when the digest cannot be owned
+    // natively: the null digest, or the table is at capacity.  -1 routes
+    // the ack to the Python path, which works from the original bytes —
+    // correctness is unaffected, the native fast path just stops covering
+    // new digests (memory stays bounded against digest-flooding peers).
+    // -2 signals a Python error.
+    int32_t intern(PyObject *bytes_obj) {
+        char *buf;
+        Py_ssize_t len;
+        if (PyBytes_AsStringAndSize(bytes_obj, &buf, &len) < 0) return -2;
+        if (len == 0) return -1;  // null digest sentinel
+        BytesKey key{std::string(buf, (size_t)len)};
+        auto it = ids.find(key);
+        if (it != ids.end()) return it->second;
+        if (objects.size() >= cap) return -1;  // full: Python path takes over
+        int32_t id = (int32_t)objects.size();
+        Py_INCREF(bytes_obj);
+        objects.push_back(bytes_obj);
+        ids.emplace(std::move(key), id);
+        return id;
+    }
+};
+
+InternTable *g_intern = nullptr;
+
+// ---------------------------------------------------------------------------
+// Plane object.
+
+constexpr uint8_t SLOT_EJECTED = 1;
+
+struct ClientWin {
+    int64_t low = 0;
+    int64_t high = -1;  // inclusive; high < low -> empty
+    std::vector<int32_t> canonical;  // digest id, -1 = none yet
+    std::vector<uint16_t> count;
+    std::vector<uint8_t> flags;
+    std::vector<uint64_t> votes;  // width * words
+
+    int64_t width() const { return high - low + 1; }
+};
+
+struct Plane {
+    PyObject_HEAD
+    int n_nodes;
+    int my_id;
+    int weak_q;
+    int strong_q;
+    int words;
+    std::unordered_map<int64_t, ClientWin> *clients;
+};
+
+PyObject *mask_to_bytes(const uint64_t *w, int words) {
+    // Little-endian byte string, words*8 long; Python: int.from_bytes(b,'little')
+    return PyBytes_FromStringAndSize((const char *)w, (Py_ssize_t)words * 8);
+}
+
+int bytes_to_mask(PyObject *b, uint64_t *out, int words) {
+    char *buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(b, &buf, &len) < 0) return -1;
+    std::memset(out, 0, (size_t)words * 8);
+    if (len > (Py_ssize_t)words * 8) len = (Py_ssize_t)words * 8;
+    std::memcpy(out, buf, (size_t)len);
+    return 0;
+}
+
+void plane_dealloc(PyObject *self) {
+    Plane *p = (Plane *)self;
+    delete p->clients;
+    Py_TYPE(self)->tp_free(self);
+}
+
+PyObject *plane_new(PyTypeObject *type, PyObject *args, PyObject *kwds) {
+    static const char *kwlist[] = {"n_nodes", "my_id", "weak_q", "strong_q",
+                                   nullptr};
+    int n_nodes, my_id, weak_q, strong_q;
+    if (!PyArg_ParseTupleAndKeywords(args, kwds, "iiii", (char **)kwlist,
+                                     &n_nodes, &my_id, &weak_q, &strong_q))
+        return nullptr;
+    if (n_nodes <= 0 || n_nodes > 4096) {
+        PyErr_SetString(PyExc_ValueError, "n_nodes out of range");
+        return nullptr;
+    }
+    Plane *p = (Plane *)type->tp_alloc(type, 0);
+    if (!p) return nullptr;
+    p->n_nodes = n_nodes;
+    p->my_id = my_id;
+    p->weak_q = weak_q;
+    p->strong_q = strong_q;
+    p->words = (n_nodes + 63) / 64;
+    p->clients = new std::unordered_map<int64_t, ClientWin>();
+    return (PyObject *)p;
+}
+
+// set_client(client_id, low, high): create or rebase a client window.
+// Slots in the [low, high] overlap with the previous window are preserved;
+// everything else starts empty.
+PyObject *plane_set_client(PyObject *self, PyObject *args) {
+    Plane *p = (Plane *)self;
+    long long client_id, low, high;
+    if (!PyArg_ParseTuple(args, "LLL", &client_id, &low, &high)) return nullptr;
+    if (high < low || high - low > 1 << 20) {
+        PyErr_SetString(PyExc_ValueError, "bad window");
+        return nullptr;
+    }
+    const int words = p->words;
+    int64_t w = high - low + 1;
+    ClientWin fresh;
+    fresh.low = low;
+    fresh.high = high;
+    fresh.canonical.assign((size_t)w, -1);
+    fresh.count.assign((size_t)w, 0);
+    fresh.flags.assign((size_t)w, 0);
+    fresh.votes.assign((size_t)w * words, 0);
+
+    auto it = p->clients->find(client_id);
+    if (it != p->clients->end()) {
+        ClientWin &old = it->second;
+        int64_t from = low > old.low ? low : old.low;
+        int64_t to = high < old.high ? high : old.high;
+        for (int64_t rn = from; rn <= to; rn++) {
+            size_t oi = (size_t)(rn - old.low), ni = (size_t)(rn - low);
+            fresh.canonical[ni] = old.canonical[oi];
+            fresh.count[ni] = old.count[oi];
+            fresh.flags[ni] = old.flags[oi];
+            std::memcpy(&fresh.votes[ni * words], &old.votes[oi * words],
+                        (size_t)words * 8);
+        }
+        it->second = std::move(fresh);
+    } else {
+        p->clients->emplace(client_id, std::move(fresh));
+    }
+    Py_RETURN_NONE;
+}
+
+PyObject *plane_drop_client(PyObject *self, PyObject *args) {
+    Plane *p = (Plane *)self;
+    long long client_id;
+    if (!PyArg_ParseTuple(args, "L", &client_id)) return nullptr;
+    p->clients->erase(client_id);
+    Py_RETURN_NONE;
+}
+
+PyObject *plane_clear(PyObject *self, PyObject *) {
+    Plane *p = (Plane *)self;
+    p->clients->clear();
+    Py_RETURN_NONE;
+}
+
+// import_slot(client_id, req_no, digest_bytes|None, mask_bytes, count)
+// (Re-)take native ownership of a slot with known state; un-ejects.
+PyObject *plane_import_slot(PyObject *self, PyObject *args) {
+    Plane *p = (Plane *)self;
+    long long client_id, req_no;
+    PyObject *digest_obj, *mask_obj;
+    int count;
+    if (!PyArg_ParseTuple(args, "LLOOi", &client_id, &req_no, &digest_obj,
+                          &mask_obj, &count))
+        return nullptr;
+    auto it = p->clients->find(client_id);
+    if (it == p->clients->end()) {
+        PyErr_SetString(PyExc_KeyError, "unknown client");
+        return nullptr;
+    }
+    ClientWin &win = it->second;
+    if (req_no < win.low || req_no > win.high) {
+        PyErr_SetString(PyExc_IndexError, "req_no outside window");
+        return nullptr;
+    }
+    int32_t did = -1;
+    if (digest_obj != Py_None) {
+        did = g_intern->intern(digest_obj);
+        if (did == -2) return nullptr;
+        if (did == -1) {
+            // Null digest (caller bug) or intern table at capacity: the
+            // slot cannot be owned natively.
+            Py_RETURN_FALSE;
+        }
+    }
+    size_t i = (size_t)(req_no - win.low);
+    win.canonical[i] = did;
+    win.count[i] = (uint16_t)count;
+    win.flags[i] = 0;
+    if (bytes_to_mask(mask_obj, &win.votes[i * p->words], p->words) < 0)
+        return nullptr;
+    Py_RETURN_TRUE;
+}
+
+PyObject *plane_mark_ejected(PyObject *self, PyObject *args) {
+    Plane *p = (Plane *)self;
+    long long client_id, req_no;
+    if (!PyArg_ParseTuple(args, "LL", &client_id, &req_no)) return nullptr;
+    auto it = p->clients->find(client_id);
+    if (it != p->clients->end()) {
+        ClientWin &win = it->second;
+        if (req_no >= win.low && req_no <= win.high)
+            win.flags[(size_t)(req_no - win.low)] |= SLOT_EJECTED;
+    }
+    Py_RETURN_NONE;
+}
+
+PyObject *slot_state_tuple(Plane *p, ClientWin &win, size_t i) {
+    PyObject *mask = mask_to_bytes(&win.votes[i * p->words], p->words);
+    if (!mask) return nullptr;
+    PyObject *res = Py_BuildValue("iNi", (int)win.canonical[i], mask,
+                                  (int)win.count[i]);
+    return res;
+}
+
+// peek(client_id, req_no) -> (digest_id, mask_bytes, count) | None
+// None when the plane has nothing live for the slot (unknown client,
+// out of window, or ejected).
+PyObject *plane_peek(PyObject *self, PyObject *args) {
+    Plane *p = (Plane *)self;
+    long long client_id, req_no;
+    if (!PyArg_ParseTuple(args, "LL", &client_id, &req_no)) return nullptr;
+    auto it = p->clients->find(client_id);
+    if (it == p->clients->end()) Py_RETURN_NONE;
+    ClientWin &win = it->second;
+    if (req_no < win.low || req_no > win.high) Py_RETURN_NONE;
+    size_t i = (size_t)(req_no - win.low);
+    if (win.flags[i] & SLOT_EJECTED) Py_RETURN_NONE;
+    if (win.canonical[i] == -1 && win.count[i] == 0) Py_RETURN_NONE;
+    return slot_state_tuple(p, win, i);
+}
+
+// eject(client_id, req_no) -> slot state (digest_id, mask_bytes, count) or
+// None, and marks the slot ejected.  Unlike peek(), an already-ejected
+// slot's state is STILL returned: apply_core may mark a slot mid-batch and
+// Python must still be able to merge the accumulated votes (the merge is an
+// idempotent bitmask OR, so repeated ejects are harmless).
+PyObject *plane_eject(PyObject *self, PyObject *args) {
+    Plane *p = (Plane *)self;
+    long long client_id, req_no;
+    if (!PyArg_ParseTuple(args, "LL", &client_id, &req_no)) return nullptr;
+    auto it = p->clients->find(client_id);
+    if (it == p->clients->end()) Py_RETURN_NONE;
+    ClientWin &win = it->second;
+    if (req_no < win.low || req_no > win.high) Py_RETURN_NONE;
+    size_t i = (size_t)(req_no - win.low);
+    win.flags[i] |= SLOT_EJECTED;
+    if (win.canonical[i] == -1 && win.count[i] == 0) Py_RETURN_NONE;
+    return slot_state_tuple(p, win, i);
+}
+
+// Core per-ack application.  Returns:
+//   0 applied, no crossing;  1 python-fallback;  2 past (drop);
+//   3 crossing (out_* filled).
+//
+// A fallback on an existing in-window slot marks it EJECTED immediately, so
+// every LATER ack for the same slot — including later acks in the same
+// batch — also falls back, preserving the reference's strict per-ack
+// ordering (e.g. the first-non-null-binding rule when one batch carries
+// conflicting digests from one source).  Python retrieves the accumulated
+// votes via eject(), which stays valid after the mark.
+inline int apply_core(Plane *p, int64_t client_id, int64_t req_no,
+                      int32_t digest_id, int source, ClientWin **out_win,
+                      size_t *out_idx) {
+    auto it = p->clients->find(client_id);
+    if (it == p->clients->end()) return 1;  // unknown client -> buffer
+    ClientWin &win = it->second;
+    if (req_no < win.low) return 2;   // past
+    if (req_no > win.high) return 1;  // future -> buffer
+    size_t i = (size_t)(req_no - win.low);
+    if (win.flags[i] & SLOT_EJECTED) return 1;
+    if (digest_id < 0) {
+        win.flags[i] |= SLOT_EJECTED;  // null digest -> python semantics
+        return 1;
+    }
+    if (win.canonical[i] == -1)
+        win.canonical[i] = digest_id;
+    else if (win.canonical[i] != digest_id) {
+        win.flags[i] |= SLOT_EJECTED;  // conflicting digest -> python
+        return 1;
+    }
+    uint64_t *w = &win.votes[i * p->words + (source >> 6)];
+    uint64_t bit = 1ULL << (source & 63);
+    if (!(*w & bit)) {
+        *w |= bit;
+        win.count[i]++;
+    }
+    int c = win.count[i];
+    if (c == p->weak_q || c == p->strong_q ||
+        (source == p->my_id && c >= p->weak_q)) {
+        *out_win = &win;
+        *out_idx = i;
+        return 3;
+    }
+    return 0;
+}
+
+// apply_batch(packed_bytes, source) -> list of records in ack order:
+//   (idx,)                                   python-fallback
+//   (idx, client_id, req_no, digest_id, count, mask_bytes)   crossing
+// Packed record layout (little-endian, 16 bytes):
+//   int32 client_id, int32 digest_id (-1 null), int64 req_no.
+PyObject *plane_apply_batch(PyObject *self, PyObject *args) {
+    Plane *p = (Plane *)self;
+    Py_buffer packed;
+    int source;
+    if (!PyArg_ParseTuple(args, "y*i", &packed, &source)) return nullptr;
+    if (source < 0 || source >= p->n_nodes) {
+        PyBuffer_Release(&packed);
+        PyErr_SetString(PyExc_ValueError, "source out of range");
+        return nullptr;
+    }
+    PyObject *out = PyList_New(0);
+    if (!out) {
+        PyBuffer_Release(&packed);
+        return nullptr;
+    }
+    const char *base = (const char *)packed.buf;
+    Py_ssize_t n = packed.len / 16;
+    for (Py_ssize_t k = 0; k < n; k++) {
+        const char *rec = base + k * 16;
+        int32_t client_id, digest_id;
+        int64_t req_no;
+        std::memcpy(&client_id, rec, 4);
+        std::memcpy(&digest_id, rec + 4, 4);
+        std::memcpy(&req_no, rec + 8, 8);
+        ClientWin *win;
+        size_t idx;
+        int r = apply_core(p, client_id, req_no, digest_id, source, &win, &idx);
+        if (r == 0 || r == 2) continue;
+        PyObject *item;
+        if (r == 1) {
+            item = Py_BuildValue("(n)", (Py_ssize_t)k);
+        } else {
+            PyObject *mask = mask_to_bytes(&win->votes[idx * p->words], p->words);
+            if (!mask) {
+                Py_DECREF(out);
+                PyBuffer_Release(&packed);
+                return nullptr;
+            }
+            item = Py_BuildValue("nLLiiN", (Py_ssize_t)k, (long long)client_id,
+                                 (long long)req_no, (int)digest_id,
+                                 (int)win->count[idx], mask);
+        }
+        if (!item || PyList_Append(out, item) < 0) {
+            Py_XDECREF(item);
+            Py_DECREF(out);
+            PyBuffer_Release(&packed);
+            return nullptr;
+        }
+        Py_DECREF(item);
+    }
+    PyBuffer_Release(&packed);
+    return out;
+}
+
+// apply_one(client_id, req_no, digest_bytes, source) ->
+//   0 | 1 | 2 (as apply_core) | (count, digest_id, mask_bytes) on crossing.
+PyObject *plane_apply_one(PyObject *self, PyObject *args) {
+    Plane *p = (Plane *)self;
+    long long client_id, req_no;
+    PyObject *digest_obj;
+    int source;
+    if (!PyArg_ParseTuple(args, "LLOi", &client_id, &req_no, &digest_obj,
+                          &source))
+        return nullptr;
+    if (source < 0 || source >= p->n_nodes) {
+        PyErr_SetString(PyExc_ValueError, "source out of range");
+        return nullptr;
+    }
+    int32_t did = g_intern->intern(digest_obj);
+    if (did == -2) return nullptr;
+    ClientWin *win;
+    size_t idx;
+    int r = apply_core(p, client_id, req_no, did, source, &win, &idx);
+    if (r == 3) {
+        PyObject *mask = mask_to_bytes(&win->votes[idx * p->words], p->words);
+        if (!mask) return nullptr;
+        return Py_BuildValue("iiN", (int)win->count[idx], (int)did, mask);
+    }
+    return PyLong_FromLong(r);
+}
+
+// export_client(client_id) -> list of (req_no, digest_id, mask_bytes, count)
+// for live (non-ejected, touched) slots; used at reinitialize.
+PyObject *plane_export_client(PyObject *self, PyObject *args) {
+    Plane *p = (Plane *)self;
+    long long client_id;
+    if (!PyArg_ParseTuple(args, "L", &client_id)) return nullptr;
+    PyObject *out = PyList_New(0);
+    if (!out) return nullptr;
+    auto it = p->clients->find(client_id);
+    if (it == p->clients->end()) return out;
+    ClientWin &win = it->second;
+    for (int64_t rn = win.low; rn <= win.high; rn++) {
+        size_t i = (size_t)(rn - win.low);
+        if (win.flags[i] & SLOT_EJECTED) continue;
+        if (win.canonical[i] == -1 && win.count[i] == 0) continue;
+        PyObject *mask = mask_to_bytes(&win.votes[i * p->words], p->words);
+        if (!mask) {
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyObject *item =
+            Py_BuildValue("LiNi", (long long)rn, (int)win.canonical[i], mask,
+                          (int)win.count[i]);
+        if (!item || PyList_Append(out, item) < 0) {
+            Py_XDECREF(item);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        Py_DECREF(item);
+    }
+    return out;
+}
+
+PyMethodDef plane_methods[] = {
+    {"set_client", plane_set_client, METH_VARARGS, nullptr},
+    {"drop_client", plane_drop_client, METH_VARARGS, nullptr},
+    {"clear", plane_clear, METH_NOARGS, nullptr},
+    {"import_slot", plane_import_slot, METH_VARARGS, nullptr},
+    {"mark_ejected", plane_mark_ejected, METH_VARARGS, nullptr},
+    {"peek", plane_peek, METH_VARARGS, nullptr},
+    {"eject", plane_eject, METH_VARARGS, nullptr},
+    {"apply_batch", plane_apply_batch, METH_VARARGS, nullptr},
+    {"apply_one", plane_apply_one, METH_VARARGS, nullptr},
+    {"export_client", plane_export_client, METH_VARARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject PlaneType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+// ---------------------------------------------------------------------------
+// Module-level functions.
+
+PyObject *interned_str_client_id;
+PyObject *interned_str_req_no;
+PyObject *interned_str_digest;
+
+// pack_acks(acks: sequence of RequestAck) -> bytes (16 bytes per ack).
+PyObject *mod_pack_acks(PyObject *, PyObject *arg) {
+    PyObject *seq = PySequence_Fast(arg, "pack_acks expects a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject *out = PyBytes_FromStringAndSize(nullptr, n * 16);
+    if (!out) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    char *buf = PyBytes_AS_STRING(out);
+    for (Py_ssize_t k = 0; k < n; k++) {
+        PyObject *ack = PySequence_Fast_GET_ITEM(seq, k);
+        PyObject *cid_o = PyObject_GetAttr(ack, interned_str_client_id);
+        if (!cid_o) goto fail;
+        PyObject *rn_o = PyObject_GetAttr(ack, interned_str_req_no);
+        if (!rn_o) {
+            Py_DECREF(cid_o);
+            goto fail;
+        }
+        PyObject *dg_o = PyObject_GetAttr(ack, interned_str_digest);
+        if (!dg_o) {
+            Py_DECREF(cid_o);
+            Py_DECREF(rn_o);
+            goto fail;
+        }
+        {
+            int32_t client_id = (int32_t)PyLong_AsLongLong(cid_o);
+            int64_t req_no = PyLong_AsLongLong(rn_o);
+            int32_t digest_id = g_intern->intern(dg_o);
+            Py_DECREF(cid_o);
+            Py_DECREF(rn_o);
+            Py_DECREF(dg_o);
+            if (digest_id == -2 || PyErr_Occurred()) goto fail;
+            char *rec = buf + k * 16;
+            std::memcpy(rec, &client_id, 4);
+            std::memcpy(rec + 4, &digest_id, 4);
+            std::memcpy(rec + 8, &req_no, 8);
+        }
+    }
+    Py_DECREF(seq);
+    return out;
+fail:
+    Py_DECREF(seq);
+    Py_DECREF(out);
+    return nullptr;
+}
+
+PyObject *mod_digest_bytes(PyObject *, PyObject *arg) {
+    long id = PyLong_AsLong(arg);
+    if (id == -1 && PyErr_Occurred()) return nullptr;
+    if (id < 0 || (size_t)id >= g_intern->objects.size()) {
+        PyErr_SetString(PyExc_IndexError, "unknown digest id");
+        return nullptr;
+    }
+    PyObject *o = g_intern->objects[(size_t)id];
+    Py_INCREF(o);
+    return o;
+}
+
+PyMethodDef module_methods[] = {
+    {"pack_acks", mod_pack_acks, METH_O, nullptr},
+    {"digest_bytes", mod_digest_bytes, METH_O, nullptr},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_core",
+    "Native hot-path planes for mirbft_tpu (ack-vote accumulation).",
+    -1, module_methods, nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__core(void) {
+    PlaneType.tp_name = "mirbft_tpu._native._core.AckPlane";
+    PlaneType.tp_basicsize = sizeof(Plane);
+    PlaneType.tp_flags = Py_TPFLAGS_DEFAULT;
+    PlaneType.tp_new = plane_new;
+    PlaneType.tp_dealloc = plane_dealloc;
+    PlaneType.tp_methods = plane_methods;
+    if (PyType_Ready(&PlaneType) < 0) return nullptr;
+
+    g_intern = new InternTable();
+    interned_str_client_id = PyUnicode_InternFromString("client_id");
+    interned_str_req_no = PyUnicode_InternFromString("req_no");
+    interned_str_digest = PyUnicode_InternFromString("digest");
+
+    PyObject *m = PyModule_Create(&moduledef);
+    if (!m) return nullptr;
+    Py_INCREF(&PlaneType);
+    if (PyModule_AddObject(m, "AckPlane", (PyObject *)&PlaneType) < 0) {
+        Py_DECREF(&PlaneType);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    return m;
+}

@@ -18,8 +18,10 @@ on the hot ack path, see its ``filter`` TODO at :194).
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, List, Optional, Set, Tuple
 
+from .. import _native
 from ..messages import (
     AckBatch,
     AckMsg,
@@ -40,6 +42,27 @@ CORRECT_FETCH_TICKS = 4
 FETCH_TIMEOUT_TICKS = 4
 ACK_RESEND_TICKS = 20
 
+# Packed-ack cache for the native ack plane: one AckBatch is delivered to
+# every replica (N times in an in-process testengine run), but its packed
+# (client_id, digest_id, req_no) representation is identical everywhere, so
+# it is computed once per batch object.  Keyed by id() with an identity check
+# (the stored strong reference keeps a live entry's id stable; an evicted
+# entry whose id gets reused fails the identity check and is recomputed).
+_PACK_CACHE: "OrderedDict[int, Tuple[object, bytes]]" = OrderedDict()
+_PACK_CAP = 8192
+
+
+def _packed_acks(batch) -> bytes:
+    key = id(batch)
+    entry = _PACK_CACHE.get(key)
+    if entry is not None and entry[0] is batch:
+        return entry[1]
+    packed = _native.core.pack_acks(batch.acks)
+    _PACK_CACHE[key] = (batch, packed)
+    if len(_PACK_CACHE) > _PACK_CAP:
+        _PACK_CACHE.popitem(last=False)
+    return packed
+
 
 def mask_to_nodes(mask: int) -> Tuple[int, ...]:
     """Replica-id bitmask -> ascending id tuple."""
@@ -56,6 +79,7 @@ class ClientRequest:
         "fetching",
         "ticks_fetching",
         "ticks_correct",
+        "refresh_ref",
     )
 
     def __init__(self, ack: RequestAck):
@@ -65,6 +89,22 @@ class ClientRequest:
         self.fetching = False
         self.ticks_fetching = 0
         self.ticks_correct = 0
+        # (plane, client_id, req_no) when the native ack plane is
+        # accumulating votes for this (canonical-digest) request; consulted
+        # by refresh() at the few sites that read a live agreement mask.
+        self.refresh_ref = None
+
+    def refresh(self) -> int:
+        """Merge any native-plane votes into ``agreements`` and return it."""
+        ref = self.refresh_ref
+        if ref is not None:
+            plane, client_id, req_no = ref
+            state = plane.peek(client_id, req_no)
+            if state is None:
+                self.refresh_ref = None  # ejected or out of window
+            else:
+                self.agreements |= int.from_bytes(state[1], "little")
+        return self.agreements
 
     def fetch(self) -> Actions:
         if self.fetching:
@@ -72,7 +112,7 @@ class ClientRequest:
         self.fetching = True
         self.ticks_fetching = 0
         return Actions().send(
-            mask_to_nodes(self.agreements), FetchRequest(ack=self.ack)
+            mask_to_nodes(self.refresh()), FetchRequest(ack=self.ack)
         )
 
 
@@ -197,12 +237,15 @@ class ClientReqNo:
         in-flight fetch timing out.  Ack-rebroadcast backoff is NOT included —
         it is handled by the client's resend schedule."""
         wr = self.weak_requests
-        if len(wr) > 1 and b"" not in self.my_requests:
-            return True  # null promotion pending
+        if not wr:
+            return False
         if len(wr) == 1:
             (req,) = wr.values()
-            if not req.stored and not req.fetching:
-                return True  # counting down to a proactive fetch
+            if req.fetching:
+                return True  # fetch-timeout counting
+            return not req.stored  # counting down to a proactive fetch
+        if b"" not in self.my_requests:
+            return True  # null promotion pending
         for req in wr.values():
             if req.fetching:
                 return True  # fetch-timeout counting
@@ -673,6 +716,8 @@ class ClientHashDisseminator:
         "msg_buffers",
         "clients",
         "client_tracker",
+        "plane",
+        "_mask_bytes",
     )
 
     def __init__(
@@ -691,11 +736,19 @@ class ClientHashDisseminator:
         self.client_states: Tuple[ClientState, ...] = ()
         self.msg_buffers: Dict[int, MsgBuffer] = {}
         self.clients: Dict[int, Client] = {}
+        # Native ack-vote plane (mirbft_tpu/_native): owns green-path vote
+        # accumulation; None when the extension is unavailable/disabled.
+        self.plane = None
+        self._mask_bytes = 0
 
     def reinitialize(self, seq_no: int, network_state: NetworkState) -> Actions:
         """Reference :143-180."""
         actions = Actions()
         reconfiguring = bool(network_state.pending_reconfigurations)
+
+        # Fold any native-plane vote state back into the Python objects
+        # before the Python-side rebuild re-derives quorum sets from them.
+        self._sync_all_from_plane()
 
         self.allocated_through = seq_no
         self.network_config = network_state.config
@@ -722,7 +775,195 @@ class ClientHashDisseminator:
                 buffer = MsgBuffer("clients", self.node_buffers.node_buffer(node))
             self.msg_buffers[node] = buffer
 
+        self._rebuild_plane()
         return actions
+
+    # --- native ack plane lifecycle -------------------------------------
+
+    def _sync_all_from_plane(self) -> None:
+        """Merge every live native slot's votes into the Python objects
+        (without marking anything ejected — used before a full rebuild,
+        which discards the plane anyway)."""
+        plane = self.plane
+        if plane is None:
+            return
+        for client_id, client in self.clients.items():
+            for req_no, digest_id, mask_b, _count in plane.export_client(
+                client_id
+            ):
+                crn = client.req_nos.get(req_no)
+                if crn is None:
+                    continue
+                self._merge_state(client_id, crn, digest_id, mask_b, None)
+        self.plane = None
+
+    def _merge_state(
+        self, client_id: int, crn: ClientReqNo, digest_id: int, mask_b: bytes,
+        refresh_ref,
+    ) -> "ClientRequest":
+        digest = _native.core.digest_bytes(digest_id)
+        ack = RequestAck(client_id=client_id, req_no=crn.req_no, digest=digest)
+        cr = crn.client_req(ack)
+        mask = int.from_bytes(mask_b, "little")
+        cr.agreements |= mask
+        cr.refresh_ref = refresh_ref
+        crn.non_null_voters |= mask
+        return cr
+
+    def _rebuild_plane(self) -> None:
+        """Create a fresh plane for the (possibly changed) config and
+        re-import every green-path slot (single non-null digest candidate,
+        no null candidate); everything else is marked ejected and handled
+        by the pure-Python path."""
+        if not _native.available:
+            self.plane = None
+            return
+        config = self.network_config
+        n_nodes = max(config.nodes) + 1
+        plane = _native.core.AckPlane(
+            n_nodes,
+            self.my_config.id,
+            some_correct_quorum(config),
+            intersection_quorum(config),
+        )
+        self._mask_bytes = ((n_nodes + 63) // 64) * 8
+        for client_state in self.client_states:
+            client_id = client_state.id
+            client = self.clients[client_id]
+            plane.set_client(
+                client_id, client.client_state.low_watermark, client.high_watermark
+            )
+            for req_no, crn in client.req_nos.items():
+                if b"" in crn.requests:
+                    plane.mark_ejected(client_id, req_no)
+                    continue
+                non_null = [(d, r) for d, r in crn.requests.items() if d]
+                if len(non_null) > 1:
+                    plane.mark_ejected(client_id, req_no)
+                    continue
+                if not non_null:
+                    continue  # untouched slot: native starts fresh
+                digest, cr = non_null[0]
+                if plane.import_slot(
+                    client_id,
+                    req_no,
+                    digest,
+                    cr.agreements.to_bytes(self._mask_bytes, "little"),
+                    cr.agreements.bit_count(),
+                ):
+                    cr.refresh_ref = (plane, client_id, req_no)
+                else:  # digest not internable (table at capacity)
+                    plane.mark_ejected(client_id, req_no)
+        self.plane = plane
+
+    def _eject_reqno(self, client: "Client", req_no: int) -> None:
+        """Hand a (client, req_no) back to the pure-Python path: merge the
+        native votes into the Python objects and mark the slot ejected so
+        every later ack for it falls through to Python."""
+        state = self.plane.eject(client.client_state.id, req_no)
+        if state is None:
+            return
+        digest_id, mask_b, _count = state
+        crn = client.req_nos.get(req_no)
+        if crn is not None and digest_id >= 0:
+            cr = self._merge_state(
+                client.client_state.id, crn, digest_id, mask_b, None
+            )
+            cr.refresh_ref = None
+
+    def _peek_merge(self, client: "Client", crn: ClientReqNo) -> None:
+        """Snapshot-merge native votes into Python (read-only sites:
+        fetch replies, status introspection); the plane stays the owner."""
+        plane = self.plane
+        if plane is None:
+            return
+        client_id = client.client_state.id
+        state = plane.peek(client_id, crn.req_no)
+        if state is None:
+            return
+        digest_id, mask_b, _count = state
+        self._merge_state(
+            client_id, crn, digest_id, mask_b, (plane, client_id, crn.req_no)
+        )
+
+    def sync_for_introspection(self) -> None:
+        """Make Python-side vote state current for status()/debugging."""
+        if self.plane is None:
+            return
+        for client in self.clients.values():
+            for crn in client.req_nos.values():
+                self._peek_merge(client, crn)
+
+    def _pyfall_ack(self, actions: Actions, source: int, ack: RequestAck) -> None:
+        """Classification + application for an ack the native plane refused
+        (unknown client, out of window, null digest, conflicting digest, or
+        ejected slot) — mirrors the legacy AckBatch classification."""
+        client = self.clients.get(ack.client_id)
+        if client is None:
+            self.msg_buffers[source].store(AckMsg(ack=ack))  # FUTURE
+            return
+        if client.client_state.low_watermark > ack.req_no:
+            return  # PAST
+        if client.high_watermark < ack.req_no:
+            self.msg_buffers[source].store(AckMsg(ack=ack))  # FUTURE
+            return
+        self._eject_reqno(client, ack.req_no)
+        client.ack_into(actions, source, ack)
+
+    def _native_crossing(
+        self,
+        actions: Actions,
+        source: int,
+        client: "Client",
+        req_no: int,
+        digest_id: int,
+        count: int,
+        mask_b: bytes,
+    ) -> None:
+        """Replay of the quorum tail of Client.ack_into/ack_run for a
+        crossing detected natively.  The conditions and action order are
+        exactly the Python path's; the native plane guarantees records are
+        emitted precisely when count == weak_q, count == strong_q, or
+        source == my_id with count >= weak_q (duplicates included — a
+        duplicate vote arriving while the count sits at a threshold re-runs
+        the tail in the reference semantics too)."""
+        crn = client.req_nos[req_no]
+        digest = _native.core.digest_bytes(digest_id)
+        cr = crn.requests.get(digest)
+        if cr is None:
+            cr = ClientRequest(
+                RequestAck(
+                    client_id=client.client_state.id,
+                    req_no=req_no,
+                    digest=digest,
+                )
+            )
+            crn.requests[digest] = cr
+        mask = int.from_bytes(mask_b, "little")
+        cr.agreements |= mask
+        crn.non_null_voters |= mask
+        if cr.refresh_ref is None:
+            cr.refresh_ref = (self.plane, client.client_state.id, req_no)
+        # cr.ack is value-identical to the received ack (same client/req_no,
+        # and cr is keyed by the canonical digest).
+        ack = cr.ack
+        newly_correct = count == client.weak_quorum
+        if newly_correct:
+            crn.weak_requests[digest] = cr
+            if not cr.stored:
+                actions.correct_request(ack)
+            # Inlined _update_attention: with exactly one weak candidate and
+            # no null candidate (guaranteed on a native-owned slot),
+            # needs_attention reduces to (not stored) or fetching.
+            if not crn.committed and (not cr.stored or cr.fetching):
+                client.attention.add(req_no)
+            else:
+                client.attention.discard(req_no)
+        if cr.stored and (newly_correct or source == self.my_config.id):
+            client.client_tracker.add_available(ack)
+        if count == client.strong_quorum:
+            crn.strong_requests[digest] = cr
+            client.advance_ready()
 
     def tick(self) -> Actions:
         actions = Actions()
@@ -748,6 +989,24 @@ class ClientHashDisseminator:
 
     def step(self, source: int, msg: Msg) -> Actions:
         if isinstance(msg, AckBatch):
+            plane = self.plane
+            if plane is not None:
+                # Native fast path: the whole batch is applied in C against
+                # packed vote bitmasks; only quorum crossings and acks the
+                # plane refuses come back, in original ack order, and are
+                # replayed through the exact Python semantics.
+                actions = Actions()
+                acks = msg.acks
+                for rec in plane.apply_batch(_packed_acks(msg), source):
+                    if len(rec) == 1:
+                        self._pyfall_ack(actions, source, acks[rec[0]])
+                    else:
+                        _idx, cid, req_no, did, count, mask_b = rec
+                        self._native_crossing(
+                            actions, source, self.clients[cid], req_no,
+                            did, count, mask_b,
+                        )
+                return actions
             # Per-ack classification: a batch may straddle a window boundary.
             # PAST acks are dropped, FUTURE acks are buffered individually
             # (so later buffer iteration applies them one by one, exactly as
@@ -777,6 +1036,22 @@ class ClientHashDisseminator:
                 # In-window: hand the whole same-client in-window run to the
                 # client's inlined loop.
                 i = client.ack_run(actions, source, acks, i)
+            return actions
+        if isinstance(msg, AckMsg) and self.plane is not None:
+            ack = msg.ack
+            result = self.plane.apply_one(
+                ack.client_id, ack.req_no, ack.digest, source
+            )
+            actions = Actions()
+            if type(result) is tuple:
+                count, did, mask_b = result
+                self._native_crossing(
+                    actions, source, self.clients[ack.client_id], ack.req_no,
+                    did, count, mask_b,
+                )
+            elif result == 1:  # plane refused: classify + apply in Python
+                self._pyfall_ack(actions, source, ack)
+            # result 0 (applied, no crossing) / 2 (past, dropped): no actions
             return actions
         verdict = self.filter(source, msg)
         if verdict == Applyable.PAST:
@@ -818,12 +1093,18 @@ class ClientHashDisseminator:
         actions = Actions()
         self.allocated_through = seq_no
         reconfiguring = bool(network_state.pending_reconfigurations)
+        plane = self.plane
         for client_state in network_state.clients:
-            actions.concat(
-                self.clients[client_state.id].allocate(
-                    seq_no, client_state, reconfiguring
+            client = self.clients[client_state.id]
+            actions.concat(client.allocate(seq_no, client_state, reconfiguring))
+            if plane is not None:
+                # Roll the native window with the Python one: the overlap
+                # keeps its votes, dropped slots are GC'd, new slots empty.
+                plane.set_client(
+                    client_state.id,
+                    client_state.low_watermark,
+                    client.high_watermark,
                 )
-            )
         for node in self.network_config.nodes:
             self.msg_buffers[node].iterate(
                 self.filter,
@@ -839,6 +1120,7 @@ class ClientHashDisseminator:
         if client is None or not client.in_watermarks(req_no):
             return Actions()
         crn = client.req_no(req_no)
+        self._peek_merge(client, crn)
         data = crn.requests.get(digest)
         if data is None or not (data.agreements >> self.my_config.id) & 1:
             return Actions()
@@ -853,6 +1135,10 @@ class ClientHashDisseminator:
             raise AssertionError(
                 "step filtering should delay reqs for non-existent clients"
             )
+        if self.plane is not None:
+            # Direct/forced acks (buffer replay, epoch-change request
+            # recovery) use the full Python semantics: hand the slot back.
+            self._eject_reqno(client, ack.req_no)
         return client.ack(source, ack, force=force)
 
     def note_fetching(self, ack: RequestAck) -> None:

@@ -196,10 +196,13 @@ class Sequence:
             # Forward each request to nodes that have not acked it, so
             # followers can satisfy their outstanding-request checks.
             for cr in self.client_requests:
+                # refresh(): the live agreement mask may be accumulating in
+                # the native ack plane (disseminator.ClientRequest.refresh).
+                agreements = cr.refresh()
                 missing = [
                     node
                     for node in self.network_config.nodes
-                    if not (cr.agreements >> node) & 1
+                    if not (agreements >> node) & 1
                 ]
                 if missing:
                     actions.forward_request(missing, cr.ack)

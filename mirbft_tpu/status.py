@@ -245,6 +245,9 @@ def snapshot(sm: StateMachine) -> StateMachineStatus:
     ]
 
     client_windows = []
+    # Votes may be accumulating in the native ack plane; make the Python
+    # view current before rendering it.
+    sm.client_hash_disseminator.sync_for_introspection()
     for client_state in sm.client_tracker.client_states:
         client = sm.client_hash_disseminator.clients[client_state.id]
         allocated = []

@@ -1,0 +1,198 @@
+"""Differential pin: the native (C++) ack-vote plane must be observationally
+identical to the pure-Python disseminator path for whole simulated runs,
+including fault scenarios that force slot ejection (drops → resends/dup acks,
+duplication).
+
+The native plane accumulates green-path votes in packed bitmasks and replays
+quorum crossings through the Python tail (see mirbft_tpu/_native/ackplane.cpp
+header for the contract); these tests run the same Spec with the plane
+enabled and disabled and require bit-identical outcomes."""
+
+import pytest
+
+from mirbft_tpu import _native
+from mirbft_tpu import state as st
+from mirbft_tpu.config import standard_initial_network_state
+from mirbft_tpu.messages import AckBatch, AckMsg, RequestAck
+from mirbft_tpu.statemachine.client_tracker import ClientTracker
+from mirbft_tpu.statemachine.disseminator import ClientHashDisseminator
+from mirbft_tpu.statemachine.msgbuffers import NodeBuffers
+from mirbft_tpu.testengine import For, Spec, matching
+
+pytestmark = pytest.mark.skipif(
+    not _native.available, reason="native extension unavailable"
+)
+
+
+def run(spec: Spec, timeout: int, native: bool):
+    prev = _native.available
+    _native.available = native
+    try:
+        recording = spec.recorder().recording()
+        count = recording.drain_clients(timeout=timeout)
+    finally:
+        _native.available = prev
+    return recording, count
+
+
+def fingerprint(recording):
+    return [
+        (
+            n.state.checkpoint_seq_no,
+            n.state.checkpoint_hash,
+            len(n.state.state_transfers),
+            n.state_machine.epoch_tracker.current_epoch.number,
+        )
+        for n in recording.nodes
+    ]
+
+
+def with_mangler(spec: Spec, mangler) -> Spec:
+    spec.tweak_recorder = lambda r: setattr(r, "mangler", mangler)
+    return spec
+
+
+def assert_differential(spec_factory, timeout):
+    r_native, c_native = run(spec_factory(), timeout, native=True)
+    r_python, c_python = run(spec_factory(), timeout, native=False)
+    assert c_native == c_python
+    assert fingerprint(r_native) == fingerprint(r_python)
+
+
+def test_green_path_differential():
+    assert_differential(
+        lambda: Spec(node_count=4, client_count=4, reqs_per_client=50,
+                     batch_size=5),
+        timeout=40000,
+    )
+
+
+def test_drop_differential():
+    def make():
+        return with_mangler(
+            Spec(node_count=4, client_count=4, reqs_per_client=30),
+            For(matching.msgs().at_percent(2)).drop(),
+        )
+
+    assert_differential(make, timeout=60000)
+
+
+def test_heavy_ack_drop_differential():
+    def make():
+        return with_mangler(
+            Spec(node_count=4, client_count=4, reqs_per_client=10),
+            For(
+                matching.msgs().of_type((AckMsg, AckBatch)).at_percent(70)
+            ).drop(),
+        )
+
+    assert_differential(make, timeout=120000)
+
+
+def test_duplicate_differential():
+    def make():
+        return with_mangler(
+            Spec(node_count=4, client_count=4, reqs_per_client=20),
+            For(matching.msgs().at_percent(75)).duplicate(300),
+        )
+
+    assert_differential(make, timeout=60000)
+
+
+# ---------------------------------------------------------------------------
+# Unit-level differential: adversarial ack streams straight into the
+# disseminator, covering orderings whole-run scenarios rarely produce.
+# ---------------------------------------------------------------------------
+
+
+def build_disseminator(native: bool, n_nodes=4, width=20):
+    prev = _native.available
+    _native.available = native
+    try:
+        network_state = standard_initial_network_state(
+            n_nodes, 0, client_width=width
+        )
+        my_config = st.EventInitialParameters(
+            id=0, batch_size=1, heartbeat_ticks=2, suspect_ticks=4,
+            new_epoch_timeout_ticks=8, buffer_size=10 * 1024 * 1024,
+        )
+        tracker = ClientTracker(my_config)
+        diss = ClientHashDisseminator(
+            NodeBuffers(my_config, None), my_config, tracker
+        )
+        diss.reinitialize(0, network_state)
+    finally:
+        _native.available = prev
+    return diss, tracker
+
+
+def diss_fingerprint(diss, tracker):
+    diss.sync_for_introspection()
+    crns = []
+    for cid, client in sorted(diss.clients.items()):
+        for rn, crn in sorted(client.req_nos.items()):
+            crns.append((
+                cid, rn, crn.non_null_voters,
+                sorted((d, r.agreements, r.stored)
+                       for d, r in crn.requests.items()),
+                sorted(crn.weak_requests),
+                sorted(crn.strong_requests),
+            ))
+    return tuple(crns)
+
+
+D1 = b"\x01" * 32
+D2 = b"\x02" * 32
+
+
+def deliver(diss, stream):
+    """stream: list of (source, AckBatch|AckMsg) deliveries."""
+    out = []
+    for source, msg in stream:
+        actions = diss.step(source, msg)
+        out.append([type(a).__name__ for a in actions])
+    return out
+
+
+@pytest.mark.parametrize("conflict_first", [True, False])
+def test_same_batch_conflicting_digests_bind_in_order(conflict_first):
+    """The first-non-null-ack-is-binding rule must hold even when one batch
+    carries conflicting digests, and even when the slot was native-owned
+    before the batch arrived (code-review finding: the native loop must not
+    count an ack that a same-batch earlier ack's fallback would have
+    bound away)."""
+
+    def ack(d, rn=3):
+        return RequestAck(client_id=0, req_no=rn, digest=d)
+
+    if conflict_first:
+        batch = AckBatch(acks=(ack(D2), ack(D1)))
+    else:
+        batch = AckBatch(acks=(ack(D1), ack(D2)))
+
+    streams = [
+        # Establish D1 as canonical from another source, then the
+        # conflicting batch from source 2, then more D1 votes.
+        [(1, AckMsg(ack=ack(D1))), (2, batch), (3, AckMsg(ack=ack(D1)))],
+        # Conflicting batch arrives first (canonical set mid-batch).
+        [(2, batch), (1, AckMsg(ack=ack(D1))), (3, AckMsg(ack=ack(D1)))],
+    ]
+    for stream in streams:
+        dn, tn = build_disseminator(True)
+        dp, tp = build_disseminator(False)
+        acts_n = deliver(dn, stream)
+        acts_p = deliver(dp, stream)
+        assert acts_n == acts_p
+        assert diss_fingerprint(dn, tn) == diss_fingerprint(dp, tp)
+
+
+def test_null_then_canonical_same_batch():
+    def ack(d, rn=5):
+        return RequestAck(client_id=0, req_no=rn, digest=d)
+
+    batch = AckBatch(acks=(ack(b""), ack(D1)))
+    stream = [(1, batch), (2, AckMsg(ack=ack(D1))), (3, AckMsg(ack=ack(D1)))]
+    dn, tn = build_disseminator(True)
+    dp, tp = build_disseminator(False)
+    assert deliver(dn, stream) == deliver(dp, stream)
+    assert diss_fingerprint(dn, tn) == diss_fingerprint(dp, tp)
