@@ -235,6 +235,11 @@ def process_state_machine_events(
         if interceptor is not None:
             interceptor.intercept(event)
         actions.concat(sm.apply_event(event))
+    marker = st.EventActionsReceived()
     if interceptor is not None:
-        interceptor.intercept(st.EventActionsReceived())
+        interceptor.intercept(marker)
+    # The marker is applied, not just recorded: it is the batch boundary at
+    # which the state machine flushes deferred ack broadcasts
+    # (reference state_machine.go:224-228 applies it as an event too).
+    actions.concat(sm.apply_event(marker))
     return actions

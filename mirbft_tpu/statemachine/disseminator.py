@@ -162,6 +162,17 @@ class ClientReqNo:
     def reinitialize(self, network_config: NetworkConfig) -> None:
         """Re-derive quorum sets under a (possibly changed) config
         (reference :371-408)."""
+        if network_config == self.network_config:
+            # Graceful epoch rotation under an unchanged config: the same
+            # node set and quorum thresholds re-derive the same agreement
+            # masks and weak/strong/my sets, so the rebuild below is an
+            # identity on them.  Only the per-candidate fetch state resets
+            # (the rebuild drops it by constructing fresh ClientRequests).
+            for req in self.requests.values():
+                req.fetching = False
+                req.ticks_fetching = 0
+                req.ticks_correct = 0
+            return
         self.network_config = network_config
         old_requests = self.requests
         self.non_null_voters = 0
@@ -718,6 +729,7 @@ class ClientHashDisseminator:
         "client_tracker",
         "plane",
         "_mask_bytes",
+        "_ack_dirty",
     )
 
     def __init__(
@@ -740,15 +752,32 @@ class ClientHashDisseminator:
         # accumulation; None when the extension is unavailable/disabled.
         self.plane = None
         self._mask_bytes = 0
+        # Clients with persisted-but-not-yet-acked requests; drained by
+        # flush_acks() at each event-batch boundary (EventActionsReceived),
+        # so one processing batch emits one aggregated AckBatch per client
+        # instead of one ack broadcast per persisted request.
+        self._ack_dirty: Set[int] = set()
 
     def reinitialize(self, seq_no: int, network_state: NetworkState) -> Actions:
         """Reference :143-180."""
         actions = Actions()
         reconfiguring = bool(network_state.pending_reconfigurations)
 
-        # Fold any native-plane vote state back into the Python objects
-        # before the Python-side rebuild re-derives quorum sets from them.
-        self._sync_all_from_plane()
+        # Unchanged config + client set (the graceful epoch-rotation case):
+        # the per-req-no rebuild is an identity on vote state, so the native
+        # plane keeps ownership and only the windows are re-based.  Otherwise
+        # fold the native votes back into Python before the rebuild
+        # re-derives quorum sets from them, and build a fresh plane after.
+        keep_plane = (
+            self.plane is not None
+            and self.network_config == network_state.config
+            and tuple(cs.id for cs in self.client_states)
+            == tuple(cs.id for cs in network_state.clients)
+        )
+        if not keep_plane:
+            # Fold any native-plane vote state back into the Python objects
+            # before the Python-side rebuild re-derives quorum sets from them.
+            self._sync_all_from_plane()
 
         self.allocated_through = seq_no
         self.network_config = network_state.config
@@ -775,7 +804,17 @@ class ClientHashDisseminator:
                 buffer = MsgBuffer("clients", self.node_buffers.node_buffer(node))
             self.msg_buffers[node] = buffer
 
-        self._rebuild_plane()
+        if keep_plane:
+            plane = self.plane
+            for client_state in self.client_states:
+                client = self.clients[client_state.id]
+                plane.set_client(
+                    client_state.id,
+                    client.client_state.low_watermark,
+                    client.high_watermark,
+                )
+        else:
+            self._rebuild_plane()
         return actions
 
     # --- native ack plane lifecycle -------------------------------------
@@ -1074,14 +1113,29 @@ class ClientHashDisseminator:
 
     def apply_new_request(self, ack: RequestAck) -> Actions:
         """EventRequestPersisted: our processor persisted a request body
-        (reference :242-257)."""
+        (reference :242-257).  Ack generation is deferred to flush_acks()
+        at the event-batch boundary so acks for all requests persisted in
+        one batch broadcast as one AckBatch per client."""
         client = self.clients.get(ack.client_id)
         if client is None:
             return Actions()  # client removed since the request was processed
         if not client.in_watermarks(ack.req_no):
             return Actions()  # already committed
         client.apply_new_request(ack)
-        return client.advance_acks()
+        self._ack_dirty.add(ack.client_id)
+        return Actions()
+
+    def flush_acks(self) -> Actions:
+        """Generate deferred ack broadcasts (deterministic client order)."""
+        if not self._ack_dirty:
+            return Actions()
+        actions = Actions()
+        for client_id in sorted(self._ack_dirty):
+            client = self.clients.get(client_id)
+            if client is not None:
+                actions.concat(client.advance_acks())
+        self._ack_dirty.clear()
+        return actions
 
     def allocate(self, seq_no: int, network_state: NetworkState) -> Actions:
         """Advance client windows after a checkpoint (reference :260-278)."""

@@ -427,6 +427,30 @@ class ActiveEpoch:
             )
         return actions
 
+    def needs_advance(self) -> bool:
+        """Cheap predicate for the per-event fixpoint: advance() is a no-op
+        unless the window can extend, buffered messages may drain, or new
+        ready proposals can be pulled/allocated.  Mirrors exactly the
+        conditions under which advance() emits actions or mutates state."""
+        hw = self.high_watermark()
+        if (
+            hw < self.epoch_config.planned_expiration
+            and hw < self.commit_state.stop_at_seq_no
+        ):
+            return True  # window extension pending
+        if self._buffered[0]:
+            return True  # buffered consensus msgs may now apply
+        proposer = self.proposer
+        if proposer.ready_iterator.has_next():
+            return True  # new strong-cert requests to pull
+        for bucket in self._owned_buckets:
+            seq_no = self.lowest_unallocated[bucket]
+            if seq_no <= hw and proposer.proposal_bucket(bucket).has_pending(
+                seq_no
+            ):
+                return True
+        return False
+
     def advance(self) -> Actions:
         """Extend the window with new checkpoint intervals (persisting an
         NEntry per chunk), drain buffers, pull proposals into owned buckets

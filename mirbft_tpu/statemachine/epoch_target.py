@@ -588,8 +588,13 @@ class EpochTarget:
                     )
                 actions.concat(self.active_epoch.drain_buffers())
             elif self.state == EpochTargetState.IN_PROGRESS:
-                actions.concat(self.active_epoch.outstanding_reqs.advance_requests())
-                actions.concat(self.active_epoch.advance())
+                # This arm runs in the per-event fixpoint; both calls are
+                # no-ops almost always, so gate them on cheap predicates.
+                ae = self.active_epoch
+                if ae.outstanding_reqs.available_iterator.has_next():
+                    actions.concat(ae.outstanding_reqs.advance_requests())
+                if ae.needs_advance():
+                    actions.concat(ae.advance())
             # ENDING / DONE: nothing to do here
             if self.state == old_state:
                 return actions

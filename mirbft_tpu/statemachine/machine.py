@@ -224,8 +224,11 @@ class StateMachine:
         if isinstance(event, st.EventLoadCompleted):
             actions = self._complete_initialization()
         elif isinstance(event, st.EventActionsReceived):
-            # No-op marker correlating action batches to their events in the
-            # recorded stream.
+            # Marker correlating action batches to their events in the
+            # recorded stream — and the batch boundary at which deferred
+            # ack broadcasts flush (one AckBatch per client per batch).
+            if self.state == MachineState.INITIALIZED:
+                return self.client_hash_disseminator.flush_acks()
             return Actions()
         else:
             if self.state != MachineState.INITIALIZED:

