@@ -487,11 +487,525 @@ PyTypeObject PlaneType = {
 };
 
 // ---------------------------------------------------------------------------
+// Sequence-vote plane: the O(N^2)-per-sequence Prepare/Commit hot path of
+// the three-phase commit (reference pkg/statemachine/sequence.go:257-355,
+// epoch_active.go:142-213).  Vote accumulation (replica bitmasks + per-digest
+// counts) runs here; Python keeps the sequence lifecycle and reads counts
+// lazily at its quorum checks, so the records this plane returns are HINTS —
+// liberal is fine, Python re-validates every transition condition.
+//
+// Contract with mirbft_tpu/statemachine/sequence.py + machine.py:
+//
+//  * The plane mirrors the active epoch's watermark window exactly
+//    (set_window after every extension/trim); Python phase changes are
+//    pushed via set_phase, the batch digest via set_expected.
+//  * apply_votes() applies one packed envelope of votes from one source,
+//    mirroring the _step_prepare/_step_commit filters (owner-INVALID,
+//    planned-expiration-INVALID, past-drop); FUTURE and wrong-epoch votes
+//    come back as fallback records and Python routes the original message
+//    objects through the slow path (buffering, epoch tracker).
+//  * Per-slot digest tables are bounded (VOTE_DIGEST_CAP).  Votes for a
+//    digest that does not fit are still mask-deduplicated but not counted —
+//    harmless for every observable: quorum checks only ever read the
+//    expected digest's count, and set_expected's entry always fits (the
+//    cap applies to vote-created entries only).
+
+constexpr int VOTE_DIGEST_CAP = 64;
+constexpr uint8_t PH_PENDING_REQUESTS = 2;
+constexpr uint8_t PH_READY = 3;
+constexpr uint8_t PH_PREPREPARED = 4;
+constexpr uint8_t PH_PREPARED = 5;
+
+struct DigestCount {
+    std::string digest;
+    int32_t prep = 0;
+    int32_t commit = 0;
+};
+
+struct SeqSlot {
+    uint8_t phase = 0;          // SeqState numeric value
+    bool expected_set = false;  // set_expected called
+    std::string expected;       // batch digest ("" = null batch until set)
+    bool my_prep_set = false;
+    std::string my_prep;        // digest our own prepare carried
+    std::vector<uint64_t> prep_mask, commit_mask;  // words each
+    std::vector<DigestCount> counts;
+
+    DigestCount *find_count(const char *d, size_t dlen, bool create,
+                            bool force) {
+        for (auto &c : counts)
+            if (c.digest.size() == dlen &&
+                std::memcmp(c.digest.data(), d, dlen) == 0)
+                return &c;
+        if (!create) return nullptr;
+        if (!force && counts.size() >= VOTE_DIGEST_CAP) return nullptr;
+        counts.push_back(DigestCount{std::string(d, dlen), 0, 0});
+        return &counts.back();
+    }
+};
+
+struct SeqPlaneObj {
+    PyObject_HEAD
+    int n_nodes, my_id, iq, words, nb;
+    int64_t epoch, planned_expiration;
+    int64_t low, high;  // inclusive window; high < low -> empty
+    std::vector<int32_t> *buckets;
+    std::vector<SeqSlot> *slots;  // index: seq_no - low
+};
+
+void seqplane_dealloc(PyObject *self) {
+    SeqPlaneObj *p = (SeqPlaneObj *)self;
+    delete p->buckets;
+    delete p->slots;
+    Py_TYPE(self)->tp_free(self);
+}
+
+PyObject *seqplane_new(PyTypeObject *type, PyObject *args, PyObject *kwds) {
+    static const char *kwlist[] = {"n_nodes", "my_id", "iq", nullptr};
+    int n_nodes, my_id, iq;
+    if (!PyArg_ParseTupleAndKeywords(args, kwds, "iii", (char **)kwlist,
+                                     &n_nodes, &my_id, &iq))
+        return nullptr;
+    if (n_nodes <= 0 || n_nodes > 4096) {
+        PyErr_SetString(PyExc_ValueError, "n_nodes out of range");
+        return nullptr;
+    }
+    SeqPlaneObj *p = (SeqPlaneObj *)type->tp_alloc(type, 0);
+    if (!p) return nullptr;
+    p->n_nodes = n_nodes;
+    p->my_id = my_id;
+    p->iq = iq;
+    p->words = (n_nodes + 63) / 64;
+    p->nb = 0;
+    p->epoch = -1;
+    p->planned_expiration = -1;
+    p->low = 0;
+    p->high = -1;
+    p->buckets = new std::vector<int32_t>();
+    p->slots = new std::vector<SeqSlot>();
+    return (PyObject *)p;
+}
+
+// reset(epoch, planned_expiration, buckets_bytes): start an (empty) window
+// for a new active epoch.  buckets_bytes: little-endian int32 per bucket
+// (bucket index -> owning node id).
+PyObject *seqplane_reset(PyObject *self, PyObject *args) {
+    SeqPlaneObj *p = (SeqPlaneObj *)self;
+    long long epoch, planned_expiration;
+    Py_buffer buckets;
+    if (!PyArg_ParseTuple(args, "LLy*", &epoch, &planned_expiration, &buckets))
+        return nullptr;
+    p->epoch = epoch;
+    p->planned_expiration = planned_expiration;
+    p->nb = (int)(buckets.len / 4);
+    p->buckets->assign((size_t)p->nb, 0);
+    std::memcpy(p->buckets->data(), buckets.buf, (size_t)p->nb * 4);
+    PyBuffer_Release(&buckets);
+    p->low = 0;
+    p->high = -1;
+    p->slots->clear();
+    Py_RETURN_NONE;
+}
+
+// set_window(low, high): rebase to [low, high] preserving overlapping slots.
+PyObject *seqplane_set_window(PyObject *self, PyObject *args) {
+    SeqPlaneObj *p = (SeqPlaneObj *)self;
+    long long low, high;
+    if (!PyArg_ParseTuple(args, "LL", &low, &high)) return nullptr;
+    if (high - low >= (1 << 22)) {
+        PyErr_SetString(PyExc_ValueError, "window too large");
+        return nullptr;
+    }
+    if (low == p->low && high == p->high) Py_RETURN_NONE;  // unchanged
+    std::vector<SeqSlot> fresh((size_t)(high >= low ? high - low + 1 : 0));
+    for (auto &s : fresh) {
+        s.prep_mask.assign((size_t)p->words, 0);
+        s.commit_mask.assign((size_t)p->words, 0);
+    }
+    int64_t from = low > p->low ? low : p->low;
+    int64_t to = high < p->high ? high : p->high;
+    for (int64_t sn = from; sn <= to; sn++)
+        fresh[(size_t)(sn - low)] = std::move((*p->slots)[(size_t)(sn - p->low)]);
+    *p->slots = std::move(fresh);
+    p->low = low;
+    p->high = high;
+    Py_RETURN_NONE;
+}
+
+inline SeqSlot *seq_slot(SeqPlaneObj *p, int64_t seq_no) {
+    if (seq_no < p->low || seq_no > p->high) return nullptr;
+    return &(*p->slots)[(size_t)(seq_no - p->low)];
+}
+
+PyObject *seqplane_set_phase(PyObject *self, PyObject *args) {
+    SeqPlaneObj *p = (SeqPlaneObj *)self;
+    long long seq_no;
+    int phase;
+    if (!PyArg_ParseTuple(args, "Li", &seq_no, &phase)) return nullptr;
+    SeqSlot *s = seq_slot(p, seq_no);
+    if (!s) {
+        PyErr_SetString(PyExc_IndexError, "seq_no outside plane window");
+        return nullptr;
+    }
+    s->phase = (uint8_t)phase;
+    Py_RETURN_NONE;
+}
+
+PyObject *seqplane_set_expected(PyObject *self, PyObject *args) {
+    SeqPlaneObj *p = (SeqPlaneObj *)self;
+    long long seq_no;
+    const char *d;
+    Py_ssize_t dlen;
+    if (!PyArg_ParseTuple(args, "Ly#", &seq_no, &d, &dlen)) return nullptr;
+    SeqSlot *s = seq_slot(p, seq_no);
+    if (!s) {
+        PyErr_SetString(PyExc_IndexError, "seq_no outside plane window");
+        return nullptr;
+    }
+    s->expected.assign(d, (size_t)dlen);
+    s->expected_set = true;
+    s->find_count(d, (size_t)dlen, true, /*force=*/true);
+    Py_RETURN_NONE;
+}
+
+// Core vote application.  Returns the post-increment count for the vote's
+// digest (0 when deduplicated or uncounted), and sets *hint when Python
+// should run the corresponding transition check.
+inline int32_t seq_apply_core(SeqPlaneObj *p, SeqSlot *s, int kind,
+                              const char *d, size_t dlen, int source,
+                              bool *dup, bool *hint) {
+    *dup = false;
+    *hint = false;
+    uint64_t *pw = &s->prep_mask[(size_t)(source >> 6)];
+    uint64_t *cw = &s->commit_mask[(size_t)(source >> 6)];
+    uint64_t bit = 1ULL << (source & 63);
+    bool matches_expected =
+        s->expected.size() == dlen &&
+        std::memcmp(s->expected.data(), d, dlen) == 0;
+    if (kind == 0) {  // prepare: dedup on (prep|commit) bit
+        if ((*pw | *cw) & bit) {
+            *dup = true;
+            return 0;
+        }
+        *pw |= bit;
+        if (source == p->my_id) {
+            s->my_prep.assign(d, dlen);
+            s->my_prep_set = true;
+        }
+        DigestCount *c = s->find_count(d, dlen, true, false);
+        int32_t n = 0;
+        if (c) n = ++c->prep;
+        if (s->phase == PH_PREPREPARED) {
+            if (matches_expected && n >= p->iq) *hint = true;
+        } else if (s->phase == PH_READY || s->phase == PH_PENDING_REQUESTS) {
+            *hint = true;  // digest-arrival path: Python advance_state
+        }
+        return n;
+    }
+    // commit: dedup on commit bit only
+    if (*cw & bit) {
+        *dup = true;
+        return 0;
+    }
+    *cw |= bit;
+    DigestCount *c = s->find_count(d, dlen, true, false);
+    int32_t n = 0;
+    if (c) n = ++c->commit;
+    if (s->phase == PH_PREPARED && matches_expected && n >= p->iq)
+        *hint = true;
+    return n;
+}
+
+// apply_vote(kind, seq_no, digest_bytes, source) -> None (duplicate) | count.
+// The slow-path entry used by Sequence.apply_prepare_msg/apply_commit_msg;
+// the caller has already passed the epoch_active filters.
+PyObject *seqplane_apply_vote(PyObject *self, PyObject *args) {
+    SeqPlaneObj *p = (SeqPlaneObj *)self;
+    int kind, source;
+    long long seq_no;
+    const char *d;
+    Py_ssize_t dlen;
+    if (!PyArg_ParseTuple(args, "iLy#i", &kind, &seq_no, &d, &dlen, &source))
+        return nullptr;
+    if (source < 0 || source >= p->n_nodes) {
+        PyErr_SetString(PyExc_ValueError, "source out of range");
+        return nullptr;
+    }
+    SeqSlot *s = seq_slot(p, seq_no);
+    if (!s) {
+        PyErr_SetString(PyExc_IndexError, "seq_no outside plane window");
+        return nullptr;
+    }
+    bool dup, hint;
+    int32_t n = seq_apply_core(p, s, kind, d, (size_t)dlen, source, &dup, &hint);
+    if (dup) Py_RETURN_NONE;
+    return PyLong_FromLong((long)n);
+}
+
+// apply_votes(packed, source) -> list of records, in vote order:
+//   (k,)             fallback: Python routes the original message (future
+//                    buffering, wrong epoch, unpackable digest)
+//   (kind, seq_no)   hint: Python runs the transition check
+// Packed record layout (56 bytes, little-endian):
+//   u8 kind (0 prepare, 1 commit, 255 unpackable), u8 dlen (<=32), pad[6],
+//   i64 seq_no, i64 epoch, u8 digest[32].
+PyObject *seqplane_apply_votes(PyObject *self, PyObject *args) {
+    SeqPlaneObj *p = (SeqPlaneObj *)self;
+    Py_buffer packed;
+    int source;
+    if (!PyArg_ParseTuple(args, "y*i", &packed, &source)) return nullptr;
+    if (source < 0 || source >= p->n_nodes) {
+        PyBuffer_Release(&packed);
+        PyErr_SetString(PyExc_ValueError, "source out of range");
+        return nullptr;
+    }
+    PyObject *out = PyList_New(0);
+    if (!out) {
+        PyBuffer_Release(&packed);
+        return nullptr;
+    }
+    const char *base = (const char *)packed.buf;
+    Py_ssize_t n = packed.len / 56;
+    for (Py_ssize_t k = 0; k < n; k++) {
+        const char *rec = base + k * 56;
+        uint8_t kind = (uint8_t)rec[0];
+        uint8_t dlen = (uint8_t)rec[1];
+        int64_t seq_no, epoch;
+        std::memcpy(&seq_no, rec + 8, 8);
+        std::memcpy(&epoch, rec + 16, 8);
+        const char *d = rec + 24;
+
+        PyObject *item = nullptr;
+        if (kind > 1 || epoch != p->epoch) {
+            item = Py_BuildValue("(n)", (Py_ssize_t)k);  // fallback
+        } else {
+            // Mirror _step_prepare/_step_commit filters (all pre-window
+            // verdicts are silent drops, so their relative order is not
+            // observable).  PAST first: it also rejects negative seq_no
+            // before the bucket modulo, whose C++ sign would otherwise
+            // index out of bounds.
+            if (seq_no < p->low) continue;  // PAST
+            if (kind == 0 && p->nb > 0 &&
+                (*p->buckets)[(size_t)(seq_no % p->nb)] == source)
+                continue;  // INVALID: owners never send Prepare
+            if (seq_no > p->planned_expiration) continue;  // INVALID
+            if (seq_no > p->high) {
+                item = Py_BuildValue("(n)", (Py_ssize_t)k);  // FUTURE
+            } else {
+                SeqSlot *s = &(*p->slots)[(size_t)(seq_no - p->low)];
+                bool dup, hint;
+                seq_apply_core(p, s, kind, d, dlen, source, &dup, &hint);
+                if (!hint) continue;
+                item = Py_BuildValue("iL", (int)kind, (long long)seq_no);
+            }
+        }
+        if (!item || PyList_Append(out, item) < 0) {
+            Py_XDECREF(item);
+            Py_DECREF(out);
+            PyBuffer_Release(&packed);
+            return nullptr;
+        }
+        Py_DECREF(item);
+    }
+    PyBuffer_Release(&packed);
+    return out;
+}
+
+// query(seq_no) -> (prep_count, commit_count, self_prep_or_commit,
+//                   self_commit, my_prep_matches_expected)
+// Everything Python's _check_prepare_quorum/_check_commit_quorum read.
+// Counts are for the expected digest ("" until set_expected — matching the
+// Python path's `digest or b""` keying).
+PyObject *seqplane_query(PyObject *self, PyObject *args) {
+    SeqPlaneObj *p = (SeqPlaneObj *)self;
+    long long seq_no;
+    if (!PyArg_ParseTuple(args, "L", &seq_no)) return nullptr;
+    SeqSlot *s = seq_slot(p, seq_no);
+    if (!s) {
+        PyErr_SetString(PyExc_IndexError, "seq_no outside plane window");
+        return nullptr;
+    }
+    DigestCount *c =
+        s->find_count(s->expected.data(), s->expected.size(), false, false);
+    uint64_t self_p = s->prep_mask[(size_t)(p->my_id >> 6)] &
+                      (1ULL << (p->my_id & 63));
+    uint64_t self_c = s->commit_mask[(size_t)(p->my_id >> 6)] &
+                      (1ULL << (p->my_id & 63));
+    // Python dict path compares (my_prepare_digest or b"") != (digest or b"");
+    // an unset my_prep is the empty string here, matching.
+    bool my_matches = s->my_prep == s->expected;
+    return Py_BuildValue("iiiii", c ? (int)c->prep : 0,
+                         c ? (int)c->commit : 0,
+                         (self_p | self_c) ? 1 : 0, self_c ? 1 : 0,
+                         my_matches ? 1 : 0);
+}
+
+// export_slot(seq_no) -> (prep_mask, commit_mask, counts_list, my_prep|None)
+// for the pure-Python rebuild in tests / debugging.
+PyObject *seqplane_export_slot(PyObject *self, PyObject *args) {
+    SeqPlaneObj *p = (SeqPlaneObj *)self;
+    long long seq_no;
+    if (!PyArg_ParseTuple(args, "L", &seq_no)) return nullptr;
+    SeqSlot *s = seq_slot(p, seq_no);
+    if (!s) Py_RETURN_NONE;
+    PyObject *pm = mask_to_bytes(s->prep_mask.data(), p->words);
+    PyObject *cm = mask_to_bytes(s->commit_mask.data(), p->words);
+    PyObject *counts = PyList_New(0);
+    if (!pm || !cm || !counts) {
+        Py_XDECREF(pm);
+        Py_XDECREF(cm);
+        Py_XDECREF(counts);
+        return nullptr;
+    }
+    for (auto &c : s->counts) {
+        PyObject *item = Py_BuildValue(
+            "y#ii", c.digest.data(), (Py_ssize_t)c.digest.size(),
+            (int)c.prep, (int)c.commit);
+        if (!item || PyList_Append(counts, item) < 0) {
+            Py_XDECREF(item);
+            Py_DECREF(pm);
+            Py_DECREF(cm);
+            Py_DECREF(counts);
+            return nullptr;
+        }
+        Py_DECREF(item);
+    }
+    PyObject *my_prep;
+    if (s->my_prep_set)
+        my_prep = PyBytes_FromStringAndSize(s->my_prep.data(),
+                                            (Py_ssize_t)s->my_prep.size());
+    else {
+        my_prep = Py_None;
+        Py_INCREF(Py_None);
+    }
+    if (!my_prep) {
+        Py_DECREF(pm);
+        Py_DECREF(cm);
+        Py_DECREF(counts);
+        return nullptr;
+    }
+    return Py_BuildValue("NNNN", pm, cm, counts, my_prep);
+}
+
+PyMethodDef seqplane_methods[] = {
+    {"reset", seqplane_reset, METH_VARARGS, nullptr},
+    {"set_window", seqplane_set_window, METH_VARARGS, nullptr},
+    {"set_phase", seqplane_set_phase, METH_VARARGS, nullptr},
+    {"set_expected", seqplane_set_expected, METH_VARARGS, nullptr},
+    {"apply_vote", seqplane_apply_vote, METH_VARARGS, nullptr},
+    {"apply_votes", seqplane_apply_votes, METH_VARARGS, nullptr},
+    {"query", seqplane_query, METH_VARARGS, nullptr},
+    {"export_slot", seqplane_export_slot, METH_VARARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject SeqPlaneType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+// ---------------------------------------------------------------------------
 // Module-level functions.
 
 PyObject *interned_str_client_id;
 PyObject *interned_str_req_no;
 PyObject *interned_str_digest;
+PyObject *interned_str_seq_no;
+PyObject *interned_str_epoch;
+
+// Message classes registered once by the Python glue so pack_votes can
+// classify by exact type (borrowed refs held for the process lifetime).
+PyObject *g_prepare_type = nullptr;
+PyObject *g_commit_type = nullptr;
+
+// register_vote_types(Prepare, Commit)
+PyObject *mod_register_vote_types(PyObject *, PyObject *args) {
+    PyObject *prep, *commit;
+    if (!PyArg_ParseTuple(args, "OO", &prep, &commit)) return nullptr;
+    Py_XDECREF(g_prepare_type);
+    Py_XDECREF(g_commit_type);
+    Py_INCREF(prep);
+    Py_INCREF(commit);
+    g_prepare_type = prep;
+    g_commit_type = commit;
+    Py_RETURN_NONE;
+}
+
+// pack_votes(msgs) -> (packed_bytes, vote_msgs, rest)
+// Splits an envelope's messages into the Prepare/Commit vote stream (packed
+// for SeqPlane.apply_votes, originals kept aligned by index for fallback
+// routing) and the rest.  A vote whose digest exceeds 32 bytes is packed as
+// kind 255 (unpackable -> fallback).  Record layout matches SeqPlane.apply_votes (56 bytes).
+PyObject *mod_pack_votes(PyObject *, PyObject *arg) {
+    if (!g_prepare_type) {
+        PyErr_SetString(PyExc_RuntimeError, "vote types not registered");
+        return nullptr;
+    }
+    PyObject *seq = PySequence_Fast(arg, "pack_votes expects a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject *votes = PyList_New(0);
+    PyObject *rest = PyList_New(0);
+    PyObject *packed = nullptr;
+    std::string buf;
+    buf.reserve((size_t)n * 56);
+    if (!votes || !rest) goto fail;
+    for (Py_ssize_t k = 0; k < n; k++) {
+        PyObject *msg = PySequence_Fast_GET_ITEM(seq, k);
+        PyObject *t = (PyObject *)Py_TYPE(msg);
+        int kind;
+        if (t == g_prepare_type)
+            kind = 0;
+        else if (t == g_commit_type)
+            kind = 1;
+        else {
+            if (PyList_Append(rest, msg) < 0) goto fail;
+            continue;
+        }
+        PyObject *sn_o = PyObject_GetAttr(msg, interned_str_seq_no);
+        PyObject *ep_o = sn_o ? PyObject_GetAttr(msg, interned_str_epoch) : nullptr;
+        PyObject *dg_o = ep_o ? PyObject_GetAttr(msg, interned_str_digest) : nullptr;
+        if (!dg_o) {
+            Py_XDECREF(sn_o);
+            Py_XDECREF(ep_o);
+            goto fail;
+        }
+        {
+            int64_t seq_no = PyLong_AsLongLong(sn_o);
+            int64_t epoch = PyLong_AsLongLong(ep_o);
+            char *d = nullptr;
+            Py_ssize_t dlen = 0;
+            int bad = PyBytes_AsStringAndSize(dg_o, &d, &dlen) < 0;
+            if (bad) PyErr_Clear();
+            char rec[56];
+            std::memset(rec, 0, 56);
+            if (bad || dlen > 32 || PyErr_Occurred()) {
+                PyErr_Clear();
+                rec[0] = (char)(uint8_t)255;  // unpackable -> fallback
+            } else {
+                rec[0] = (char)(uint8_t)kind;
+                rec[1] = (char)(uint8_t)dlen;
+                std::memcpy(rec + 24, d, (size_t)dlen);
+            }
+            std::memcpy(rec + 8, &seq_no, 8);
+            std::memcpy(rec + 16, &epoch, 8);
+            buf.append(rec, 56);
+        }
+        Py_DECREF(sn_o);
+        Py_DECREF(ep_o);
+        Py_DECREF(dg_o);
+        if (PyList_Append(votes, msg) < 0) goto fail;
+    }
+    packed = PyBytes_FromStringAndSize(buf.data(), (Py_ssize_t)buf.size());
+    if (!packed) goto fail;
+    Py_DECREF(seq);
+    return Py_BuildValue("NNN", packed, votes, rest);
+fail:
+    Py_XDECREF(votes);
+    Py_XDECREF(rest);
+    Py_XDECREF(packed);
+    Py_DECREF(seq);
+    return nullptr;
+}
 
 // pack_acks(acks: sequence of RequestAck) -> bytes (16 bytes per ack).
 PyObject *mod_pack_acks(PyObject *, PyObject *arg) {
@@ -556,6 +1070,8 @@ PyObject *mod_digest_bytes(PyObject *, PyObject *arg) {
 PyMethodDef module_methods[] = {
     {"pack_acks", mod_pack_acks, METH_O, nullptr},
     {"digest_bytes", mod_digest_bytes, METH_O, nullptr},
+    {"register_vote_types", mod_register_vote_types, METH_VARARGS, nullptr},
+    {"pack_votes", mod_pack_votes, METH_O, nullptr},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -576,16 +1092,32 @@ PyMODINIT_FUNC PyInit__core(void) {
     PlaneType.tp_methods = plane_methods;
     if (PyType_Ready(&PlaneType) < 0) return nullptr;
 
+    SeqPlaneType.tp_name = "mirbft_tpu._native._core.SeqPlane";
+    SeqPlaneType.tp_basicsize = sizeof(SeqPlaneObj);
+    SeqPlaneType.tp_flags = Py_TPFLAGS_DEFAULT;
+    SeqPlaneType.tp_new = seqplane_new;
+    SeqPlaneType.tp_dealloc = seqplane_dealloc;
+    SeqPlaneType.tp_methods = seqplane_methods;
+    if (PyType_Ready(&SeqPlaneType) < 0) return nullptr;
+
     g_intern = new InternTable();
     interned_str_client_id = PyUnicode_InternFromString("client_id");
     interned_str_req_no = PyUnicode_InternFromString("req_no");
     interned_str_digest = PyUnicode_InternFromString("digest");
+    interned_str_seq_no = PyUnicode_InternFromString("seq_no");
+    interned_str_epoch = PyUnicode_InternFromString("epoch");
 
     PyObject *m = PyModule_Create(&moduledef);
     if (!m) return nullptr;
     Py_INCREF(&PlaneType);
     if (PyModule_AddObject(m, "AckPlane", (PyObject *)&PlaneType) < 0) {
         Py_DECREF(&PlaneType);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    Py_INCREF(&SeqPlaneType);
+    if (PyModule_AddObject(m, "SeqPlane", (PyObject *)&SeqPlaneType) < 0) {
+        Py_DECREF(&SeqPlaneType);
         Py_DECREF(m);
         return nullptr;
     }

@@ -242,11 +242,16 @@ class AckMsg:
     ack: RequestAck
 
 
-@dataclass(frozen=True, slots=True)
+@dataclass(frozen=True, slots=True, weakref_slot=True)
 class MsgBatch:
     """Transport envelope: a sequence of consensus messages from one sender
-    to the same targets, delivered and processed in order as if sent
-    individually.  Nesting is not allowed.
+    to the same targets, delivered atomically.  Nesting is not allowed.
+
+    Processing order: the receiver applies the envelope's Prepare/Commit
+    votes first (in order), then the remaining messages (in order) — see
+    ``machine.StateMachine.step``.  Relative to per-message delivery this is
+    merely a different (still deterministic) interleaving, which the
+    protocol must tolerate from any asynchronous network anyway.
 
     Extension over the reference, whose Link sends every protocol message as
     its own transmission.  Consensus traffic is many tiny messages — at N

@@ -159,10 +159,15 @@ class ClientReqNo:
         self.acked_digest: Optional[bytes] = None  # digest our ack endorsed
         self.resend_nonce = 0  # invalidates stale resend-schedule entries
 
-    def reinitialize(self, network_config: NetworkConfig) -> None:
+    def reinitialize(
+        self, network_config: NetworkConfig, same_config: Optional[bool] = None
+    ) -> None:
         """Re-derive quorum sets under a (possibly changed) config
-        (reference :371-408)."""
-        if network_config == self.network_config:
+        (reference :371-408).  ``same_config`` lets the caller hoist the
+        config comparison out of the per-slot loop."""
+        if same_config is None:
+            same_config = network_config == self.network_config
+        if same_config:
             # Graceful epoch rotation under an unchanged config: the same
             # node set and quorum thresholds re-derive the same agreement
             # masks and weak/strong/my sets, so the rebuild below is an
@@ -372,6 +377,7 @@ class Client:
         self.weak_quorum = some_correct_quorum(network_config)
         self.strong_quorum = intersection_quorum(network_config)
         old_req_nos = self.req_nos
+        old_config = self.network_config
 
         # Window is exactly `width` slots, [lw, lw+width-1]; the portion
         # usable before the next checkpoint excludes what the previous
@@ -395,6 +401,7 @@ class Client:
             self.next_ack_mark = client_state.low_watermark
         self.req_nos = {}
 
+        same_config = network_config == old_config
         for req_no in range(client_state.low_watermark, self.high_watermark + 1):
             crn = old_req_nos.get(req_no)
             if crn is None:
@@ -412,7 +419,7 @@ class Client:
                 )
                 actions.allocate_request(client_state.id, req_no)
             crn.committed = is_committed(req_no, client_state)
-            crn.reinitialize(network_config)
+            crn.reinitialize(network_config, same_config)
             self.req_nos[req_no] = crn
 
         self.attention = {

@@ -34,7 +34,8 @@ from .outstanding import AllOutstandingReqs
 from .persisted import PersistedLog
 from .proposer import Proposer
 from .sequence import SeqState, Sequence
-from .stateless import seq_to_bucket
+from .stateless import intersection_quorum, seq_to_bucket
+from .voteplane import make_seq_plane
 
 
 class PreprepareBuffer:
@@ -91,6 +92,7 @@ class ActiveEpoch:
         "_ci",
         "_owned_buckets",
         "_buffered",
+        "seq_plane",
     )
 
     def __init__(
@@ -169,6 +171,26 @@ class ActiveEpoch:
         self.sequences: List[List[Sequence]] = []
         self.last_committed_at_tick = 0
         self.ticks_since_progress = 0
+
+        # Native vote plane for this epoch's window (None = pure-Python).
+        # Mirrors the watermark window exactly; see voteplane.py.
+        plane = make_seq_plane(
+            len(network_config.nodes),
+            my_config.id,
+            intersection_quorum(network_config),
+        )
+        if plane is not None:
+            import struct
+
+            plane.reset(
+                epoch_config.number,
+                epoch_config.planned_expiration,
+                struct.pack(
+                    f"<{num_buckets}i",
+                    *(self.buckets[i] for i in range(num_buckets)),
+                ),
+            )
+        self.seq_plane = plane
 
     # --- window geometry ---
 
@@ -325,6 +347,42 @@ class ActiveEpoch:
         self._commit_cascade()
         return Actions()
 
+    def apply_envelope_votes(
+        self, packed: bytes, vote_msgs: List[Msg], source: int, step
+    ) -> Actions:
+        """Apply one transport envelope's Prepare/Commit votes through the
+        native plane in a single call, then run the returned records in vote
+        order: fallbacks re-enter the generic ``step`` with the original
+        message (future buffering, other epochs), hints run the transition
+        checks the per-message path would have run — which re-validate every
+        quorum condition against the plane's live counts, so hints are safe
+        to be liberal."""
+        actions = Actions()
+        records = self.seq_plane.apply_votes(packed, source)
+        for rec in records:
+            if len(rec) == 1:
+                actions.concat(step(source, vote_msgs[rec[0]]))
+                continue
+            kind, seq_no = rec
+            seq = self.sequence(seq_no)
+            if kind == 0:
+                # Mirrors apply_prepare_msg's state arms.
+                s = seq.state
+                if (
+                    s is SeqState.PREPREPARED
+                    or s is SeqState.READY
+                    or s is SeqState.PENDING_REQUESTS
+                ):
+                    actions.concat(seq.advance_state())
+            else:
+                seq._check_commit_quorum()
+            if (
+                seq.state is SeqState.COMMITTED
+                and seq.seq_no == self.lowest_uncommitted
+            ):
+                self._commit_cascade()
+        return actions
+
     def _commit_cascade(self) -> None:
         """Feed consecutive committed sequences into CommitState, in order."""
         seqs = self.sequences
@@ -399,6 +457,8 @@ class ActiveEpoch:
         actions = self.advance()
         while seq_no > self.low_watermark():
             self.sequences = self.sequences[1:]
+        if self.seq_plane is not None and self.sequences:
+            self.seq_plane.set_window(self.low_watermark(), self.high_watermark())
         return actions, False
 
     def drain_buffers(self) -> Actions:
@@ -480,10 +540,14 @@ class ActiveEpoch:
                     persisted=self.persisted,
                     network_config=self.network_config,
                     my_id=self.my_config.id,
+                    plane=self.seq_plane,
                 )
                 for i in range(ci)
             ]
             self.sequences.append(chunk)
+
+        if self.seq_plane is not None and self.sequences:
+            self.seq_plane.set_window(self.low_watermark(), self.high_watermark())
 
         actions.concat(self.drain_buffers())
 

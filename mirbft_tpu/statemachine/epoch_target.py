@@ -95,6 +95,8 @@ class EpochTarget:
         "network_config",
         "my_config",
         "logger",
+        "_ec_digests",
+        "_ec_keys",
     )
 
     def __init__(
@@ -146,6 +148,22 @@ class EpochTarget:
         self.network_config = network_config
         self.my_config = my_config
         self.logger = logger
+        # Digest memo for epoch-change ack hashing: every ack carrying the
+        # same EpochChange content hashes to the same digest, so only the
+        # first ack per distinct content pays the hash-action round-trip
+        # (the reference hashes every ack, epoch_target.go:514-528 — O(N³)
+        # cluster-wide per epoch change).  The memo is keyed by CONTENT
+        # (the flattened hash-data tuple) so behavior is a deterministic
+        # function of the event stream — a serialized replay reproduces the
+        # exact same state even though it sees fresh message objects.
+        # content_key -> (digest, waiters): digest None while the hash
+        # action is in flight, with (source, origin) pairs queued to apply
+        # when the result lands.
+        self._ec_digests: Dict[tuple, list] = {}
+        # In-process transports hand all N acks the same message OBJECT, so
+        # an identity side-table skips re-flattening per ack (values pin the
+        # msg so ids stay stable); replay simply misses here and re-flattens.
+        self._ec_keys: Dict[int, tuple] = {}
 
     # --- three-phase traffic routing (reference :120-131) ---
 
@@ -413,23 +431,63 @@ class EpochTarget:
         self, source: int, origin: int, msg: EpochChange
     ) -> Actions:
         """Hash the acked epoch change (on the TPU batcher); processing
-        resumes in apply_epoch_change_digest (reference :514-528)."""
+        resumes in apply_epoch_change_digest (reference :514-528).
+
+        Digest memo: the reference hashes every ack separately — O(N²) per
+        node, O(N³) cluster-wide per epoch change.  Acks referencing epoch-
+        change content this node has already hashed (or has in flight) skip
+        the round-trip: a known digest applies synchronously, an in-flight
+        one queues the (source, origin) pair for when the result lands."""
+        key = self._ec_key(msg)
+        entry = self._ec_digests.get(key)
+        if entry is not None:
+            if entry[0] is not None:
+                return self._apply_ec_digest(source, origin, msg, entry[0])
+            entry[1].append((source, origin))
+            return Actions()
+        self._ec_digests[key] = [None, []]
         return Actions().hash(
-            epoch_change_hash_data(msg),
+            list(key),
             st.EpochChangeOrigin(source=source, origin=origin, epoch_change=msg),
         )
+
+    def _ec_key(self, msg: EpochChange) -> tuple:
+        """Content key for the digest memo.  The identity side-table entry
+        stores the msg itself, pinning the id for the table's lifetime."""
+        entry = self._ec_keys.get(id(msg))
+        if entry is not None and entry[0] is msg:
+            return entry[1]
+        key = tuple(epoch_change_hash_data(msg))
+        self._ec_keys[id(msg)] = (msg, key)
+        return key
 
     def apply_epoch_change_digest(
         self, origin: st.EpochChangeOrigin, digest: bytes
     ) -> Actions:
-        """Reference :534-560."""
-        origin_node = origin.origin
-        source_node = origin.source
+        """Reference :534-560, plus draining the digest-memo waiters."""
+        msg = origin.epoch_change
+        key = self._ec_key(msg)
+        entry = self._ec_digests.get(key)
+        waiters: list = []
+        if entry is not None and entry[0] is None:
+            waiters = entry[1]
+        self._ec_digests[key] = [digest, []]
+        actions = self._apply_ec_digest(origin.source, origin.origin, msg, digest)
+        for source, origin_node in waiters:
+            actions.concat(
+                self._apply_ec_digest(source, origin_node, msg, digest)
+            )
+        return actions
+
+    def _apply_ec_digest(
+        self, source_node: int, origin_node: int, msg: EpochChange, digest: bytes
+    ) -> Actions:
+        """One ack's digest application (reference :534-560)."""
         votes = self.changes.get(origin_node)
         if votes is None:
             votes = EpochChangeVotes(self.network_config)
             self.changes[origin_node] = votes
-        votes.add_ack(source_node, origin.epoch_change, digest)
+        votes.add_ack(source_node, msg, digest)
         if votes.strong_cert is not None and origin_node not in self.strong_changes:
             self.strong_changes[origin_node] = votes.parsed_by_digest[
                 votes.strong_cert

@@ -51,6 +51,7 @@ from .epoch_target import EpochTargetState
 from .epoch_tracker import EpochTracker
 from .msgbuffers import NodeBuffers
 from .persisted import PersistedLog
+from .voteplane import split_votes
 
 _ET_IN_PROGRESS = EpochTargetState.IN_PROGRESS
 
@@ -314,9 +315,23 @@ class StateMachine:
         if t is AckBatch or t is AckMsg or t is FetchRequest or t is ForwardRequest:
             return self.client_hash_disseminator.step(source, msg)
         if t is MsgBatch:
-            # Transport envelope: process contents in order as one event
-            # (the post-event fixpoint in apply_event runs once for the
-            # whole envelope, which is where the amortization comes from).
+            # Transport envelope: one event for the whole envelope (the
+            # post-event fixpoint in apply_event runs once), and — when the
+            # native vote plane is live — the envelope's Prepare/Commit
+            # votes are applied with a single native call on a packed
+            # representation shared by every receiver (voteplane.py).
+            target = self.epoch_tracker.current_epoch
+            if target.state is _ET_IN_PROGRESS:
+                plane = target.active_epoch.seq_plane
+                if plane is not None:
+                    packed, vote_msgs, rest = split_votes(msg)
+                    if vote_msgs:
+                        actions = target.active_epoch.apply_envelope_votes(
+                            packed, vote_msgs, source, self.step
+                        )
+                        for inner in rest:
+                            actions.concat(self.step(source, inner))
+                        return actions
             actions = Actions()
             for inner in msg.msgs:
                 actions.concat(self.step(source, inner))

@@ -6,6 +6,12 @@ PREPARED → COMMITTED`` (sequence.go:18-26), batch-digest hashing on
 allocation (:142-177) — the hash request is the unit of work the TPU batcher
 aggregates — QEntry-persist-then-send on preprepare (:203-255), and the
 intersection-quorum prepare/commit rules (:276-355).
+
+Vote accumulation (the O(N²) Prepare/Commit hot path) runs in the native
+sequence-vote plane when available (``voteplane.py`` / ackplane.cpp); the
+dict-based path below is the pure-Python semantic reference.  Both paths
+share the lifecycle/transition code: quorum checks read counts through
+``_counts()``, which consults whichever store is live.
 """
 
 from __future__ import annotations
@@ -48,7 +54,8 @@ class Sequence:
         "my_id",
         "network_config",
         "persisted",
-        "state",
+        "_state",
+        "plane",
         "q_entry",
         "client_requests",
         "batch",
@@ -70,6 +77,7 @@ class Sequence:
         persisted: PersistedLog,
         network_config: NetworkConfig,
         my_id: int,
+        plane=None,
     ):
         self.owner = owner
         self.seq_no = seq_no
@@ -77,23 +85,40 @@ class Sequence:
         self.my_id = my_id
         self.network_config = network_config
         self.persisted = persisted
-        self.state = SeqState.UNINITIALIZED
+        # Bypass the property: the plane window may not cover this seq yet
+        # (slots default to UNINITIALIZED natively too).
+        self._state = SeqState.UNINITIALIZED
+        self.plane = plane
         self.q_entry: Optional[QEntry] = None
         self.client_requests: List = []  # ClientRequest-like (has .ack, .agreements)
         self.batch: List[RequestAck] = []
         self.outstanding_reqs: Optional[Set[RequestAck]] = None
         self.digest: Optional[bytes] = None
-        # Per-node vote tracking as replica-id bitmasks (a node's "seq choice
-        # state" in the reference is derivable: prepare recorded ⇔ bit in
-        # prep_mask|commit_mask; commit recorded ⇔ bit in commit_mask).
-        self.prep_mask = 0
-        self.commit_mask = 0
         # The digest carried by our own prepare — the only per-node digest
         # the quorum checks ever read back.
         self.my_prepare_digest: Optional[bytes] = None
-        self.prepares: Dict[bytes, int] = {}
-        self.commits: Dict[bytes, int] = {}
         self._iq = intersection_quorum(network_config)
+        if plane is None:
+            # Pure-Python vote store: per-node bitmasks (a node's "seq choice
+            # state" in the reference is derivable: prepare recorded ⇔ bit in
+            # prep_mask|commit_mask; commit recorded ⇔ bit in commit_mask)
+            # plus per-digest counts.
+            self.prep_mask = 0
+            self.commit_mask = 0
+            self.prepares: Dict[bytes, int] = {}
+            self.commits: Dict[bytes, int] = {}
+
+    # --- state, mirrored into the native plane ---
+
+    @property
+    def state(self) -> SeqState:
+        return self._state
+
+    @state.setter
+    def state(self, value: SeqState) -> None:
+        self._state = value
+        if self.plane is not None:
+            self.plane.set_phase(self.seq_no, int(value))
 
     # --- driver ---
 
@@ -101,17 +126,17 @@ class Sequence:
         """Iterate phase transitions to fixpoint (reference sequence.go:101-125)."""
         actions = Actions()
         while True:
-            old_state = self.state
-            if self.state == SeqState.PENDING_REQUESTS:
+            old_state = self._state
+            if self._state == SeqState.PENDING_REQUESTS:
                 self._check_requests()
-            elif self.state == SeqState.READY:
+            elif self._state == SeqState.READY:
                 if self.digest is not None or not self.batch:
                     actions.concat(self._prepare())
-            elif self.state == SeqState.PREPREPARED:
+            elif self._state == SeqState.PREPREPARED:
                 actions.concat(self._check_prepare_quorum())
-            elif self.state == SeqState.PREPARED:
+            elif self._state == SeqState.PREPARED:
                 self._check_commit_quorum()
-            if self.state == old_state:
+            if self._state == old_state:
                 return actions
 
     # --- allocation ---
@@ -130,10 +155,10 @@ class Sequence:
         """Reserve this sequence for a batch; emits the batch-digest hash
         request (the TPU hot-path action) unless the batch is empty
         (reference sequence.go:139-177)."""
-        if self.state != SeqState.UNINITIALIZED:
+        if self._state != SeqState.UNINITIALIZED:
             raise AssertionError(
                 f"seq_no={self.seq_no} must be uninitialized to allocate, "
-                f"was {self.state.name}"
+                f"was {self._state.name}"
             )
         self.state = SeqState.ALLOCATED
         self.batch = request_acks
@@ -178,6 +203,10 @@ class Sequence:
         """Record the batch digest (computed on TPU) and treat it as the
         owner's implicit prepare (reference sequence.go:190-194)."""
         self.digest = digest
+        if self.plane is not None:
+            self.plane.set_expected(
+                self.seq_no, digest if digest is not None else b""
+            )
         return self.apply_prepare_msg(self.owner, digest)
 
     def _prepare(self) -> Actions:
@@ -230,20 +259,29 @@ class Sequence:
         loopback BOTH increment the prepare count (its dup-check is
         ``source != owner`` only), letting a leader count itself twice toward
         the 2f+1 prepare certificate.  We count each node at most once."""
-        bit = 1 << source
-        if (self.prep_mask | self.commit_mask) & bit:
-            return Actions()
-        self.prep_mask |= bit
-        if source == self.my_id:
-            self.my_prepare_digest = digest
-        key = digest if digest is not None else b""
-        count = self.prepares.get(key, 0) + 1
-        self.prepares[key] = count
+        if self.plane is not None:
+            count = self.plane.apply_vote(
+                0, self.seq_no, digest if digest is not None else b"", source
+            )
+            if count is None:
+                return Actions()  # duplicate
+            if source == self.my_id:
+                self.my_prepare_digest = digest
+        else:
+            bit = 1 << source
+            if (self.prep_mask | self.commit_mask) & bit:
+                return Actions()
+            self.prep_mask |= bit
+            if source == self.my_id:
+                self.my_prepare_digest = digest
+            key = digest if digest is not None else b""
+            count = self.prepares.get(key, 0) + 1
+            self.prepares[key] = count
         # advance_state can only do work here when the prepare quorum on the
         # incremented digest is reachable (PREPREPARED) or when this is the
         # digest-arrival path (READY/PENDING_REQUESTS); every other state's
         # transitions do not read prepare votes, so skip the fixpoint walk.
-        state = self.state
+        state = self._state
         if state is SeqState.PREPREPARED:
             if count >= self._iq:
                 return self.advance_state()
@@ -256,20 +294,29 @@ class Sequence:
         """2f+1 prepares (leader's preprepare counts) + own prepare persisted
         → persist PEntry, send Commit (reference sequence.go:276-318)."""
         my_key = self.digest if self.digest is not None else b""
-        agreements = self.prepares.get(my_key, 0)
-
-        if not ((self.prep_mask | self.commit_mask) >> self.my_id) & 1:
-            # Have not sent our own prepare → QEntry may not be persisted.
-            return Actions()
-        my_digest = (
-            self.my_prepare_digest if self.my_prepare_digest is not None else b""
-        )
-        if my_digest != my_key:
-            # Network's correct digest differs from ours; do not prepare.
-            return Actions()
-
-        if agreements < self._iq:
-            return Actions()
+        if self.plane is not None:
+            prep_count, _, self_pc, _, my_matches = self.plane.query(self.seq_no)
+            if not self_pc:
+                # Have not sent our own prepare → QEntry may not be persisted.
+                return Actions()
+            if not my_matches:
+                # Network's correct digest differs from ours; do not prepare.
+                return Actions()
+            if prep_count < self._iq:
+                return Actions()
+        else:
+            agreements = self.prepares.get(my_key, 0)
+            if not ((self.prep_mask | self.commit_mask) >> self.my_id) & 1:
+                return Actions()
+            my_digest = (
+                self.my_prepare_digest
+                if self.my_prepare_digest is not None
+                else b""
+            )
+            if my_digest != my_key:
+                return Actions()
+            if agreements < self._iq:
+                return Actions()
 
         self.state = SeqState.PREPARED
         p_entry = PEntry(seq_no=self.seq_no, digest=my_key)
@@ -280,25 +327,39 @@ class Sequence:
 
     def apply_commit_msg(self, source: int, digest: Optional[bytes]) -> Actions:
         """Reference sequence.go:320-337."""
-        bit = 1 << source
-        if self.commit_mask & bit:
-            return Actions()  # duplicate commit
-        self.commit_mask |= bit
-        key = digest if digest is not None else b""
-        count = self.commits.get(key, 0) + 1
-        self.commits[key] = count
+        if self.plane is not None:
+            count = self.plane.apply_vote(
+                1, self.seq_no, digest if digest is not None else b"", source
+            )
+            if count is None:
+                return Actions()  # duplicate commit
+        else:
+            bit = 1 << source
+            if self.commit_mask & bit:
+                return Actions()  # duplicate commit
+            self.commit_mask |= bit
+            key = digest if digest is not None else b""
+            count = self.commits.get(key, 0) + 1
+            self.commits[key] = count
         # Only a PREPARED sequence with a reachable commit quorum can
         # transition on a commit vote (commit emission itself is action-free).
-        if self.state is SeqState.PREPARED and count >= self._iq:
+        if self._state is SeqState.PREPARED and count >= self._iq:
             self._check_commit_quorum()
         return Actions()
 
     def _check_commit_quorum(self) -> None:
         """Reference sequence.go:339-355."""
-        my_key = self.digest if self.digest is not None else b""
-        agreements = self.commits.get(my_key, 0)
-        if not (self.commit_mask >> self.my_id) & 1:
-            return  # our own Commit (and thus PEntry persist) not sent yet
-        if agreements < self._iq:
-            return
+        if self.plane is not None:
+            _, commit_count, _, self_c, _ = self.plane.query(self.seq_no)
+            if not self_c:
+                return  # our own Commit (and thus PEntry persist) not sent yet
+            if commit_count < self._iq:
+                return
+        else:
+            my_key = self.digest if self.digest is not None else b""
+            agreements = self.commits.get(my_key, 0)
+            if not (self.commit_mask >> self.my_id) & 1:
+                return
+            if agreements < self._iq:
+                return
         self.state = SeqState.COMMITTED

@@ -1,0 +1,256 @@
+"""Native sequence-vote plane: unit mechanics + differential equivalence.
+
+The native SeqPlane (ackplane.cpp) owns Prepare/Commit vote accumulation for
+the three-phase commit while the sequence lifecycle stays in Python
+(sequence.py).  These tests enforce:
+
+1. plane mechanics — dedup, per-digest counting, filter mirroring, window
+   rebase — in isolation;
+2. per-message equivalence — a Sequence driven through the plane emits
+   byte-identical actions and reaches the same state as the pure-Python
+   dict path, for randomized vote streams including conflicting digests,
+   duplicates, and out-of-order delivery;
+3. whole-run equivalence — a multi-node testengine run with the plane
+   disabled converges to the same application state as the native run.
+"""
+
+import random
+import struct
+
+import pytest
+
+from mirbft_tpu import _native
+from mirbft_tpu import state as st
+from mirbft_tpu.config import standard_initial_network_state
+from mirbft_tpu.statemachine.persisted import PersistedLog
+from mirbft_tpu.statemachine.sequence import SeqState, Sequence
+from mirbft_tpu.statemachine.stateless import intersection_quorum
+
+pytestmark = pytest.mark.skipif(
+    not _native.available, reason="native extension unavailable"
+)
+
+
+def make_plane(n_nodes, my_id, iq, epoch=0, expiration=10_000, buckets=None):
+    plane = _native.core.SeqPlane(n_nodes, my_id, iq)
+    if buckets is None:
+        buckets = list(range(n_nodes))
+    plane.reset(epoch, expiration, struct.pack(f"<{len(buckets)}i", *buckets))
+    return plane
+
+
+def pack_vote(kind, seq_no, epoch, digest):
+    return struct.pack("<BB6xqq32s", kind, len(digest), seq_no, epoch, digest)
+
+
+class TestPlaneMechanics:
+    def test_prepare_dedup_and_count(self):
+        plane = make_plane(4, 0, 3)
+        plane.set_window(1, 10)
+        d = b"d" * 32
+        assert plane.apply_vote(0, 5, d, 2) == 1
+        assert plane.apply_vote(0, 5, d, 2) is None  # duplicate
+        assert plane.apply_vote(0, 5, d, 3) == 2
+        # a commit from source 3 dedups its later prepare, not its count
+        plane2 = make_plane(4, 0, 3)
+        plane2.set_window(1, 10)
+        assert plane2.apply_vote(1, 5, d, 3) == 1  # commit
+        assert plane2.apply_vote(0, 5, d, 3) is None  # prepare after commit
+
+    def test_conflicting_digests_count_separately(self):
+        plane = make_plane(4, 0, 3)
+        plane.set_window(1, 10)
+        assert plane.apply_vote(0, 5, b"a" * 32, 1) == 1
+        assert plane.apply_vote(0, 5, b"b" * 32, 2) == 1
+        assert plane.apply_vote(0, 5, b"a" * 32, 3) == 2
+        plane.set_expected(5, b"a" * 32)
+        prep, commit, _, _, _ = plane.query(5)
+        assert (prep, commit) == (2, 0)
+
+    def test_expected_before_votes(self):
+        plane = make_plane(4, 0, 3)
+        plane.set_window(1, 10)
+        plane.set_expected(5, b"a" * 32)
+        plane.apply_vote(0, 5, b"a" * 32, 1)
+        assert plane.query(5)[0] == 1
+
+    def test_envelope_filters(self):
+        # buckets [0,1,2,3]: seq 6 -> bucket 2 -> owner 2
+        plane = make_plane(4, 0, 3, epoch=7, expiration=100)
+        plane.set_window(5, 20)
+        d = b"x" * 32
+        # prepare from the owner: INVALID, silently dropped
+        assert plane.apply_votes(pack_vote(0, 6, 7, d), 2) == []
+        assert plane.export_slot(6)[2] == []
+        # wrong epoch: fallback record
+        assert plane.apply_votes(pack_vote(0, 6, 8, d), 1) == [(0,)]
+        # past: silent drop; future: fallback
+        assert plane.apply_votes(pack_vote(0, 4, 7, d), 1) == []
+        assert plane.apply_votes(pack_vote(1, 21, 7, d), 1) == [(0,)]
+        # beyond planned expiration: silent drop
+        assert plane.apply_votes(pack_vote(1, 101, 7, d), 1) == []
+
+    def test_hint_on_quorum(self):
+        plane = make_plane(4, 1, 3)  # we are node 1
+        plane.set_window(1, 10)
+        d = b"h" * 32
+        plane.set_expected(6, d)
+        plane.set_phase(6, int(SeqState.PREPREPARED))
+        # seq 6 -> bucket 2 -> owner 2; votes from 0, 3 + own
+        assert plane.apply_votes(pack_vote(0, 6, 0, d), 0) == []
+        assert plane.apply_votes(pack_vote(0, 6, 0, d), 3) == []
+        assert plane.apply_votes(pack_vote(0, 6, 0, d), 1) == [(0, 6)]
+        prep, _, self_pc, _, my_match = plane.query(6)
+        assert prep == 3 and self_pc == 1 and my_match == 1
+
+    def test_window_rebase_preserves_overlap(self):
+        plane = make_plane(4, 0, 3)
+        plane.set_window(1, 10)
+        d = b"w" * 32
+        plane.apply_vote(0, 8, d, 1)
+        plane.apply_vote(1, 8, d, 2)
+        plane.set_window(5, 20)
+        pm, cm, counts, _ = plane.export_slot(8)
+        assert counts == [(d, 1, 1)]
+        # slots that left the window are gone
+        assert plane.export_slot(3) is None
+
+
+def network_config(n_nodes=4):
+    return standard_initial_network_state(n_nodes, 0).config
+
+
+def build_sequence(owner, my_id, plane, seq_no=5, epoch=0, n_nodes=4):
+    from mirbft_tpu import messages as m
+
+    state = standard_initial_network_state(n_nodes, 0)
+    log = PersistedLog()
+    log.append_initial_load(
+        1, m.CEntry(seq_no=0, checkpoint_value=b"genesis", network_state=state)
+    )
+    log.append_initial_load(
+        2,
+        m.FEntry(
+            ends_epoch_config=m.EpochConfig(
+                0, tuple(range(n_nodes)), 0
+            )
+        ),
+    )
+    return Sequence(
+        owner=owner,
+        epoch=epoch,
+        seq_no=seq_no,
+        persisted=log,
+        network_config=state.config,
+        my_id=my_id,
+        plane=plane,
+    )
+
+
+def seq_fingerprint(seq):
+    return (
+        seq.state,
+        seq.digest,
+        seq.my_prepare_digest,
+        seq.q_entry,
+    )
+
+
+class TestSequenceEquivalence:
+    """Randomized differential: plane-backed vs dict-backed Sequence."""
+
+    def run_stream(self, plane_mode, events, owner, my_id, n_nodes=4):
+        if plane_mode:
+            plane = make_plane(
+                n_nodes, my_id, intersection_quorum(network_config(n_nodes))
+            )
+            plane.set_window(1, 40)
+        else:
+            plane = None
+        seq = build_sequence(owner, my_id, plane, n_nodes=n_nodes)
+        emitted = []
+        for kind, *rest in events:
+            if kind == "allocate":
+                from mirbft_tpu.messages import RequestAck
+
+                batch = [
+                    RequestAck(client_id=0, req_no=i, digest=b"%02d" % i * 16)
+                    for i in range(rest[0])
+                ]
+                emitted.append(seq.allocate(batch, None).items)
+            elif kind == "hash":
+                emitted.append(seq.apply_batch_hash_result(rest[0]).items)
+            elif kind == "prepare":
+                source, digest = rest
+                emitted.append(seq.apply_prepare_msg(source, digest).items)
+            else:
+                source, digest = rest
+                emitted.append(seq.apply_commit_msg(source, digest).items)
+        return seq, emitted
+
+    def test_randomized_streams(self):
+        for seed in range(12):
+            rng = random.Random(seed)
+            n_nodes = rng.choice([4, 7])
+            owner = rng.randrange(n_nodes)
+            my_id = rng.randrange(n_nodes)
+            good = b"g" * 32
+            evil = b"e" * 32
+            events = [("allocate", rng.randrange(0, 3))]
+            hash_at = rng.randrange(0, 10)
+            votes = []
+            for _ in range(30):
+                digest = good if rng.random() < 0.8 else rng.choice([evil, b""])
+                votes.append(
+                    (
+                        rng.choice(["prepare", "commit"]),
+                        rng.randrange(n_nodes),
+                        digest,
+                    )
+                )
+            null_batch = events[0][1] == 0
+            expected = None if null_batch else good
+            for i, vote in enumerate(votes):
+                if i == hash_at and not null_batch:
+                    events.append(("hash", good))
+                events.append(vote)
+            if hash_at >= len(votes) and not null_batch:
+                events.append(("hash", good))
+
+            a_seq, a_emitted = self.run_stream(True, events, owner, my_id, n_nodes)
+            b_seq, b_emitted = self.run_stream(False, events, owner, my_id, n_nodes)
+            assert seq_fingerprint(a_seq) == seq_fingerprint(b_seq), (
+                f"state diverged seed={seed}"
+            )
+            assert a_emitted == b_emitted, f"actions diverged seed={seed}"
+
+
+class TestWholeRunEquivalence:
+    def test_native_matches_pure_python_final_state(self, monkeypatch):
+        from mirbft_tpu.statemachine import epoch_active
+        from mirbft_tpu.testengine import Spec
+
+        def run(disable_plane):
+            if disable_plane:
+                monkeypatch.setattr(
+                    epoch_active, "make_seq_plane", lambda *a, **k: None
+                )
+            else:
+                monkeypatch.undo()
+            spec = Spec(node_count=4, client_count=4, reqs_per_client=30)
+            rec = spec.recorder().recording()
+            rec.drain_clients(timeout=500_000)
+            states = []
+            for node in rec.nodes:
+                states.append(
+                    (
+                        node.state.checkpoint_hash,
+                        dict(node.state.committed_reqs),
+                    )
+                )
+            return states
+
+        native = run(False)
+        pure = run(True)
+        assert native == pure
+        assert len({h for h, _ in native}) == 1

@@ -15,7 +15,7 @@ from mirbft_tpu.testengine import After, For, Spec, Until, matching
 # Determinism pins — tier 3.  Any semantic change to the state machine or
 # scheduler shows up here first.  (Reference pins: 67 and 43,950 steps.)
 PIN_1N1C3R_STEPS = 61
-PIN_4N4C200R_STEPS = 6468
+PIN_4N4C200R_STEPS = 6528
 PIN_4N4C200R_HASH = "bd5ab97be3938aae99cab2ef4df70d2fea3173ea89ba212760f96e9a6b14306a"
 PIN_4N4C200R_EPOCH = 4
 
