@@ -11,6 +11,8 @@ epoch-change data) is dispatched to the TPU batcher in ``mirbft_tpu.ops``.
 
 from __future__ import annotations
 
+import bisect
+
 from typing import Dict, List, Mapping, Optional, Protocol, Tuple
 
 from ..messages import (
@@ -225,17 +227,33 @@ def construct_new_epoch_config(
     final_preprepares: List[bytes] = [b""] * window
     any_selected = False
 
+    # Precomputation for the per-sequence scan: the window is 2 checkpoint
+    # intervals wide and p-sets are sparse (empty on a graceful rotation),
+    # so probing every (offset, node) pair costs O(window * n) dict lookups
+    # for nothing.  One pass over the p-sets yields, per offset, the
+    # candidate entries in config.nodes order (the A-scan's iteration
+    # order) and the count of changes that admit the seq with no P-entry
+    # (condition B's numerator, combined with the sorted-watermark count).
+    candidates: List[List] = [[] for _ in range(window)]
+    entry_counts = [0] * window  # changes with lw < seq AND a P-entry at seq
+    for node in config.nodes:  # deterministic order
+        node_ec = epoch_changes.get(node)
+        if node_ec is None:
+            continue
+        lw = node_ec.low_watermark
+        for p_seq, p_entry in node_ec.p_set.items():
+            p_off = p_seq - cp_seq - 1
+            if 0 <= p_off < window:
+                candidates[p_off].append(p_entry)
+                if lw < p_seq:
+                    entry_counts[p_off] += 1
+    sorted_lws = sorted(ec.low_watermark for ec in epoch_changes.values())
+
     for offset in range(window):
         seq_no = cp_seq + 1 + offset
         selected: Optional[EpochChangeSetEntry] = None
 
-        for node in config.nodes:  # deterministic order
-            ec = epoch_changes.get(node)
-            if ec is None:
-                continue
-            entry = ec.p_set.get(seq_no)
-            if entry is None:
-                continue
+        for entry in candidates[offset]:
 
             # Condition A1: ≥ intersection quorum of nodes whose watermark
             # admits seq_no either saw nothing newer at seq_no, or agree.
@@ -278,11 +296,10 @@ def construct_new_epoch_config(
             continue
 
         # Condition B: an intersection quorum has no P-entry at seq_no
-        # (→ safe to fill with a null request).
-        b_count = sum(
-            1
-            for ec in epoch_changes.values()
-            if ec.low_watermark < seq_no and seq_no not in ec.p_set
+        # (→ safe to fill with a null request).  #changes with lw < seq_no
+        # minus those that do have a P-entry there (precomputed above).
+        b_count = (
+            bisect.bisect_left(sorted_lws, seq_no) - entry_counts[offset]
         )
         if b_count < intersection_quorum(config):
             return None  # cannot satisfy A or B yet; wait for more changes
