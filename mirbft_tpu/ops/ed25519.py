@@ -431,7 +431,18 @@ class Ed25519BatchVerifier:
                 [verify_one(p, m, s) for p, m, s in zip(pubs, msgs, sigs)],
                 dtype=bool,
             )
+        return self.collect(self.dispatch(pubs, msgs, sigs))
 
+    def dispatch(
+        self,
+        pubs: Sequence[bytes],
+        msgs: Sequence[bytes],
+        sigs: Sequence[bytes],
+    ) -> "VerifyDispatch":
+        """Asynchronously verify a batch: packs the inputs, enqueues ONE
+        kernel call, and returns without blocking on the device.  Use
+        ``collect`` to materialize the verdicts."""
+        n = len(pubs)
         batch = _next_pow2(n)
         ax = np.zeros((batch, NUM_LIMBS), dtype=np.int32)
         ay = np.zeros((batch, NUM_LIMBS), dtype=np.int32)
@@ -456,5 +467,22 @@ class Ed25519BatchVerifier:
             s_bits[i] = _bits_le(s)
             h_bits[i] = _bits_le(_challenge(sig[:32], bytes(pub), bytes(msg)))
 
-        ok = np.asarray(ed25519_verify_kernel(ax, ay, r_bytes, s_bits, h_bits))
-        return ok[:n] & valid[:n]
+        ok = ed25519_verify_kernel(ax, ay, r_bytes, s_bits, h_bits)
+        return VerifyDispatch(ok, valid, n)
+
+    def collect(self, handle: "VerifyDispatch") -> np.ndarray:
+        """Block until a dispatch's verdicts are host-resident."""
+        ok = np.asarray(handle.ok)
+        return ok[: handle.count] & handle.valid[: handle.count]
+
+
+class VerifyDispatch:
+    """An in-flight async verification dispatch (device-resident verdicts
+    plus the host-side structural-validity mask)."""
+
+    __slots__ = ("ok", "valid", "count")
+
+    def __init__(self, ok, valid: np.ndarray, count: int):
+        self.ok = ok
+        self.valid = valid
+        self.count = count

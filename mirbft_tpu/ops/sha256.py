@@ -162,6 +162,19 @@ def digests_from_words(words: np.ndarray) -> List[bytes]:
     return [be[i].tobytes() for i in range(be.shape[0])]
 
 
+class HashDispatch:
+    """An in-flight async device dispatch: the result array is still on the
+    device; ``TpuHasher.collect`` materializes it.  Launching costs one
+    enqueue (non-blocking); the ~100 ms round-trip of a tunneled device is
+    paid only when (and if) the digests are first needed."""
+
+    __slots__ = ("words", "count")
+
+    def __init__(self, words, count: int):
+        self.words = words  # jax [B, 8] uint32, possibly padded rows
+        self.count = count  # real rows
+
+
 class TpuHasher:
     """Batched SHA-256 ``processor.Hasher`` backed by the JAX kernel.
 
@@ -172,12 +185,69 @@ class TpuHasher:
     ``min_device_batch``: below this many messages the hashlib path is used —
     dispatch overhead dominates for tiny batches (the testengine's default
     traffic) while large batches (the throughput path) go to the device.
+
+    ``kernel``: "scan" (vmapped lax.scan, the default) or "pallas" (explicit
+    VMEM tiling; see ``ops/sha256_pallas.py``).  ``dispatch``/``collect``
+    expose the asynchronous path: ``dispatch`` enqueues the device work and
+    returns immediately; ``collect`` blocks until the digests are on host.
     """
 
-    def __init__(self, min_device_batch: int = 32, max_block_bucket: int = 1 << 14):
+    def __init__(
+        self,
+        min_device_batch: int = 32,
+        max_block_bucket: int = 1 << 14,
+        kernel: str = "scan",
+    ):
         self.min_device_batch = min_device_batch
         self.max_block_bucket = max_block_bucket
+        if kernel not in ("scan", "pallas"):
+            raise ValueError(f"unknown sha256 kernel {kernel!r}")
+        self.kernel = kernel
         self._cpu = None
+
+    def _kernel_fn(self):
+        if self.kernel == "pallas":
+            import jax
+
+            from .sha256_pallas import sha256_batch_kernel_pallas
+
+            interpret = jax.default_backend() != "tpu"
+            return functools.partial(
+                sha256_batch_kernel_pallas, interpret=interpret
+            )
+        return sha256_batch_kernel
+
+    def dispatch(
+        self,
+        messages: Sequence[bytes],
+        block_bucket: Optional[int] = None,
+        batch_bucket: Optional[int] = None,
+    ) -> HashDispatch:
+        """Asynchronously digest same-bucket packed messages: pads shapes,
+        enqueues ONE kernel call, returns without blocking.  All messages
+        must fit one block bucket (the caller groups by bucket).  Callers may
+        pin ``block_bucket``/``batch_bucket`` to quantized values so repeated
+        dispatches reuse one compiled kernel shape."""
+        padded = [pad_message(m) for m in messages]
+        bucket = _next_pow2(max(p.shape[0] for p in padded))
+        if block_bucket is not None:
+            bucket = max(bucket, block_bucket)
+        batch_size = _next_pow2(len(messages))
+        if batch_bucket is not None:
+            batch_size = max(batch_size, batch_bucket)
+        blocks = np.zeros((batch_size, bucket, 16), dtype=np.uint32)
+        n_blocks = np.zeros(batch_size, dtype=np.uint32)
+        for row, p in enumerate(padded):
+            blocks[row, : p.shape[0]] = p
+            n_blocks[row] = p.shape[0]
+        words = self._kernel_fn()(blocks, n_blocks)
+        return HashDispatch(words, len(messages))
+
+    def collect(self, handle: HashDispatch) -> List[bytes]:
+        """Block until a dispatch's digests are host-resident; return them
+        in input order."""
+        words = np.asarray(handle.words)
+        return digests_from_words(words[: handle.count])
 
     def _hash_cpu(self, batches: Sequence[Sequence[bytes]]) -> List[bytes]:
         if self._cpu is None:
@@ -220,7 +290,7 @@ class TpuHasher:
                 nb = padded[i].shape[0]
                 blocks[row, :nb] = padded[i]
                 n_blocks[row] = nb
-            words = np.asarray(sha256_batch_kernel(blocks, n_blocks))
+            words = np.asarray(self._kernel_fn()(blocks, n_blocks))
             digests = digests_from_words(words[: len(indices)])
             for i, d in zip(indices, digests):
                 out[i] = d

@@ -54,9 +54,7 @@ def _sha256_tile_kernel(blocks_ref, n_blocks_ref, out_ref, *, n_block_bucket):
     n_blocks = n_blocks_ref[:, 0]  # (TILE,) uint32
 
     def block_step(b, state):
-        slab = pl.load(
-            blocks_ref, (slice(None), pl.ds(b, 1), slice(None))
-        )  # (TILE, 1, 16)
+        slab = blocks_ref[:, pl.ds(b, 1), :]  # (TILE, 1, 16)
         w2 = [slab[:, 0, t] for t in range(16)]
         a, b_, c, d, e, f, g, h = state
         for t in range(64):

@@ -7,9 +7,11 @@ without a cluster, bit-for-bit reproducible.  The mangler DSL injects
 network faults (drop/delay/jitter/duplicate/crash-restart) at the queue.
 """
 
+from .crypto import DeviceAuthPlane, DeviceHashPlane
 from .queue import EventQueue, SimEvent
 from .recorder import (
     ClientConfig,
+    CryptoConfig,
     NodeConfig,
     Recorder,
     Recording,
@@ -30,6 +32,9 @@ __all__ = [
     "After",
     "ClientConfig",
     "Conditional",
+    "CryptoConfig",
+    "DeviceAuthPlane",
+    "DeviceHashPlane",
     "EventMangling",
     "EventQueue",
     "For",

@@ -1,0 +1,408 @@
+"""Shared cross-node crypto planes: device-batched, content-memoized, async.
+
+The BASELINE.json north star is "swap the Hash/verify processor backend for a
+TPU one" at the reference's ``Hasher`` boundary
+(``/root/reference/pkg/processor/serial.go:180-198``) and its anticipated
+hash-parallelism hook (``/root/reference/mirbft.go:470`` "TODO, spawn more of
+these").  In the simulated cluster every replica digests the same content, so
+the natural unit of device work is the *cluster-wide wave* of crypto actions,
+not one node's action batch (round-1 mean: 16 messages/batch, far below any
+useful device shape; the union across 64 replicas is hundreds).
+
+Two planes, both shared by all ``SimNode``s of a ``Recording``:
+
+``DeviceHashPlane`` (implements the processor ``Hasher`` protocol)
+  * ``enqueue(messages)`` is called by the scheduler the moment a
+    hash-processing event is *scheduled* (the simulated latency model delays
+    its firing); pending unique messages accumulate into a wave.
+  * When a wave reaches ``wave_size`` messages, the plane launches ONE
+    asynchronous device dispatch per block-bucket (``TpuHasher.dispatch``) —
+    non-blocking, so the Python event loop keeps processing the simulation
+    while the device works and the results ride back over the link.
+  * ``hash_batches`` (fired when the node's hash event is consumed) serves
+    digests from the memo; a miss first materializes in-flight dispatches,
+    then falls back to host hashing for stragglers below ``device_floor``.
+  * Digests are pure functions of content, so memoized cross-node serving is
+    bit-identical to per-node hashing, and the simulation's event schedule is
+    completely unchanged — determinism pins hold with the device on or off.
+
+``DeviceAuthPlane`` (signed-request mode, BASELINE configs 2-5)
+  * ``note(client_id, req_no)`` is called when a signed client proposal is
+    scheduled; the plane looks ahead through the client's next
+    ``lookahead`` request envelopes (the simulation analogue of batching the
+    replica's network-ingress queue) and accumulates unverified ones.
+  * Waves launch asynchronously through ``Ed25519BatchVerifier.dispatch``;
+    ``authenticate`` (the fire-time check) serves memoized verdicts,
+    materializing in-flight dispatches on a miss and verifying stragglers on
+    host.  Invalid signatures are memoized as False — byzantine signers stay
+    rejected on the device path.
+
+Host-vs-device accounting: every second spent blocking on device results is
+recorded as ``device_wait_seconds``; host-side crypto (hashlib fallback,
+straggler verification) as ``host_crypto_seconds`` — the "<5% host CPU in
+crypto" half of the BASELINE target is computed from these by the bench.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .. import metrics
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+class DeviceHashPlane:
+    """Cross-node SHA-256 service: content-memoized, wave-batched, async.
+
+    With ``device=False`` this degenerates to the shared memoized hashlib
+    hasher (identical digests, zero device use) — the default for unit tests
+    so they stay fast; the bench and the device-parity tests enable it.
+    """
+
+    _CAP = 1 << 17  # memo entries; each pins its key objects
+
+    def __init__(
+        self,
+        device: bool = False,
+        wave_size: int = 192,
+        device_floor: int = 64,
+        max_block_bucket: int = 1 << 12,
+        kernel: str = "scan",
+    ):
+        self.device = device
+        self.wave_size = wave_size
+        self.device_floor = device_floor
+        self.max_block_bucket = max_block_bucket
+        self._memo: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # key -> (refs tuple, joined message) awaiting dispatch
+        self._pending: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._inflight: List[tuple] = []  # (keys, refs, handle)
+        # keys dispatched but not yet materialized (prevents re-enqueue)
+        self._issued: Dict[tuple, tuple] = {}
+        self._hasher = None
+        if device:
+            from ..ops.sha256 import TpuHasher
+
+            self._hasher = TpuHasher(
+                min_device_batch=1,
+                max_block_bucket=max_block_bucket,
+                kernel=kernel,
+            )
+
+    # -- scheduler-side -----------------------------------------------------
+
+    def enqueue(self, batches: Sequence[Sequence[bytes]]) -> None:
+        """Accumulate a scheduled hash batch into the current wave; launch
+        async device dispatches when the wave is full.  No-op without a
+        device: the fire-time path hashes on host exactly as before."""
+        if not self.device:
+            return
+        memo = self._memo
+        pending = self._pending
+        start = time.perf_counter()
+        for parts in batches:
+            if len(parts) == 1 and len(parts[0]) < 512:
+                continue  # tiny single-part inputs stay on the hashlib path
+            key = tuple(map(id, parts))
+            if key in memo or key in pending or key in self._issued:
+                continue
+            pending[key] = (tuple(parts), b"".join(parts))
+        if len(pending) >= self.wave_size:
+            self._launch_wave()
+        # Joining/packing is host-side crypto-pipeline work: count it.
+        metrics.counter("host_crypto_seconds").inc(time.perf_counter() - start)
+
+    def _launch_wave(self) -> None:
+        """One async kernel dispatch per block-bucket over the pending set.
+        Block buckets are quantized (min 4, powers of two) and the batch
+        dimension is pinned to the wave's power-of-two, bounding the set of
+        compiled kernel shapes."""
+        pending, self._pending = self._pending, OrderedDict()
+        groups: Dict[int, List[tuple]] = {}
+        for key, (refs, message) in pending.items():
+            n_blocks = (len(message) + 8) // 64 + 1
+            bucket = max(4, _next_pow2(n_blocks))
+            if bucket > self.max_block_bucket:
+                # Degenerate huge message: host-hash immediately.
+                self._memo_put(key, refs, self._host_hash(message))
+                continue
+            groups.setdefault(bucket, []).append((key, refs, message))
+        for bucket in sorted(groups):
+            entries = groups[bucket]
+            handle = self._hasher.dispatch(
+                [m for (_, _, m) in entries],
+                block_bucket=bucket,
+                batch_bucket=_next_pow2(self.wave_size),
+            )
+            self._inflight.append(
+                ([k for (k, _, _) in entries], [r for (_, r, _) in entries], handle)
+            )
+            for key, refs, _ in entries:
+                self._issued[key] = refs
+            metrics.counter("device_hash_dispatches").inc()
+            metrics.counter("device_hashed_messages").inc(len(entries))
+
+    # -- fire-time (Hasher protocol) ----------------------------------------
+
+    def hash_batches(self, batches: Sequence[Sequence[bytes]]) -> List[bytes]:
+        out: List[Optional[bytes]] = [None] * len(batches)
+        memo = self._memo
+        misses: List[int] = []
+        for i, parts in enumerate(batches):
+            if len(parts) == 1 and len(parts[0]) < 512:
+                out[i] = hashlib.sha256(parts[0]).digest()
+                continue
+            entry = memo.get(tuple(map(id, parts)))
+            if entry is not None:
+                refs, digest = entry
+                if len(refs) == len(parts) and all(
+                    a is b for a, b in zip(refs, parts)
+                ):
+                    out[i] = digest
+                    continue
+            misses.append(i)
+        if misses and self._inflight:
+            self._materialize_inflight()
+            for i in list(misses):
+                entry = memo.get(tuple(map(id, batches[i])))
+                if entry is not None:
+                    out[i] = entry[1]
+                    misses.remove(i)
+        if misses:
+            if self.device and len(misses) >= self.device_floor:
+                # A straggler set big enough for the device: dispatch and
+                # collect synchronously (one round-trip for the whole set).
+                for i in misses:
+                    self.enqueue([batches[i]])
+                self._launch_wave()
+                self._materialize_inflight()
+            start = time.perf_counter()
+            for i in misses:
+                parts = batches[i]
+                key = tuple(map(id, parts))
+                entry = memo.get(key)
+                if entry is not None and out[i] is None:
+                    out[i] = entry[1]
+                    continue
+                self._pending.pop(key, None)  # served on host: drop stale entry
+                h = hashlib.sha256()
+                for part in parts:
+                    h.update(part)
+                digest = h.digest()
+                self._memo_put(key, tuple(parts), digest)
+                out[i] = digest
+            metrics.counter("host_crypto_seconds").inc(
+                time.perf_counter() - start
+            )
+        return out  # type: ignore[return-value]
+
+    def _materialize_inflight(self) -> None:
+        start = time.perf_counter()
+        inflight, self._inflight = self._inflight, []
+        for keys, refs, handle in inflight:
+            digests = self._hasher.collect(handle)
+            for key, ref, digest in zip(keys, refs, digests):
+                self._memo_put(key, ref, digest)
+                self._issued.pop(key, None)
+        metrics.counter("device_wait_seconds").inc(time.perf_counter() - start)
+
+    def _host_hash(self, message: bytes) -> bytes:
+        start = time.perf_counter()
+        digest = hashlib.sha256(message).digest()
+        metrics.counter("host_crypto_seconds").inc(time.perf_counter() - start)
+        return digest
+
+    def _memo_put(self, key: tuple, refs: tuple, digest: bytes) -> None:
+        memo = self._memo
+        memo[key] = (refs, digest)
+        if len(memo) > self._CAP:
+            memo.popitem(last=False)
+
+
+class DeviceAuthPlane:
+    """Cross-node Ed25519 request authentication: verdict-memoized,
+    lookahead-batched, async (see module docstring).
+
+    One instance per Recording; nodes share it the way they share the hash
+    plane — a verdict is a pure function of (client key, req_no, envelope).
+    """
+
+    def __init__(
+        self,
+        chunk_provider: Callable[[int, int], List[Tuple[int, bytes]]],
+        device: bool = True,
+        wave_size: int = 128,
+        device_floor: int = 16,
+        lookahead: int = 128,
+    ):
+        from ..ops.ed25519 import Ed25519BatchVerifier
+
+        self.chunk_provider = chunk_provider
+        self.device = device
+        self.wave_size = wave_size
+        self.device_floor = device_floor
+        self.lookahead = lookahead
+        self.verifier = Ed25519BatchVerifier(min_device_batch=device_floor)
+        self.keys: Dict[int, bytes] = {}
+        # (client_id, req_no, id(envelope)) -> (envelope ref, verdict);
+        # bounded like the hash memo (entries pin their envelope objects)
+        self._memo: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._memo_cap = 1 << 17
+        self._pending: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._inflight: List[tuple] = []  # (keys, items, handle)
+        # keys dispatched but not yet materialized (prevents re-enqueue);
+        # values pin the envelope objects so ids stay unique
+        self._issued: Dict[tuple, bytes] = {}
+        self.verified_count = 0
+
+    def register(self, client_id: int, public_key: bytes) -> None:
+        if len(public_key) != 32:
+            raise ValueError("ed25519 public keys are 32 bytes")
+        self.keys[client_id] = public_key
+
+    def remove(self, client_id: int) -> None:
+        """Deregister a client (reconfiguration): drop its key AND every
+        cached/pending/in-flight-issued verdict — a removed client's
+        envelopes must stop authenticating immediately."""
+        self.keys.pop(client_id, None)
+        for store in (self._memo, self._pending, self._issued):
+            for key in [k for k in store if k[0] == client_id]:
+                del store[key]
+
+    # -- scheduler-side -----------------------------------------------------
+
+    def note(self, client_id: int, req_no: int) -> None:
+        """A signed proposal was scheduled: enqueue this client's next
+        ``lookahead`` unverified envelopes (the ingress-queue batch) and
+        launch an async wave if full."""
+        memo = self._memo
+        pending = self._pending
+        added = False
+        for rn, envelope in self.chunk_provider(client_id, req_no)[: self.lookahead]:
+            key = (client_id, rn, id(envelope))
+            if key in memo or key in pending or key in self._issued:
+                continue
+            pending[key] = (client_id, rn, envelope)
+            added = True
+        if added and len(pending) >= self.wave_size:
+            self._launch_wave()
+
+    def _launch_wave(self) -> None:
+        """Dispatch the pending set in ``wave_size`` chunks; the dispatcher
+        pads each chunk to the same power-of-two batch shape, so the kernel
+        compiles once."""
+        pending, self._pending = self._pending, OrderedDict()
+        if not pending:
+            return
+        all_keys = list(pending.keys())
+        for start in range(0, len(all_keys), self.wave_size):
+            keys = all_keys[start : start + self.wave_size]
+            items = [pending[k] for k in keys]
+            pack_start = time.perf_counter()
+            packed = self._pack(items)
+            if self.device and len(items) >= self.device_floor:
+                if len(items) < self.wave_size:
+                    # Pad to the wave shape with throwaway rows so every
+                    # dispatch compiles to the same kernel shape.
+                    pad = self.wave_size - len(items)
+                    packed = (
+                        list(packed[0]) + [b"\x00" * 32] * pad,
+                        list(packed[1]) + [b""] * pad,
+                        list(packed[2]) + [b"\x00" * 64] * pad,
+                    )
+                handle = self.verifier.dispatch(*packed)
+                # Packing (per-signature SHA-512 challenge, key decompression,
+                # limb conversion) is host crypto work; the device runs async
+                # after the enqueue, so everything up to here is host-side.
+                metrics.counter("host_crypto_seconds").inc(
+                    time.perf_counter() - pack_start
+                )
+                self._inflight.append((keys, items, handle))
+                for key, item in zip(keys, items):
+                    self._issued[key] = item[2]
+                metrics.counter("device_verify_dispatches").inc()
+                metrics.counter("device_verified_signatures").inc(len(items))
+            else:
+                self._verify_host(keys, items, packed)
+
+    def _pack(self, items) -> Tuple[List[bytes], List[bytes], List[bytes]]:
+        from ..processor.verify import signing_payload, unseal
+
+        pubs: List[bytes] = []
+        msgs: List[bytes] = []
+        sigs: List[bytes] = []
+        for client_id, req_no, envelope in items:
+            pub = self.keys.get(client_id)
+            parts = unseal(envelope)
+            if pub is None or parts is None:
+                # Structurally invalid: keep the row (all-zero signature
+                # fails verification) so indices stay aligned.
+                pubs.append(b"\x00" * 32)
+                msgs.append(b"")
+                sigs.append(b"\x00" * 64)
+                continue
+            payload, signature = parts
+            pubs.append(pub)
+            msgs.append(signing_payload(client_id, req_no, payload))
+            sigs.append(signature)
+        return pubs, msgs, sigs
+
+    def _verify_host(self, keys, items, packed) -> None:
+        from ..ops.ed25519 import verify_one
+
+        pubs, msgs, sigs = packed
+        start = time.perf_counter()
+        for key, item, pub, msg, sig in zip(keys, items, pubs, msgs, sigs):
+            self._memo_put(key, item[2], bool(verify_one(pub, msg, sig)))
+        metrics.counter("host_crypto_seconds").inc(time.perf_counter() - start)
+        self.verified_count += len(keys)
+
+    def _memo_put(self, key: tuple, envelope: bytes, verdict: bool) -> None:
+        memo = self._memo
+        memo[key] = (envelope, verdict)
+        if len(memo) > self._memo_cap:
+            memo.popitem(last=False)
+
+    # -- fire-time ----------------------------------------------------------
+
+    def authenticate(self, client_id: int, req_no: int, envelope: bytes) -> bool:
+        key = (client_id, req_no, id(envelope))
+        entry = self._memo.get(key)
+        if entry is not None and entry[0] is envelope:
+            return entry[1]
+        # Miss: pull this client's ingress chunk in, flush the wave, and
+        # materialize everything in flight.
+        self.note(client_id, req_no)
+        if self._pending:
+            self._launch_wave()
+        self._materialize_inflight()
+        entry = self._memo.get(key)
+        if entry is not None and entry[0] is envelope:
+            return entry[1]
+        # Envelope object unknown to the provider (e.g. mangled/foreign
+        # bytes): verify directly on host.
+        keys = [key]
+        items = [(client_id, req_no, envelope)]
+        self._verify_host(keys, items, self._pack(items))
+        return self._memo[key][1]
+
+    def _materialize_inflight(self) -> None:
+        if not self._inflight:
+            return
+        start = time.perf_counter()
+        inflight, self._inflight = self._inflight, []
+        for keys, items, handle in inflight:
+            verdicts = self.verifier.collect(handle)
+            for key, item, verdict in zip(keys, items, verdicts):
+                self._issued.pop(key, None)
+                if key[0] not in self.keys:
+                    continue  # client removed while the dispatch was in flight
+                self._memo_put(key, item[2], bool(verdict))
+            self.verified_count += len(keys)
+        metrics.counter("device_wait_seconds").inc(time.perf_counter() - start)

@@ -12,7 +12,6 @@ client's requests commit on every node.
 from __future__ import annotations
 
 import hashlib
-from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -29,10 +28,10 @@ from ..messages import (
     Reconfiguration,
     RequestAck,
 )
-from ..ops import CpuHasher
 from ..state import Event, EventInitialParameters
 from ..statemachine.actions import Actions, Events
 from ..statemachine.machine import StateMachine
+from .crypto import DeviceAuthPlane, DeviceHashPlane
 from .queue import EventQueue
 
 
@@ -80,54 +79,10 @@ class SimReqStore:
         pass
 
 
-class _MemoHasher:
-    """CpuHasher with an identity-keyed memo.
-
-    In a simulated cluster every node hashes the same byte objects (request
-    bodies, batch digest lists, epoch-change payloads are shared references),
-    so digests are computed once per distinct object tuple instead of once
-    per node.  Purely an executor-side optimization: inputs are immutable
-    bytes, outputs are bit-identical to CpuHasher, and the simulated hash
-    latency model is unaffected.  The cache pins its key objects, so id()
-    reuse cannot alias a live entry."""
-
-    __slots__ = ("_cache",)
-    _CAP = 65536
-
-    def __init__(self):
-        self._cache: "OrderedDict[tuple, tuple]" = OrderedDict()
-
-    def hash_batches(self, batches):
-        out = []
-        cache = self._cache
-        for parts in batches:
-            if len(parts) == 1 and len(parts[0]) < 512:
-                # Tiny single-part input (request-body hashing on the propose
-                # path): hashlib's C loop is faster than the memo machinery.
-                out.append(hashlib.sha256(parts[0]).digest())
-                continue
-            key = tuple(map(id, parts))
-            entry = cache.get(key)
-            if entry is not None:
-                refs, digest = entry
-                if len(refs) == len(parts) and all(
-                    a is b for a, b in zip(refs, parts)
-                ):
-                    out.append(digest)
-                    continue
-            h = hashlib.sha256()
-            for part in parts:
-                h.update(part)
-            digest = h.digest()
-            cache[key] = (tuple(parts), digest)
-            if len(cache) > self._CAP:
-                cache.popitem(last=False)
-            out.append(digest)
-        return out
-
-
-# One cache for the whole process: the cross-NODE sharing is the point.
-_SHARED_MEMO_HASHER = _MemoHasher()
+# One plane for the whole process in CPU mode (cross-NODE and cross-run
+# digest sharing: digests are pure functions of content).  Device-enabled
+# recordings build their own plane (see CryptoConfig).
+_SHARED_CPU_PLANE = DeviceHashPlane(device=False)
 
 # Requests a client pipelines to a node within one simulation event.
 _PROPOSAL_CHUNK = 32
@@ -314,6 +269,24 @@ class ReconfigPoint:
     reconfiguration: Reconfiguration
 
 
+@dataclass
+class CryptoConfig:
+    """Crypto-plane knobs (see ``testengine/crypto.py``).
+
+    ``device=True`` routes wave-aggregated SHA-256 hashing and Ed25519
+    verification through asynchronous TPU dispatches; ``False`` (default)
+    keeps the memoized host paths.  Digests/verdicts are bit-identical
+    either way and the simulation's event schedule is unaffected."""
+
+    device: bool = False
+    hash_wave: int = 192
+    hash_floor: int = 64
+    auth_wave: int = 128
+    auth_floor: int = 16
+    lookahead: int = 128
+    kernel: str = "scan"  # sha256 backend: "scan" | "pallas"
+
+
 class SimClient:
     """Deterministic request generator (reference recorder.go:246-263).
     In signed mode each request is sealed with a deterministic per-client
@@ -377,6 +350,7 @@ class SimNode:
         state: NodeState,
         interceptor=None,
         authenticator=None,
+        hasher=None,
     ):
         self.id = node_id
         self.config = config
@@ -386,7 +360,7 @@ class SimNode:
         self.state = state
         self.interceptor = interceptor
         self.authenticator = authenticator
-        self.hasher = _SHARED_MEMO_HASHER
+        self.hasher = hasher if hasher is not None else _SHARED_CPU_PLANE
         self.work_items: Optional[proc.WorkItems] = None
         self.clients: Optional[proc.Clients] = None
         self.state_machine: Optional[StateMachine] = None
@@ -414,6 +388,7 @@ class Recorder:
         mangler=None,
         random_seed: int = 0,
         event_log_writer=None,
+        crypto: Optional[CryptoConfig] = None,
     ):
         self.network_state = network_state
         self.node_configs = node_configs
@@ -422,6 +397,7 @@ class Recorder:
         self.mangler = mangler
         self.random_seed = random_seed
         self.event_log_writer = event_log_writer
+        self.crypto = crypto or CryptoConfig()
 
     def recording(self) -> "Recording":
         event_queue = EventQueue(seed=self.random_seed, mangler=self.mangler)
@@ -432,6 +408,44 @@ class Recorder:
             for cc in self.client_configs
             if cc.signed
         }
+
+        crypto = self.crypto
+        if crypto.device:
+            hash_plane = DeviceHashPlane(
+                device=True,
+                wave_size=crypto.hash_wave,
+                device_floor=crypto.hash_floor,
+                kernel=crypto.kernel,
+            )
+        else:
+            hash_plane = _SHARED_CPU_PLANE
+
+        auth_plane = None
+        if signed_pubs:
+
+            def chunk_provider(client_id: int, start_req: int, _clients=clients):
+                client = _clients.get(client_id)
+                if client is None:
+                    return []
+                out = []
+                req_no = start_req
+                while len(out) < crypto.lookahead:
+                    data = client.request_by_req_no(req_no)
+                    if data is None:
+                        break
+                    out.append((req_no, data))
+                    req_no += 1
+                return out
+
+            auth_plane = DeviceAuthPlane(
+                chunk_provider,
+                device=crypto.device,
+                wave_size=crypto.auth_wave,
+                device_floor=crypto.auth_floor,
+                lookahead=crypto.lookahead,
+            )
+            for client_id, pub in signed_pubs.items():
+                auth_plane.register(client_id, pub)
 
         nodes = []
         for i, node_config in enumerate(self.node_configs):
@@ -450,14 +464,6 @@ class Recorder:
                 writer = self.event_log_writer
                 interceptor = _Interceptor(i, event_queue, writer)
 
-            authenticator = None
-            if signed_pubs:
-                from ..processor.verify import RequestAuthenticator
-
-                authenticator = RequestAuthenticator()
-                for client_id, pub in signed_pubs.items():
-                    authenticator.register(client_id, pub)
-
             nodes.append(
                 SimNode(
                     i,
@@ -467,14 +473,17 @@ class Recorder:
                     req_store,
                     node_state,
                     interceptor,
-                    authenticator,
+                    auth_plane,
+                    hash_plane,
                 )
             )
             event_queue.insert_initialize(
                 i, node_config.init_parms, node_config.start_delay
             )
 
-        return Recording(event_queue, nodes, clients)
+        return Recording(
+            event_queue, nodes, clients, hash_plane=hash_plane, auth_plane=auth_plane
+        )
 
 
 class _Interceptor:
@@ -499,10 +508,30 @@ class _Interceptor:
 class Recording:
     """Reference recorder.go:472-723."""
 
-    def __init__(self, event_queue: EventQueue, nodes: List[SimNode], clients: Dict[int, SimClient]):
+    def __init__(
+        self,
+        event_queue: EventQueue,
+        nodes: List[SimNode],
+        clients: Dict[int, SimClient],
+        hash_plane: Optional[DeviceHashPlane] = None,
+        auth_plane: Optional[DeviceAuthPlane] = None,
+    ):
         self.event_queue = event_queue
         self.nodes = nodes
         self.clients = clients  # by client id (ids need not be dense)
+        self.hash_plane = hash_plane
+        self.auth_plane = auth_plane
+
+    def _schedule_proposal(
+        self, node_id: int, client_id: int, req_no: int, data: bytes, delay: int
+    ) -> None:
+        """Schedule a client proposal, telling the auth plane so signed
+        envelopes start verifying (asynchronously) before the event fires."""
+        self.event_queue.insert_client_proposal(
+            node_id, client_id, req_no, data, delay
+        )
+        if self.auth_plane is not None and self.clients[client_id].config.signed:
+            self.auth_plane.note(client_id, req_no)
 
     def step(self) -> None:
         """Consume one simulation event, replicating the scheduling rules of
@@ -537,7 +566,7 @@ class Recording:
                 )
                 data = client.request_by_req_no(start_req)
                 if data is not None:
-                    queue.insert_client_proposal(
+                    self._schedule_proposal(
                         node.id,
                         client.config.id,
                         start_req,
@@ -568,7 +597,7 @@ class Recording:
                     next_req_no = client.next_req_no_value()
                 except proc.clients.ClientNotExistError:
                     # Client window not allocated yet; retry later.
-                    queue.insert_client_proposal(
+                    self._schedule_proposal(
                         node.id,
                         client_id,
                         req_no,
@@ -579,7 +608,7 @@ class Recording:
                 if next_req_no != req_no:
                     next_data = sim_client.request_by_req_no(next_req_no)
                     if next_data is not None:
-                        queue.insert_client_proposal(
+                        self._schedule_proposal(
                             node.id,
                             client_id,
                             next_req_no,
@@ -602,7 +631,7 @@ class Recording:
                 if data is None:
                     break  # no more requests from this client
             else:
-                queue.insert_client_proposal(
+                self._schedule_proposal(
                     node.id,
                     client_id,
                     req_no,
@@ -675,6 +704,10 @@ class Recording:
                 node.pending[key] = True
                 queue.insert_process(node.id, event_field, batch, latency)
                 setattr(work, attr, empty())
+                if key == "hash" and self.hash_plane is not None:
+                    # Start the device working on this batch (async) while
+                    # the simulated hash latency elapses.
+                    self.hash_plane.enqueue([a.data for a in batch])
 
     def drain_clients(self, timeout: int) -> int:
         """Run until every client's requests commit on every node
@@ -751,6 +784,7 @@ class Spec:
     client_width: int = 100  # per-client watermark window (reference default)
     clients_ignore: Tuple[int, ...] = ()
     signed_requests: bool = False
+    crypto: Optional[CryptoConfig] = None  # None -> host paths (CryptoConfig())
     tweak_recorder: Optional[Callable[[Recorder], None]] = None
 
     def recorder(self) -> Recorder:
@@ -789,6 +823,7 @@ class Spec:
             network_state=network_state,
             node_configs=node_configs,
             client_configs=client_configs,
+            crypto=self.crypto,
         )
         if self.tweak_recorder is not None:
             self.tweak_recorder(recorder)
