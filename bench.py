@@ -1,17 +1,30 @@
 #!/usr/bin/env python
 """Benchmark harness for the BASELINE.json configuration family.
 
-Runs the N-replica in-process testengine configs (SHA-256 hashing, batched
-ordering, optional Ed25519-signed clients) and the TPU crypto kernels, and
-prints ONE JSON line:
+Runs the five BASELINE configs on the in-process testengine with the device
+crypto planes enabled (SHA-256 hashing and Ed25519 verification ride
+asynchronous TPU dispatches; see ``mirbft_tpu/testengine/crypto.py``), plus
+pipelined TPU kernel micro-benchmarks, and prints ONE JSON line:
 
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N/100000, "detail": {...}}
 
-The headline is the 64-replica testengine run (BASELINE.json north star):
-cluster-wide committed-request operations per wall-clock second (each replica
-executing a request's commit counts once — the work the cluster actually
-performs; the per-request ordering rate is reported alongside as
-``unique_req_per_s``).  vs_baseline is against the driver-set target of 100k.
+Headline metric (honest accounting, per round-1 verdict): **unique committed
+requests per wall-clock second** on the 64-replica config — each client
+request counts once no matter how many replicas execute it.  The cluster-wide
+commit-operation rate (unique x replicas actually applying) is reported in
+detail as ``*_commit_ops_per_s`` for comparison with round 1.
+
+Device accounting: ``*_host_crypto_s`` is host CPU spent in the crypto
+pipeline (hashlib fallback, packing, challenge hashing), ``*_device_wait_s``
+is wall time blocked on device results; ``*_host_crypto_share`` is host
+crypto over wall — the "<5% host CPU in hash/verify" half of the BASELINE
+target.
+
+Kernel micro-benchmarks are measured two ways because this environment
+reaches the TPU through a tunnel with ~100 ms round-trip latency: *pipelined*
+(N async dispatches, block once — true device throughput; the planes run this
+way) and *sync* (block per dispatch — what a latency-bound caller would see,
+dominated by tunnel RTT, reported as ``tunnel_rtt_ms`` context).
 """
 
 import json
@@ -22,9 +35,51 @@ import time
 BASELINE_REQ_PER_S = 100_000
 
 
-def run_engine(node_count, client_count, reqs_per_client, batch_size,
-               signed=False):
-    """One testengine run; returns (wall_s, sim_steps, commit_ops, uniq)."""
+def _device_crypto():
+    """Crypto plane config for the bench configs: small hash waves (unique
+    multi-part hash content per run is modest — Mir is digest-only by
+    design), full auth waves."""
+    from mirbft_tpu.testengine import CryptoConfig
+
+    return CryptoConfig(
+        device=True, hash_wave=64, hash_floor=8, auth_wave=128, auth_floor=16
+    )
+
+
+def warm_kernels():
+    """Compile every kernel shape the engine configs will hit, so engine
+    walls measure steady state, not XLA compilation."""
+    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier
+    from mirbft_tpu.ops.sha256 import TpuHasher
+
+    hasher = TpuHasher(min_device_batch=1)
+    for block_bucket in (4, 16, 64):
+        h = hasher.dispatch(
+            [b"warmup-%d" % i for i in range(16)],
+            block_bucket=block_bucket,
+            batch_bucket=64,
+        )
+        hasher.collect(h)
+
+    verifier = Ed25519BatchVerifier(min_device_batch=1)
+    pubs = [b"\x00" * 32] * 128
+    msgs = [b""] * 128
+    sigs = [b"\x00" * 64] * 128
+    verifier.collect(verifier.dispatch(pubs, msgs, sigs))
+
+
+def run_engine(
+    node_count,
+    client_count,
+    reqs_per_client,
+    batch_size,
+    signed=False,
+    device=True,
+    corrupt_clients=(),
+    tweak=None,
+    timeout=100_000_000,
+):
+    """One testengine run; returns a result dict."""
     from mirbft_tpu import metrics
     from mirbft_tpu.testengine import Spec
 
@@ -35,10 +90,16 @@ def run_engine(node_count, client_count, reqs_per_client, batch_size,
         reqs_per_client=reqs_per_client,
         batch_size=batch_size,
         signed_requests=signed,
+        crypto=_device_crypto() if device else None,
     )
-    recording = spec.recorder().recording()
+    recorder = spec.recorder()
+    for cid in corrupt_clients:
+        recorder.client_configs[cid].corrupt = True
+    if tweak is not None:
+        tweak(recorder)
+    recording = recorder.recording()
     start = time.perf_counter()
-    steps = recording.drain_clients(timeout=1_000_000_000_000)
+    steps = recording.drain_clients(timeout=timeout)
     elapsed = time.perf_counter() - start
     # safety: all nodes at the same checkpoint agree
     by_seq = {}
@@ -48,127 +109,326 @@ def run_engine(node_count, client_count, reqs_per_client, batch_size,
         )
     assert all(len(h) == 1 for h in by_seq.values()), "divergent state"
     snap = metrics.snapshot()
-    return elapsed, steps, int(snap["committed_requests"]), snap
+    unique = (client_count - len(corrupt_clients)) * reqs_per_client
+    return {
+        "wall_s": elapsed,
+        "steps": steps,
+        "unique": unique,
+        "unique_per_s": unique / elapsed,
+        "commit_ops": int(snap.get("committed_requests", 0)),
+        "commit_ops_per_s": snap.get("committed_requests", 0) / elapsed,
+        "host_crypto_s": float(snap.get("host_crypto_seconds", 0.0)),
+        "device_wait_s": float(snap.get("device_wait_seconds", 0.0)),
+        "host_crypto_share": float(snap.get("host_crypto_seconds", 0.0))
+        / elapsed,
+        "hash_dispatches": int(snap.get("device_hash_dispatches", 0)),
+        "hash_msgs": int(snap.get("device_hashed_messages", 0)),
+        "verify_dispatches": int(snap.get("device_verify_dispatches", 0)),
+        "verify_sigs": int(snap.get("device_verified_signatures", 0)),
+        "recording": recording,
+    }
 
 
-def bench_tpu_hash_dispatch(batch=4096, msg_len=640):
-    """Wall time of one batched SHA-256 dispatch on the device (the unit of
-    work the processor offloads per iteration)."""
+def put(detail, prefix, res, engaged_keys=True):
+    res.pop("recording", None)  # release the cluster's memory
+    detail[f"{prefix}_unique_req_per_s"] = round(res["unique_per_s"], 1)
+    detail[f"{prefix}_commit_ops_per_s"] = round(res["commit_ops_per_s"], 1)
+    detail[f"{prefix}_wall_s"] = round(res["wall_s"], 2)
+    detail[f"{prefix}_sim_steps"] = res["steps"]
+    detail[f"{prefix}_host_crypto_share"] = round(res["host_crypto_share"], 4)
+    if engaged_keys:
+        detail[f"{prefix}_device_hash_dispatches"] = res["hash_dispatches"]
+        detail[f"{prefix}_device_verify_dispatches"] = res["verify_dispatches"]
+        detail[f"{prefix}_device_verified_sigs"] = res["verify_sigs"]
+
+
+def config4_wan_epoch_change(detail):
+    """BASELINE config 4: 128-node WAN-latency sim; a silenced leader forces
+    an epoch change, whose quorum-cert (epoch-change ack) hashing rides the
+    crypto plane (device waves up to the block ladder, memoized host above
+    it — the certs at this scale exceed the device ladder by design)."""
+    from mirbft_tpu.testengine import For, matching
+
+    def tweak(recorder):
+        for nc in recorder.node_configs:
+            nc.runtime_parms.link_latency = 1000  # WAN RTT ~ 20 ticks
+        recorder.mangler = For(matching.msgs().from_node(0)).drop()
+
+    res = run_engine(
+        128, 8, 5, 20, signed=True, device=True, tweak=tweak, timeout=30_000_000
+    )
+    recording = res.pop("recording")
+    epochs = {
+        n.state_machine.epoch_tracker.current_epoch.number
+        for n in recording.nodes[1:]
+    }
+    put(detail, "c4_128n_wan_viewchange", res)
+    detail["c4_epoch_changed"] = bool(max(epochs) > 0)
+    return res
+
+
+def config5_reconfig_byzantine(detail):
+    """BASELINE config 5: 256-node run with byzantine signers (rejected on
+    the device verify path), a mid-run reconfiguration adding a client, and
+    a late-started replica that must state-transfer to catch up.
+
+    The network config is tuned for 256 replicas (8 buckets, short
+    checkpoint interval, no planned epoch rotation): the canonical
+    buckets=n rule would put ~2,500 null-batch sequences in flight per
+    heartbeat wave at O(N^2) messages each.  The run is condition-bounded:
+    it stops once every BASELINE property is observed (honest + added
+    clients committed, late replica state-transferred), rather than waiting
+    for the final checkpoint to become visible on all 256 replicas."""
+    import dataclasses
+    import time as _time
+
+    from mirbft_tpu import metrics
+    from mirbft_tpu.messages import ReconfigNewClient
+    from mirbft_tpu.testengine import ClientConfig, ReconfigPoint, Spec
+
+    n_clients = 8
+    corrupt = (6, 7)  # byzantine signers
+
+    metrics.default_registry.reset()
+    spec = Spec(
+        node_count=256,
+        client_count=n_clients,
+        reqs_per_client=4,
+        batch_size=20,
+        signed_requests=True,
+        crypto=_device_crypto(),
+    )
+    recorder = spec.recorder()
+    cfg = dataclasses.replace(
+        recorder.network_state.config,
+        number_of_buckets=8,
+        checkpoint_interval=16,
+        max_epoch_length=100_000,
+    )
+    recorder.network_state = dataclasses.replace(
+        recorder.network_state, config=cfg
+    )
+    for nc in recorder.node_configs:
+        nc.init_parms = dataclasses.replace(
+            nc.init_parms, suspect_ticks=16, new_epoch_timeout_ticks=32
+        )
+    for cid in corrupt:
+        recorder.client_configs[cid].corrupt = True
+    recorder.reconfig_points = [
+        ReconfigPoint(
+            client_id=0,
+            req_no=2,
+            reconfiguration=ReconfigNewClient(id=n_clients, width=100),
+        )
+    ]
+    recorder.client_configs.append(
+        ClientConfig(id=n_clients, total=3, signed=True)
+    )
+    recorder.node_configs[255].start_delay = 12_000
+
+    recording = recorder.recording()
+    start = _time.perf_counter()
+    steps = 0
+    ok = {}
+    while steps < 12_000_000 and _time.perf_counter() - start < 600:
+        for _ in range(20_000):
+            recording.step()
+        steps += 20_000
+        ok = {
+            "honest": all(
+                max(n.state.committed_reqs.get(cid, 0) for n in recording.nodes)
+                >= 4
+                for cid in range(6)
+            ),
+            "added": max(
+                n.state.committed_reqs.get(n_clients, 0)
+                for n in recording.nodes
+            )
+            >= 3,
+            "state_transfer": bool(recording.nodes[255].state.state_transfers),
+        }
+        if all(ok.values()):
+            break
+    elapsed = _time.perf_counter() - start
+    snap = metrics.snapshot()
+    detail["c5_256n_wall_s"] = round(elapsed, 1)
+    detail["c5_256n_sim_steps"] = steps
+    detail["c5_all_conditions_met"] = bool(all(ok.values()))
+    detail["c5_state_transfer"] = ok.get("state_transfer", False)
+    detail["c5_reconfig_added_client_committed"] = ok.get("added", False)
+    detail["c5_byzantine_requests_committed"] = int(
+        max(
+            node.state.committed_reqs.get(cid, 0)
+            for node in recording.nodes
+            for cid in corrupt
+        )
+    )
+    detail["c5_host_crypto_share"] = round(
+        float(snap.get("host_crypto_seconds", 0.0)) / elapsed, 4
+    )
+    detail["c5_device_verify_dispatches"] = int(
+        snap.get("device_verify_dispatches", 0)
+    )
+
+
+def bench_tpu_hash_kernel(batch=4096, msg_len=640, pipeline=20):
+    """Pipelined vs sync dispatch of the batched SHA-256 kernel."""
     import numpy as np
 
-    from mirbft_tpu.ops.sha256 import pad_message, sha256_batch_kernel
+    from mirbft_tpu.ops.sha256 import TpuHasher
 
+    hasher = TpuHasher(min_device_batch=1)
     rng = np.random.default_rng(0)
-    blocks_list = [
-        pad_message(rng.integers(0, 256, size=msg_len, dtype=np.uint8).tobytes())
+    msgs = [
+        rng.integers(0, 256, size=msg_len, dtype=np.uint8).tobytes()
         for _ in range(batch)
     ]
-    max_blocks = 16
-    blocks = np.zeros((batch, max_blocks, 16), dtype=np.uint32)
-    n_blocks = np.zeros(batch, dtype=np.uint32)
-    for i, padded in enumerate(blocks_list):
-        blocks[i, : padded.shape[0]] = padded
-        n_blocks[i] = padded.shape[0]
+    hasher.collect(hasher.dispatch(msgs))  # compile + warm
 
-    import jax
+    start = time.perf_counter()
+    handles = [hasher.dispatch(msgs) for _ in range(pipeline)]
+    hasher.collect(handles[-1])
+    piped = (time.perf_counter() - start) / pipeline
 
-    jb, jn = jax.device_put(blocks), jax.device_put(n_blocks)
-    np.asarray(sha256_batch_kernel(jb, jn))  # compile + warm
-    best = float("inf")
-    for _ in range(5):
-        start = time.perf_counter()
-        # Materialize on host: on tunneled platforms block_until_ready alone
-        # does not reliably wait, so the measurement includes D2H of the
-        # 32-byte digests — which the real processor pipeline pays anyway.
-        np.asarray(sha256_batch_kernel(jb, jn))
-        best = min(best, time.perf_counter() - start)
-    return batch / best
+    start = time.perf_counter()
+    hasher.collect(hasher.dispatch(msgs))
+    sync = time.perf_counter() - start
+    return batch / piped, piped, sync
 
 
-def bench_tpu_verify_dispatch(batch=1024, n_keys=64, dispatches=5):
-    """Batched Ed25519 verification: throughput and per-dispatch p99 latency
-    (BASELINE config 2: Ed25519-signed requests)."""
+def bench_tpu_verify_kernel(batch=1024, n_keys=64, pipeline=10, sync_reps=5):
+    """Pipelined vs sync dispatch of the batched Ed25519 kernel.
+
+    Returns (sigs_per_s, pipelined_per_dispatch_s, sync_p99_s): the p99 is
+    over ``sync_reps`` blocking dispatch round-trips — what a latency-bound
+    caller observes, tunnel RTT included (round-1 semantics)."""
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )
 
     from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier
-    from mirbft_tpu.processor.verify import seal, signing_payload
-    from mirbft_tpu.processor.verify import RequestAuthenticator
 
-    auth = RequestAuthenticator(verifier=Ed25519BatchVerifier())
-    keys = []
-    for cid in range(n_keys):
-        key = Ed25519PrivateKey.from_private_bytes(
-            (cid + 1).to_bytes(4, "big") * 8
-        )
-        keys.append(key)
-        auth.register(
-            cid,
-            key.public_key().public_bytes(
-                serialization.Encoding.Raw, serialization.PublicFormat.Raw
-            ),
-        )
-    items = []
+    verifier = Ed25519BatchVerifier(min_device_batch=1)
+    pubs, msgs, sigs = [], [], []
+    keys = {}
     for i in range(batch):
         cid = i % n_keys
-        payload = b"bench-request-%d" % i
-        sig = keys[cid].sign(signing_payload(cid, i, payload))
-        items.append((cid, i, seal(payload, sig)))
+        if cid not in keys:
+            keys[cid] = Ed25519PrivateKey.from_private_bytes(
+                (cid + 1).to_bytes(4, "big") * 8
+            )
+        m = b"bench-request-%d" % i
+        pubs.append(
+            keys[cid]
+            .public_key()
+            .public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            )
+        )
+        msgs.append(m)
+        sigs.append(keys[cid].sign(m))
 
-    warm = auth.authenticate_batch(items)  # compile + warm
-    if not warm.all():
+    ok = verifier.collect(verifier.dispatch(pubs, msgs, sigs))  # warm
+    if not ok.all():
         raise RuntimeError("verify warm-up dispatch rejected valid signatures")
-    auth.dispatch_seconds.clear()
-    total = 0
+
     start = time.perf_counter()
-    for _ in range(dispatches):
-        ok = auth.authenticate_batch(items)
-        total += int(ok.sum())
-    elapsed = time.perf_counter() - start
-    return total / elapsed, auth.p99_dispatch_seconds()
+    handles = [verifier.dispatch(pubs, msgs, sigs) for _ in range(pipeline)]
+    verifier.collect(handles[-1])
+    piped = (time.perf_counter() - start) / pipeline
+
+    sync_times = []
+    for _ in range(sync_reps):
+        start = time.perf_counter()
+        verifier.collect(verifier.dispatch(pubs, msgs, sigs))
+        sync_times.append(time.perf_counter() - start)
+    import numpy as np
+
+    sync_p99 = float(np.percentile(np.array(sync_times), 99))
+    return batch / piped, piped, sync_p99
+
+
+def measure_tunnel_rtt():
+    import jax
+    import numpy as np
+
+    x = jax.device_put(np.zeros(8, dtype=np.uint32))
+    f = jax.jit(lambda a: a + 1)
+    np.asarray(f(x))
+    best = float("inf")
+    for _ in range(3):
+        start = time.perf_counter()
+        np.asarray(f(x))
+        best = min(best, time.perf_counter() - start)
+    return best
 
 
 def main():
     detail = {}
 
-    # Config 1: 4-node green path (README SerialProcessor-style config).
-    el, steps, ops, _ = run_engine(4, 4, 500, 100)
-    detail["c1_4n_commit_ops_per_s"] = round(ops / el, 1)
-    detail["c1_4n_unique_req_per_s"] = round(4 * 500 / el, 1)
+    try:
+        warm_kernels()
+    except Exception:
+        pass
 
-    # Config 2: 16-node, Ed25519-signed client requests.
-    el, steps, ops, snap = run_engine(16, 16, 50, 100, signed=True)
-    detail["c2_16n_signed_commit_ops_per_s"] = round(ops / el, 1)
-    detail["c2_16n_signed_unique_req_per_s"] = round(16 * 50 / el, 1)
+    # Config 1: 4-node green path (host crypto: batches too small to win on
+    # a device; this is the latency-bound smoke config).
+    res = run_engine(4, 4, 500, 100, device=False)
+    put(detail, "c1_4n", res, engaged_keys=False)
 
-    # Config 3 (north star): 64-replica stress, large batches.
-    el, steps, ops, snap = run_engine(64, 64, 50, 1000)
-    headline = ops / el
-    detail["c3_64n_unique_req_per_s"] = round(64 * 50 / el, 1)
-    detail["c3_64n_sim_steps"] = steps
-    detail["c3_64n_wall_s"] = round(el, 1)
-    detail["c3_hash_batch_mean"] = round(snap["hash_batch_size_mean"], 1)
-    detail["c3_hash_dispatch_p99_ms"] = round(
-        snap["hash_dispatch_seconds_p99"] * 1e3, 3
+    # Config 2: 16-node, Ed25519-signed client requests, device crypto —
+    # plus the unsigned twin for the signing-cost ratio.
+    res_u = run_engine(16, 16, 50, 100, device=False)
+    detail["c2u_16n_unique_req_per_s"] = round(res_u["unique_per_s"], 1)
+    res = run_engine(16, 16, 50, 100, signed=True, device=True)
+    put(detail, "c2_16n_signed", res)
+    detail["c2_signed_over_unsigned_slowdown"] = round(
+        res_u["unique_per_s"] / res["unique_per_s"], 2
     )
 
-    # TPU kernel micro-benchmarks (the offloaded crypto hot path).
+    # Config 3 (north star): 64-replica stress, device crypto.
+    res = run_engine(64, 64, 100, 100, device=True)
+    put(detail, "c3_64n", res)
+    headline = res["unique_per_s"]
+    detail["c3_64n_commit_ops"] = res["commit_ops"]
+
+    # Configs 4 and 5 (BASELINE configs[3..4]).
     try:
-        detail["tpu_hashes_per_s"] = round(bench_tpu_hash_dispatch(), 1)
+        config4_wan_epoch_change(detail)
+    except Exception as exc:  # must not sink the whole bench
+        detail["c4_error"] = f"{type(exc).__name__}: {exc}"[:200]
+    try:
+        config5_reconfig_byzantine(detail)
+    except Exception as exc:
+        detail["c5_error"] = f"{type(exc).__name__}: {exc}"[:200]
+
+    # TPU kernel micro-benchmarks (pipelined = device throughput; sync =
+    # one blocking round-trip, tunnel-latency bound in this environment).
+    try:
+        detail["tunnel_rtt_ms"] = round(measure_tunnel_rtt() * 1e3, 1)
+    except Exception:
+        detail["tunnel_rtt_ms"] = None
+    try:
+        per_s, piped, sync = bench_tpu_hash_kernel()
+        detail["tpu_hashes_per_s"] = round(per_s, 1)
+        detail["hash_dispatch_4096_ms"] = round(piped * 1e3, 2)
+        detail["hash_dispatch_4096_sync_ms"] = round(sync * 1e3, 2)
     except Exception:
         detail["tpu_hashes_per_s"] = None
     try:
-        sigs_per_s, verify_p99 = bench_tpu_verify_dispatch()
-        detail["tpu_sig_verifies_per_s"] = round(sigs_per_s, 1)
-        detail["sig_verify_p99_ms"] = round(verify_p99 * 1e3, 2)
+        per_s, piped, sync_p99 = bench_tpu_verify_kernel()
+        detail["tpu_sig_verifies_per_s"] = round(per_s, 1)
+        detail["sig_verify_dispatch_1024_ms"] = round(piped * 1e3, 2)
+        # p99 of blocking dispatch round-trips (tunnel RTT included) —
+        # round-1 semantics for this key.
+        detail["sig_verify_p99_ms"] = round(sync_p99 * 1e3, 2)
     except Exception:
         detail["tpu_sig_verifies_per_s"] = None
         detail["sig_verify_p99_ms"] = None
 
     result = {
-        "metric": "committed req ops/s (64-replica testengine, cluster-wide)",
+        "metric": "unique committed req/s (64-replica testengine)",
         "value": round(headline, 1),
         "unit": "req/s",
         "vs_baseline": round(headline / BASELINE_REQ_PER_S, 4),

@@ -7,7 +7,9 @@ Rebuild of reference ``config.go`` and ``mirbft.go:104-133``
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
+from .logger import Logger
 from .messages import ClientState, NetworkConfig, NetworkState
 from .state import EventInitialParameters
 
@@ -24,7 +26,8 @@ class Config:
     suspect_ticks: int = 4
     new_epoch_timeout_ticks: int = 8
     buffer_size: int = 5 * 1024 * 1024
-    logger: object = None
+    # Leveled kv logger (``mirbft_tpu.logger``; reference logger.go:62-67).
+    logger: Optional[Logger] = None
 
     def initial_parameters(self) -> EventInitialParameters:
         """Reference mirbft.go:425-434."""

@@ -72,6 +72,12 @@ class Checkpoint:
             # >= (not ==): our own agreement may arrive after 2f+1 others.
             if agreements >= intersection_quorum(self.network_config):
                 self.stable = True
+                if self.logger is not None:
+                    self.logger.debug(
+                        "checkpoint stable",
+                        seq_no=self.seq_no,
+                        agreements=agreements,
+                    )
 
 
 class CheckpointTracker:

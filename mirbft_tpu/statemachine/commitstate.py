@@ -271,6 +271,8 @@ class CommitState:
         if self.transferring:
             raise AssertionError("concurrent state transfers are not supported")
         self.transferring = True
+        if self.logger is not None:
+            self.logger.info("initiating state transfer", seq_no=seq_no)
         return self.persisted.add_t_entry(
             TEntry(seq_no=seq_no, value=value)
         ).state_transfer(seq_no, value)

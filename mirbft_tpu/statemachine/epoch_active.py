@@ -581,6 +581,12 @@ class ActiveEpoch:
             suspect = Suspect(epoch=self.epoch_config.number)
             actions.send(self.network_config.nodes, suspect)
             actions.concat(self.persisted.add_suspect(suspect))
+            if self.logger is not None:
+                self.logger.warn(
+                    "suspecting epoch: no progress",
+                    epoch=self.epoch_config.number,
+                    ticks_since_progress=self.ticks_since_progress,
+                )
 
         if (
             self.my_config.heartbeat_ticks == 0

@@ -234,6 +234,10 @@ class EpochTracker:
         self.current_epoch = self._new_target(new_epoch_number)
         self.current_epoch.my_epoch_change = my_epoch_change
         self.current_epoch.my_leader_choice = (self.my_config.id,)
+        if self.logger is not None:
+            self.logger.info(
+                "initiating epoch change", new_epoch=new_epoch_number
+            )
 
         actions = self.persisted.add_ec_entry(
             ECEntry(epoch_number=new_epoch_number)

@@ -57,6 +57,15 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+def _host_fast(parts: Sequence[bytes]) -> bool:
+    """Tiny single-part inputs (request-body digests on the propose path)
+    always take the synchronous hashlib path: one C call beats any memo or
+    device machinery.  Single source of truth for enqueue/poll/hash_batches
+    — the three must agree for poll's readiness answer to match fire-time
+    behavior."""
+    return len(parts) == 1 and len(parts[0]) < 512
+
+
 class DeviceHashPlane:
     """Cross-node SHA-256 service: content-memoized, wave-batched, async.
 
@@ -67,18 +76,30 @@ class DeviceHashPlane:
 
     _CAP = 1 << 17  # memo entries; each pins its key objects
 
+    # Device block-bucket ladder: content above the last rung hashes on
+    # host (hashlib streams large payloads faster than a tunneled dispatch
+    # amortizes, and a fixed ladder bounds XLA compilations to 3 shapes).
+    BLOCK_LADDER = (4, 16, 64)
+
     def __init__(
         self,
         device: bool = False,
         wave_size: int = 192,
         device_floor: int = 64,
-        max_block_bucket: int = 1 << 12,
+        max_block_bucket: int = 64,
         kernel: str = "scan",
+        defer_unready: bool = True,
     ):
         self.device = device
         self.wave_size = wave_size
         self.device_floor = device_floor
         self.max_block_bucket = max_block_bucket
+        # When True the scheduler re-schedules (in simulated time) hash
+        # events whose device dispatch is still in flight, instead of
+        # blocking the host loop.  Trades bit-pinned step counts (which
+        # become wall-clock-dependent) for full RTT overlap; the consensus
+        # outcome is unaffected either way.
+        self.defer_unready = defer_unready
         self._memo: "OrderedDict[tuple, tuple]" = OrderedDict()
         # key -> (refs tuple, joined message) awaiting dispatch
         self._pending: "OrderedDict[tuple, tuple]" = OrderedDict()
@@ -107,8 +128,8 @@ class DeviceHashPlane:
         pending = self._pending
         start = time.perf_counter()
         for parts in batches:
-            if len(parts) == 1 and len(parts[0]) < 512:
-                continue  # tiny single-part inputs stay on the hashlib path
+            if _host_fast(parts):
+                continue
             key = tuple(map(id, parts))
             if key in memo or key in pending or key in self._issued:
                 continue
@@ -127,26 +148,66 @@ class DeviceHashPlane:
         groups: Dict[int, List[tuple]] = {}
         for key, (refs, message) in pending.items():
             n_blocks = (len(message) + 8) // 64 + 1
-            bucket = max(4, _next_pow2(n_blocks))
-            if bucket > self.max_block_bucket:
-                # Degenerate huge message: host-hash immediately.
+            bucket = next(
+                (b for b in self.BLOCK_LADDER if n_blocks <= b), None
+            )
+            if bucket is None or bucket > self.max_block_bucket:
+                # Above the device ladder: host-hash immediately.
                 self._memo_put(key, refs, self._host_hash(message))
                 continue
             groups.setdefault(bucket, []).append((key, refs, message))
+        batch_bucket = _next_pow2(self.wave_size)
         for bucket in sorted(groups):
-            entries = groups[bucket]
-            handle = self._hasher.dispatch(
-                [m for (_, _, m) in entries],
-                block_bucket=bucket,
-                batch_bucket=_next_pow2(self.wave_size),
-            )
-            self._inflight.append(
-                ([k for (k, _, _) in entries], [r for (_, r, _) in entries], handle)
-            )
-            for key, refs, _ in entries:
-                self._issued[key] = refs
-            metrics.counter("device_hash_dispatches").inc()
-            metrics.counter("device_hashed_messages").inc(len(entries))
+            all_entries = groups[bucket]
+            for start in range(0, len(all_entries), self.wave_size):
+                entries = all_entries[start : start + self.wave_size]
+                handle = self._hasher.dispatch(
+                    [m for (_, _, m) in entries],
+                    block_bucket=bucket,
+                    batch_bucket=batch_bucket,
+                )
+                self._inflight.append(
+                    (
+                        [k for (k, _, _) in entries],
+                        [r for (_, r, _) in entries],
+                        handle,
+                    )
+                )
+                for key, refs, _ in entries:
+                    self._issued[key] = (refs, handle)
+                metrics.counter("device_hash_dispatches").inc()
+                metrics.counter("device_hashed_messages").inc(len(entries))
+
+    def poll(self, batches: Sequence[Sequence[bytes]]) -> bool:
+        """True if ``hash_batches(batches)`` would not block on the device.
+
+        The scheduler uses this to model device latency in *simulated* time:
+        an unready hash event is re-scheduled instead of stalling the host
+        event loop for a device round-trip.  Side effect: pending waves
+        covering polled misses are launched (asynchronously) so progress is
+        guaranteed — a dispatch, once launched, eventually reports ready."""
+        if not self.device:
+            return True
+        launch = False
+        ready = True
+        for parts in batches:
+            if _host_fast(parts):
+                continue
+            key = tuple(map(id, parts))
+            if key in self._memo:
+                continue
+            issued = self._issued.get(key)
+            if issued is not None:
+                if not issued[1].words.is_ready():
+                    ready = False
+                continue
+            if key in self._pending:
+                launch = True
+                ready = False
+            # Unknown keys take the host straggler path: no device block.
+        if launch:
+            self._launch_wave()
+        return ready
 
     # -- fire-time (Hasher protocol) ----------------------------------------
 
@@ -155,7 +216,7 @@ class DeviceHashPlane:
         memo = self._memo
         misses: List[int] = []
         for i, parts in enumerate(batches):
-            if len(parts) == 1 and len(parts[0]) < 512:
+            if _host_fast(parts):
                 out[i] = hashlib.sha256(parts[0]).digest()
                 continue
             entry = memo.get(tuple(map(id, parts)))
@@ -168,7 +229,8 @@ class DeviceHashPlane:
                     continue
             misses.append(i)
         if misses and self._inflight:
-            self._materialize_inflight()
+            needed = {tuple(map(id, batches[i])) for i in misses}
+            self._materialize_inflight(needed)
             for i in list(misses):
                 entry = memo.get(tuple(map(id, batches[i])))
                 if entry is not None:
@@ -202,10 +264,21 @@ class DeviceHashPlane:
             )
         return out  # type: ignore[return-value]
 
-    def _materialize_inflight(self) -> None:
+    def _materialize_inflight(self, needed: Optional[set] = None) -> None:
+        """Collect in-flight dispatches into the memo.  With ``needed``,
+        dispatches that are neither ready nor carrying a needed key stay in
+        flight — a blocking collect is paid only for results the caller
+        actually requires (the contract ``poll`` assumes)."""
         start = time.perf_counter()
         inflight, self._inflight = self._inflight, []
         for keys, refs, handle in inflight:
+            if (
+                needed is not None
+                and not handle.words.is_ready()
+                and not any(key in needed for key in keys)
+            ):
+                self._inflight.append((keys, refs, handle))
+                continue
             digests = self._hasher.collect(handle)
             for key, ref, digest in zip(keys, refs, digests):
                 self._memo_put(key, ref, digest)

@@ -251,12 +251,18 @@ class ClientConfig:
 
     ``signed`` enables the extended Ed25519-signed-request mode (BASELINE
     configs 2-5, no reference counterpart): the client signs every request
-    and replicas authenticate before persisting/acking."""
+    and replicas authenticate before persisting/acking.
+
+    ``corrupt`` models a byzantine signer (BASELINE config 5): every
+    envelope carries a garbage signature, so honest replicas must reject
+    each proposal at the authentication gate and none of the client's
+    requests ever commit."""
 
     id: int
     total: int
     ignore_nodes: Tuple[int, ...] = ()
     signed: bool = False
+    corrupt: bool = False
 
     def should_skip(self, node_id: int) -> bool:
         return node_id in self.ignore_nodes
@@ -285,6 +291,11 @@ class CryptoConfig:
     auth_floor: int = 16
     lookahead: int = 128
     kernel: str = "scan"  # sha256 backend: "scan" | "pallas"
+    # Re-schedule (in sim time) hash events whose device dispatch is still
+    # in flight rather than blocking the host loop.  Full RTT overlap, but
+    # step counts become wall-clock-dependent; disable for runs that pin
+    # exact step counts against the host path.
+    defer_unready: bool = True
 
 
 class SimClient:
@@ -326,9 +337,16 @@ class SimClient:
         if sealed is None:
             from ..processor.verify import seal, signing_payload
 
-            signature = self._signing_key().sign(
-                signing_payload(self.config.id, req_no, payload)
-            )
+            if self.config.corrupt:
+                # Byzantine signer: deterministic garbage in place of a
+                # valid signature (fails verification at every replica).
+                signature = hashlib.sha512(
+                    b"corrupt-" + _u64(self.config.id) + _u64(req_no)
+                ).digest()
+            else:
+                signature = self._signing_key().sign(
+                    signing_payload(self.config.id, req_no, payload)
+                )
             sealed = seal(payload, signature)
             self._sealed[req_no] = sealed
         return sealed
@@ -351,6 +369,7 @@ class SimNode:
         interceptor=None,
         authenticator=None,
         hasher=None,
+        logger=None,
     ):
         self.id = node_id
         self.config = config
@@ -361,6 +380,7 @@ class SimNode:
         self.interceptor = interceptor
         self.authenticator = authenticator
         self.hasher = hasher if hasher is not None else _SHARED_CPU_PLANE
+        self.logger = logger
         self.work_items: Optional[proc.WorkItems] = None
         self.clients: Optional[proc.Clients] = None
         self.state_machine: Optional[StateMachine] = None
@@ -370,7 +390,7 @@ class SimNode:
         """(Re)boot the node from its WAL (reference recorder.go:222-244)."""
         self.work_items = proc.WorkItems()
         self.clients = proc.Clients(self.hasher, self.req_store)
-        self.state_machine = StateMachine()
+        self.state_machine = StateMachine(self.logger)
         self.pending = {}
         events = proc.recover_wal_for_existing_node(self.wal, init_parms)
         self.work_items.result_events.concat(events)
@@ -389,6 +409,7 @@ class Recorder:
         random_seed: int = 0,
         event_log_writer=None,
         crypto: Optional[CryptoConfig] = None,
+        logger=None,
     ):
         self.network_state = network_state
         self.node_configs = node_configs
@@ -398,6 +419,7 @@ class Recorder:
         self.random_seed = random_seed
         self.event_log_writer = event_log_writer
         self.crypto = crypto or CryptoConfig()
+        self.logger = logger
 
     def recording(self) -> "Recording":
         event_queue = EventQueue(seed=self.random_seed, mangler=self.mangler)
@@ -416,6 +438,7 @@ class Recorder:
                 wave_size=crypto.hash_wave,
                 device_floor=crypto.hash_floor,
                 kernel=crypto.kernel,
+                defer_unready=crypto.defer_unready,
             )
         else:
             hash_plane = _SHARED_CPU_PLANE
@@ -464,6 +487,11 @@ class Recorder:
                 writer = self.event_log_writer
                 interceptor = _Interceptor(i, event_queue, writer)
 
+            node_logger = None
+            if self.logger is not None:
+                from ..logger import PrefixLogger
+
+                node_logger = PrefixLogger(self.logger, node=i)
             nodes.append(
                 SimNode(
                     i,
@@ -475,6 +503,7 @@ class Recorder:
                     interceptor,
                     auth_plane,
                     hash_plane,
+                    node_logger,
                 )
             )
             event_queue.insert_initialize(
@@ -667,6 +696,25 @@ class Recording:
             )
             node.pending["net"] = False
         elif event.process_hash_actions is not None:
+            hash_plane = self.hash_plane
+            if (
+                hash_plane is not None
+                and hash_plane.device
+                and hash_plane.defer_unready
+                and not hash_plane.poll(
+                    [a.data for a in event.process_hash_actions]
+                )
+            ):
+                # The device dispatch for this batch is still in flight:
+                # model the extra device latency in simulated time instead
+                # of stalling the host loop on a blocking collect.
+                queue.insert_process(
+                    node.id,
+                    "process_hash_actions",
+                    event.process_hash_actions,
+                    parms.process_hash_latency,
+                )
+                return  # pending["hash"] stays set; nothing new to schedule
             node.work_items.add_hash_results(
                 proc.process_hash_actions(node.hasher, event.process_hash_actions)
             )
@@ -712,8 +760,12 @@ class Recording:
     def drain_clients(self, timeout: int) -> int:
         """Run until every client's requests commit on every node
         (reference recorder.go:682-723).  Returns the step count."""
+        # Corrupt (byzantine-signer) clients are rejected at the
+        # authentication gate, so nothing of theirs ever commits: their
+        # drain target is zero.
         target_reqs = {
-            c.config.id: c.config.total for c in self.clients.values()
+            c.config.id: 0 if c.config.corrupt else c.config.total
+            for c in self.clients.values()
         }
         count = 0
         while True:

@@ -37,7 +37,9 @@ def test_device_hash_plane_parity_and_engagement():
     steps_dev, finals_dev, snap = _run(
         Spec(
             **base,
-            crypto=CryptoConfig(device=True, hash_wave=4, hash_floor=1),
+            crypto=CryptoConfig(
+                device=True, hash_wave=4, hash_floor=1, defer_unready=False
+            ),
         )
     )
     assert steps_dev == steps_host
@@ -65,6 +67,7 @@ def test_device_auth_plane_parity_and_engagement():
                 auth_wave=8,
                 auth_floor=4,
                 lookahead=16,
+                defer_unready=False,
             ),
         )
     )

@@ -1,0 +1,223 @@
+"""Exact-ActionList unit tests of the sequence three-phase commit.
+
+Port of reference ``pkg/statemachine/sequence_test.go`` — which the
+reference ships disabled (``XDescribe``, sequence_test.go:17); here the
+scenarios run, extended through the prepare/commit quorum transitions the
+reference file stops short of.
+
+Setup mirrors the reference BeforeEach: my_id=1, nodes {0,1,2,3}, f=1
+(intersection quorum 3), epoch=4, seq_no=5, owner=0 (we are a follower).
+"""
+
+import pytest
+
+from mirbft_tpu import state as st
+from mirbft_tpu.config import standard_initial_network_state
+from mirbft_tpu.messages import (
+    CEntry,
+    Commit,
+    FEntry,
+    EpochConfig,
+    PEntry,
+    Prepare,
+    QEntry,
+    RequestAck,
+)
+from mirbft_tpu.statemachine.persisted import PersistedLog
+from mirbft_tpu.statemachine.sequence import SeqState, Sequence
+
+ACK1 = RequestAck(client_id=9, req_no=7, digest=b"msg1-digest")
+ACK2 = RequestAck(client_id=9, req_no=8, digest=b"msg2-digest")
+NODES = (0, 1, 2, 3)
+
+
+def make_sequence(owner=0, my_id=1):
+    network_state = standard_initial_network_state(4, 0)
+    log = PersistedLog()
+    # Seed genesis the way a booted node does (CEntry + FEntry), so the
+    # next Persist index is deterministic (=3).
+    log.append_initial_load(
+        1, CEntry(seq_no=0, checkpoint_value=b"genesis", network_state=network_state)
+    )
+    log.append_initial_load(
+        2,
+        FEntry(
+            ends_epoch_config=EpochConfig(
+                number=0, leaders=NODES, planned_expiration=0
+            )
+        ),
+    )
+    return Sequence(
+        owner=owner,
+        epoch=4,
+        seq_no=5,
+        persisted=log,
+        network_config=network_state.config,
+        my_id=my_id,
+    )
+
+
+class FakeClientRequest:
+    """Owner-side client request carrying its ack + agreement mask."""
+
+    def __init__(self, ack, agreements=0b1111):
+        self.ack = ack
+        self.agreements = agreements
+
+    def refresh(self):
+        return self.agreements
+
+
+def test_allocate_emits_exact_hash_action():
+    """Reference sequence_test.go:41-106 ("transitions from Unknown to
+    Allocated"): allocation emits exactly one Hash action carrying the batch
+    digests and a fully-populated Batch origin."""
+    s = make_sequence()
+    actions = list(s.allocate([ACK1, ACK2], None))
+    assert actions == [
+        st.ActionHashRequest(
+            data=(b"msg1-digest", b"msg2-digest"),
+            origin=st.BatchOrigin(
+                source=0, seq_no=5, epoch=4, request_acks=(ACK1, ACK2)
+            ),
+        )
+    ]
+    # No outstanding requests -> READY awaiting the digest (the reference
+    # models this as Allocated; PENDING_REQUESTS/READY split the same span).
+    assert s.state == SeqState.READY
+    assert s.batch == [ACK1, ACK2]
+
+
+def test_allocate_in_wrong_state_panics():
+    """Reference sequence_test.go:108-134: allocating a non-uninitialized
+    sequence is an invariant violation."""
+    s = make_sequence()
+    s.allocate([ACK1], None)
+    state_before = s.state
+    with pytest.raises(AssertionError):
+        s.allocate([ACK2], None)
+    assert s.state == state_before
+
+
+def test_batch_hash_result_persists_qentry_then_sends_prepare():
+    """Reference sequence_test.go:137-210: the digest's arrival persists the
+    QEntry and sends Prepare (we are a follower) — in that order
+    (WAL-before-send)."""
+    s = make_sequence()
+    s.allocate([ACK1, ACK2], None)
+    actions = list(s.apply_batch_hash_result(b"digest"))
+    expected_q = QEntry(seq_no=5, digest=b"digest", requests=(ACK1, ACK2))
+    assert actions == [
+        st.ActionPersist(index=3, entry=expected_q),
+        st.ActionSend(
+            targets=NODES, msg=Prepare(seq_no=5, epoch=4, digest=b"digest")
+        ),
+    ]
+    assert s.digest == b"digest"
+    assert s.state == SeqState.PREPREPARED
+    assert s.q_entry == expected_q
+
+
+def test_owner_sends_preprepare_instead_of_prepare():
+    """Owner side of reference sequence.go:224-243: the leader sends the
+    full-batch Preprepare and forwards unacked requests first."""
+    from mirbft_tpu.messages import Preprepare
+
+    s = make_sequence(owner=1, my_id=1)
+    s.allocate_as_owner(
+        [FakeClientRequest(ACK1, agreements=0b1011), FakeClientRequest(ACK2)]
+    )
+    actions = list(s.apply_batch_hash_result(b"digest"))
+    assert actions == [
+        st.ActionPersist(
+            index=3, entry=QEntry(seq_no=5, digest=b"digest", requests=(ACK1, ACK2))
+        ),
+        # node 2 never acked ACK1: the owner forwards it before preprepare
+        st.ActionForwardRequest(targets=(2,), ack=ACK1),
+        st.ActionSend(
+            targets=NODES,
+            msg=Preprepare(seq_no=5, epoch=4, batch=(ACK1, ACK2)),
+        ),
+    ]
+
+
+def test_prepare_quorum_persists_pentry_then_sends_commit():
+    """Reference sequence_test.go:228-264 ("transitions from Preprepared to
+    Prepared"), with the quorum actually assembled: 3 = (n+f+2)/2 matching
+    prepares (including our own) persist the PEntry and send Commit."""
+    s = make_sequence()
+    s.allocate([ACK1, ACK2], None)
+    s.apply_batch_hash_result(b"digest")  # owner 0 implicit + our Prepare sent
+    assert list(s.apply_prepare_msg(1, b"digest")) == []  # self-loopback: 2 votes
+    actions = list(s.apply_prepare_msg(2, b"digest"))  # third vote -> quorum
+    assert actions == [
+        st.ActionPersist(index=4, entry=PEntry(seq_no=5, digest=b"digest")),
+        st.ActionSend(
+            targets=NODES, msg=Commit(seq_no=5, epoch=4, digest=b"digest")
+        ),
+    ]
+    assert s.state == SeqState.PREPARED
+
+
+def test_conflicting_prepare_digests_do_not_count():
+    """Votes for a different digest never contribute to our quorum."""
+    s = make_sequence()
+    s.allocate([ACK1, ACK2], None)
+    s.apply_batch_hash_result(b"digest")
+    s.apply_prepare_msg(1, b"digest")
+    assert list(s.apply_prepare_msg(2, b"evil-digest")) == []
+    assert s.state == SeqState.PREPREPARED  # still only 2 matching votes
+    assert list(s.apply_prepare_msg(3, b"digest")) != []  # now 3 -> PREPARED
+    assert s.state == SeqState.PREPARED
+
+
+def test_duplicate_votes_are_dropped():
+    """A node's second prepare does not advance the count (including the
+    owner: see sequence.py:255-261 for the documented hardening vs the
+    reference's owner double-count)."""
+    s = make_sequence()
+    s.allocate([ACK1, ACK2], None)
+    s.apply_batch_hash_result(b"digest")  # owner 0 voted
+    assert list(s.apply_prepare_msg(0, b"digest")) == []  # duplicate owner vote
+    s.apply_prepare_msg(1, b"digest")
+    assert s.state == SeqState.PREPREPARED  # 2 distinct votes, no quorum
+
+
+def test_commit_quorum_reaches_committed():
+    """Reference sequence.go:320-355: 3 matching commits including our own
+    transition PREPARED -> COMMITTED (no actions: the commit cascade is the
+    epoch's job)."""
+    s = make_sequence()
+    s.allocate([ACK1, ACK2], None)
+    s.apply_batch_hash_result(b"digest")
+    s.apply_prepare_msg(1, b"digest")
+    s.apply_prepare_msg(2, b"digest")
+    assert s.state == SeqState.PREPARED
+    assert list(s.apply_commit_msg(0, b"digest")) == []
+    assert list(s.apply_commit_msg(1, b"digest")) == []  # our own commit
+    assert s.state == SeqState.PREPARED
+    assert list(s.apply_commit_msg(3, b"digest")) == []
+    assert s.state == SeqState.COMMITTED
+
+
+def test_commit_quorum_requires_own_commit():
+    """Without our own Commit (PEntry persisted barrier) the sequence must
+    not report COMMITTED even with a full foreign quorum."""
+    s = make_sequence()
+    s.allocate([ACK1, ACK2], None)
+    s.apply_batch_hash_result(b"digest")
+    for source in (0, 2, 3):
+        s.apply_commit_msg(source, b"digest")
+    assert s.state != SeqState.COMMITTED
+
+
+def test_null_batch_prepares_immediately():
+    """An empty batch (heartbeat null sequence) needs no hash dispatch: it
+    persists an empty QEntry and prepares with the empty digest."""
+    s = make_sequence()
+    actions = list(s.allocate([], None))
+    assert actions == [
+        st.ActionPersist(index=3, entry=QEntry(seq_no=5, digest=b"", requests=())),
+        st.ActionSend(targets=NODES, msg=Prepare(seq_no=5, epoch=4, digest=b"")),
+    ]
+    assert s.state == SeqState.PREPREPARED
