@@ -42,7 +42,15 @@ def _device_crypto():
     from mirbft_tpu.testengine import CryptoConfig
 
     return CryptoConfig(
-        device=True, hash_wave=64, hash_floor=8, auth_wave=128, auth_floor=16
+        device=True,
+        hash_wave=64,
+        hash_floor=8,
+        auth_wave=1024,
+        auth_floor=16,
+        # Blocking collects: on this single-core host the defer path's
+        # re-scheduled events spin through sim steps faster than the tunnel
+        # RTT elapses, multiplying step counts for nothing.
+        defer_unready=False,
     )
 
 
@@ -62,9 +70,9 @@ def warm_kernels():
         hasher.collect(h)
 
     verifier = Ed25519BatchVerifier(min_device_batch=1)
-    pubs = [b"\x00" * 32] * 128
-    msgs = [b""] * 128
-    sigs = [b"\x00" * 64] * 128
+    pubs = [b"\x00" * 32] * 1024
+    msgs = [b""] * 1024
+    sigs = [b"\x00" * 64] * 1024
     verifier.collect(verifier.dispatch(pubs, msgs, sigs))
 
 

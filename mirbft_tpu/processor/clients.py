@@ -164,11 +164,17 @@ class Clients:
             return c
 
     def process_client_actions(self, actions: Actions) -> Events:
-        """Reference clients.go:46-83."""
+        """Reference clients.go:46-83.  AllocatedRequest dominates (a whole
+        client window per checkpoint) and arrives in same-client runs, so
+        the client handle is cached across consecutive actions."""
         events = Events()
+        last_id = None
+        client = None
         for action in actions:
             if isinstance(action, st.ActionAllocatedRequest):
-                client = self.client(action.client_id)
+                if action.client_id != last_id:
+                    last_id = action.client_id
+                    client = self.client(last_id)
                 digest = client.allocate(action.req_no)
                 if digest is None:
                     continue
@@ -180,8 +186,12 @@ class Clients:
                     )
                 )
             elif isinstance(action, st.ActionCorrectRequest):
-                client = self.client(action.ack.client_id)
-                client.add_correct_digest(action.ack.req_no, action.ack.digest)
+                # Distinct local: must not clobber the cached allocation
+                # handle above while its last_id remains set.
+                correct_client = self.client(action.ack.client_id)
+                correct_client.add_correct_digest(
+                    action.ack.req_no, action.ack.digest
+                )
             elif isinstance(action, st.ActionStateApplied):
                 for client_state in action.network_state.clients:
                     self.client(client_state.id).state_applied(client_state)

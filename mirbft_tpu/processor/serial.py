@@ -10,6 +10,7 @@ Ordering guarantees preserved from the reference:
 
 from __future__ import annotations
 
+import operator
 from typing import List, Optional
 
 from .. import metrics
@@ -89,8 +90,7 @@ def process_wal_actions(wal: WAL, actions: Actions) -> Actions:
     return net_actions
 
 
-def _ack_sort_key(ack: m.RequestAck):
-    return (ack.client_id, ack.req_no)
+_ack_sort_key = operator.attrgetter("client_id", "req_no")
 
 
 def _coalesce_sends(actions: Actions) -> List[st.ActionSend]:

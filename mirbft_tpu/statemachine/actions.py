@@ -31,6 +31,7 @@ class Actions:
     def __init__(self, items: Optional[List[s.Action]] = None):
         self.items = items if items is not None else []
 
+
     # --- composition ---
 
     def concat(self, other: "Actions") -> "Actions":
@@ -214,3 +215,32 @@ class Events:
     def actions_received(self) -> "Events":
         self.items.append(s.EventActionsReceived())
         return self
+
+
+class _FrozenActions(Actions):
+    """Immutable empty ActionList, returned by hot no-op paths to avoid
+    allocating a fresh list per call.  Mutators raise so an accidental
+    in-place use is caught immediately (``concat(EMPTY_ACTIONS)`` onto a
+    live list is fine — it only reads)."""
+
+    __slots__ = ()
+
+    def _frozen(self, *_args, **_kw):
+        raise AssertionError("EMPTY_ACTIONS is immutable; allocate Actions()")
+
+    concat = _frozen
+    push_back = _frozen
+    send = _frozen
+    hash = _frozen
+    persist = _frozen
+    truncate = _frozen
+    commit = _frozen
+    checkpoint = _frozen
+    allocate_request = _frozen
+    correct_request = _frozen
+    forward_request = _frozen
+    state_applied = _frozen
+    state_transfer = _frozen
+
+
+EMPTY_ACTIONS = _FrozenActions()

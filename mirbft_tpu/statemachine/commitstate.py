@@ -25,7 +25,7 @@ from ..messages import (
     TEntry,
 )
 from ..state import EventCheckpointResult
-from .actions import Actions
+from .actions import EMPTY_ACTIONS, Actions
 from .persisted import PersistedLog
 from .stateless import Bitmask
 
@@ -346,9 +346,7 @@ class CommitState:
             self.highest_commit = q_entry.seq_no
 
         ci = self.active_state.config.checkpoint_interval
-        upper = q_entry.seq_no - self.low_watermark > ci
-        offset = (q_entry.seq_no - (self.low_watermark + 1)) % ci
-        commits = self.upper_half_commits if upper else self.lower_half_commits
+        commits, offset = self._slot(q_entry.seq_no, ci)
         existing = commits[offset]
         if existing is not None:
             if existing.digest != q_entry.digest:
@@ -358,10 +356,33 @@ class CommitState:
         else:
             commits[offset] = q_entry
 
+    def _slot(self, seq_no: int, ci: int):
+        """(half-list, offset) holding the pending QEntry slot for seq_no —
+        the single source of the two-half window arithmetic
+        (reference commitstate.go:24-38)."""
+        upper = seq_no - self.low_watermark > ci
+        offset = (seq_no - (self.low_watermark + 1)) % ci
+        return (
+            self.upper_half_commits if upper else self.lower_half_commits,
+            offset,
+        )
+
     def drain(self) -> Actions:
         """Emit all in-order Commit actions plus the Checkpoint action at the
         interval boundary (reference commitstate.go:228-269)."""
         ci = self.active_state.config.checkpoint_interval
+
+        # Fast path for the per-event fixpoint loop: nothing commits and no
+        # checkpoint is due — the overwhelmingly common case.
+        lac = self.last_applied_commit
+        if lac < self.low_watermark + 2 * ci and not (
+            lac == self.low_watermark + ci and not self.checkpoint_pending
+        ):
+            next_commit = lac + 1
+            commits, offset = self._slot(next_commit, ci)
+            if commits[offset] is None:
+                return EMPTY_ACTIONS
+
         actions = Actions()
         while self.last_applied_commit < self.low_watermark + 2 * ci:
             if (
@@ -377,9 +398,7 @@ class CommitState:
                 self.checkpoint_pending = True
 
             next_commit = self.last_applied_commit + 1
-            upper = next_commit - self.low_watermark > ci
-            offset = (next_commit - (self.low_watermark + 1)) % ci
-            commits = self.upper_half_commits if upper else self.lower_half_commits
+            commits, offset = self._slot(next_commit, ci)
             commit = commits[offset]
             if commit is None:
                 break

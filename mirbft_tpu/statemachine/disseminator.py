@@ -33,7 +33,7 @@ from ..messages import (
     RequestAck,
 )
 from ..state import EventInitialParameters
-from .actions import Actions
+from .actions import EMPTY_ACTIONS, Actions
 from .client_tracker import ClientTracker
 from .msgbuffers import Applyable, MsgBuffer, NodeBuffers
 from .stateless import intersection_quorum, is_committed, some_correct_quorum

@@ -26,7 +26,7 @@ from ..messages import (
     Suspect,
 )
 from ..state import EventInitialParameters
-from .actions import Actions
+from .actions import EMPTY_ACTIONS, Actions
 from .client_tracker import ClientTracker
 from .commitstate import CommitState
 from .msgbuffers import Applyable, MsgBuffer, NodeBuffers

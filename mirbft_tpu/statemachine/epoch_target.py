@@ -33,7 +33,7 @@ from ..messages import (
     Suspect,
 )
 from ..state import EventInitialParameters
-from .actions import Actions
+from .actions import EMPTY_ACTIONS, Actions
 from .batch_tracker import BatchTracker
 from .client_tracker import ClientTracker
 from .commitstate import CommitState
@@ -601,6 +601,15 @@ class EpochTarget:
     # --- driver (reference :797-851) ---
 
     def advance_state(self) -> Actions:
+        # Fast path for the per-event fixpoint: a steady-state epoch with no
+        # pending available requests and no window work allocates nothing.
+        if self.state == EpochTargetState.IN_PROGRESS:
+            ae = self.active_epoch
+            if (
+                not ae.outstanding_reqs.available_iterator.has_next()
+                and not ae.needs_advance()
+            ):
+                return EMPTY_ACTIONS
         actions = Actions()
         while True:
             old_state = self.state

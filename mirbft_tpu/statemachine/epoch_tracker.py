@@ -30,7 +30,7 @@ from ..messages import (
     Suspect,
 )
 from ..state import EventInitialParameters
-from .actions import Actions
+from .actions import EMPTY_ACTIONS, Actions
 from .batch_tracker import BatchTracker
 from .client_tracker import ClientTracker
 from .commitstate import CommitState
@@ -223,7 +223,7 @@ class EpochTracker:
 
         if self.commit_state.checkpoint_pending:
             # Wait for pending checkpoints before initiating epoch change.
-            return Actions()
+            return EMPTY_ACTIONS
 
         new_epoch_number = self.current_epoch.number + 1
         if self.max_correct_epoch > new_epoch_number:
@@ -263,12 +263,12 @@ class EpochTracker:
     def step(self, source: int, msg: Msg) -> Actions:
         epoch_number = epoch_for_msg(msg)
         if epoch_number < self.current_epoch.number:
-            return Actions()
+            return EMPTY_ACTIONS
         if epoch_number > self.current_epoch.number:
             if self.max_epochs.get(source, 0) < epoch_number:
                 self.max_epochs[source] = epoch_number
             self.future_msgs[source].store(msg)
-            return Actions()
+            return EMPTY_ACTIONS
         return self.apply_msg(source, msg)
 
     def apply_msg(self, source: int, msg: Msg) -> Actions:

@@ -214,48 +214,52 @@ class StateMachine:
     # --- event dispatch (reference state_machine.go:168-270) ---
 
     def apply_event(self, event: st.Event) -> Actions:
-        actions = Actions()
+        cls = event.__class__
 
-        if isinstance(event, st.EventInitialParameters):
+        if cls is st.EventInitialParameters:
             self._initialize(event)
             return Actions()
-        if isinstance(event, st.EventLoadPersistedEntry):
+        if cls is st.EventLoadPersistedEntry:
             self._apply_persisted(event.index, event.entry)
             return Actions()
-        if isinstance(event, st.EventLoadCompleted):
+
+        actions = Actions()
+        if cls is st.EventLoadCompleted:
             actions = self._complete_initialization()
-        elif isinstance(event, st.EventActionsReceived):
+        elif cls is st.EventActionsReceived:
             # Marker correlating action batches to their events in the
             # recorded stream — and the batch boundary at which deferred
             # ack broadcasts flush (one AckBatch per client per batch).
             if self.state == MachineState.INITIALIZED:
                 return self.client_hash_disseminator.flush_acks()
-            return Actions()
+            return actions
         else:
             if self.state != MachineState.INITIALIZED:
                 raise AssertionError(
                     "cannot apply events to an uninitialized state machine"
                 )
-            if isinstance(event, st.EventTickElapsed):
-                actions.concat(self.client_hash_disseminator.tick())
-                actions.concat(self.epoch_tracker.tick())
-            elif isinstance(event, st.EventStep):
+            # Ordered by hot-path frequency: Step dominates, then the
+            # hash/persist round-trips, then ticks.
+            if cls is st.EventStep:
                 actions.concat(self.step(event.source, event.msg))
-            elif isinstance(event, st.EventHashResult):
-                actions.concat(self._process_hash_result(event))
-            elif isinstance(event, st.EventCheckpointResult):
-                actions.concat(self._process_checkpoint_result(event))
-            elif isinstance(event, st.EventRequestPersisted):
+            elif cls is st.EventRequestPersisted:
                 actions.concat(
                     self.client_hash_disseminator.apply_new_request(
                         event.request_ack
                     )
                 )
-            elif isinstance(event, st.EventStateTransferFailed):
+            elif cls is st.EventHashResult:
+                actions.concat(self._process_hash_result(event))
+            elif cls is st.EventCheckpointResult:
+                actions.concat(self._process_checkpoint_result(event))
+            elif cls is st.EventTickElapsed:
+                actions.concat(self.client_hash_disseminator.tick())
+                actions.concat(self.epoch_tracker.tick())
+            elif cls is st.EventStateTransferFailed:
                 # Mirrors the reference's unresolved edge
                 # (state_machine.go:210-212).
                 raise NotImplementedError("state transfer failure handling")
-            elif isinstance(event, st.EventStateTransferComplete):
+            elif cls is st.EventStateTransferComplete:
                 if not self.commit_state.transferring:
                     raise AssertionError(
                         "state transfer completed but none was requested"

@@ -29,7 +29,7 @@ from ..messages import (
     QEntry,
     RequestAck,
 )
-from .actions import Actions
+from .actions import EMPTY_ACTIONS, Actions
 from .persisted import PersistedLog
 from .stateless import intersection_quorum
 
@@ -264,13 +264,13 @@ class Sequence:
                 0, self.seq_no, digest if digest is not None else b"", source
             )
             if count is None:
-                return Actions()  # duplicate
+                return EMPTY_ACTIONS  # duplicate
             if source == self.my_id:
                 self.my_prepare_digest = digest
         else:
             bit = 1 << source
             if (self.prep_mask | self.commit_mask) & bit:
-                return Actions()
+                return EMPTY_ACTIONS
             self.prep_mask |= bit
             if source == self.my_id:
                 self.my_prepare_digest = digest
@@ -288,7 +288,7 @@ class Sequence:
             return Actions()
         if state is SeqState.READY or state is SeqState.PENDING_REQUESTS:
             return self.advance_state()
-        return Actions()
+        return EMPTY_ACTIONS
 
     def _check_prepare_quorum(self) -> Actions:
         """2f+1 prepares (leader's preprepare counts) + own prepare persisted
@@ -298,25 +298,25 @@ class Sequence:
             prep_count, _, self_pc, _, my_matches = self.plane.query(self.seq_no)
             if not self_pc:
                 # Have not sent our own prepare → QEntry may not be persisted.
-                return Actions()
+                return EMPTY_ACTIONS
             if not my_matches:
                 # Network's correct digest differs from ours; do not prepare.
-                return Actions()
+                return EMPTY_ACTIONS
             if prep_count < self._iq:
-                return Actions()
+                return EMPTY_ACTIONS
         else:
             agreements = self.prepares.get(my_key, 0)
             if not ((self.prep_mask | self.commit_mask) >> self.my_id) & 1:
-                return Actions()
+                return EMPTY_ACTIONS
             my_digest = (
                 self.my_prepare_digest
                 if self.my_prepare_digest is not None
                 else b""
             )
             if my_digest != my_key:
-                return Actions()
+                return EMPTY_ACTIONS
             if agreements < self._iq:
-                return Actions()
+                return EMPTY_ACTIONS
 
         self.state = SeqState.PREPARED
         p_entry = PEntry(seq_no=self.seq_no, digest=my_key)
@@ -332,11 +332,11 @@ class Sequence:
                 1, self.seq_no, digest if digest is not None else b"", source
             )
             if count is None:
-                return Actions()  # duplicate commit
+                return EMPTY_ACTIONS  # duplicate commit
         else:
             bit = 1 << source
             if self.commit_mask & bit:
-                return Actions()  # duplicate commit
+                return EMPTY_ACTIONS  # duplicate commit
             self.commit_mask |= bit
             key = digest if digest is not None else b""
             count = self.commits.get(key, 0) + 1
@@ -345,7 +345,7 @@ class Sequence:
         # transition on a commit vote (commit emission itself is action-free).
         if self._state is SeqState.PREPARED and count >= self._iq:
             self._check_commit_quorum()
-        return Actions()
+        return EMPTY_ACTIONS
 
     def _check_commit_quorum(self) -> None:
         """Reference sequence.go:339-355."""

@@ -88,7 +88,7 @@ class DeviceHashPlane:
         device_floor: int = 64,
         max_block_bucket: int = 64,
         kernel: str = "scan",
-        defer_unready: bool = True,
+        defer_unready: bool = False,
     ):
         self.device = device
         self.wave_size = wave_size

@@ -292,10 +292,11 @@ class CryptoConfig:
     lookahead: int = 128
     kernel: str = "scan"  # sha256 backend: "scan" | "pallas"
     # Re-schedule (in sim time) hash events whose device dispatch is still
-    # in flight rather than blocking the host loop.  Full RTT overlap, but
-    # step counts become wall-clock-dependent; disable for runs that pin
-    # exact step counts against the host path.
-    defer_unready: bool = True
+    # in flight rather than blocking the host loop.  Step counts become
+    # wall-clock-dependent, and on a single-core host the re-scheduled
+    # events spin faster than the device round-trip elapses — opt in only
+    # when the host has spare cores to burn during device waits.
+    defer_unready: bool = False
 
 
 class SimClient:
