@@ -17,8 +17,19 @@ runs the batch dimension across VPU lanes explicitly:
   (tile, L) bucket.
 
 SHA-256 is pure uint32 bitwise/rotate/add arithmetic — no MXU work — so the
-win over the XLA-scheduled version is locality (state never leaves VMEM) and
-the removal of scan/vmap loop machinery.
+hoped-for win over the XLA-scheduled version was locality (state pinned in
+VMEM, no scan/vmap loop machinery).
+
+**Measured verdict (TPU v5e, round 2): the scan kernel wins 6.5x** — 4.3 ms
+vs 28 ms device-time per 4096-message dispatch.  The batch-dim-major layout
+keeps each 16-word message contiguous in the (padded) lane dimension, so
+every ``w[t]`` read is a cross-lane slice the VPU handles poorly, while
+XLA's own schedule for the vmapped scan vectorizes the batch across lanes
+cleanly.  (TILE > 128 additionally exhausts scoped VMEM.)  The module is
+retained as the explicit-tiling alternative backend — selected via
+``TpuHasher(kernel="pallas")`` / ``CryptoConfig(kernel="pallas")`` and
+covered by a parity test — but ``"scan"`` is the default everywhere; a
+faster pallas variant needs a lanes-major (batch-last) data layout.
 
 Reference parity: replaces the streaming ``crypto.SHA256`` hasher behind the
 reference's ``Hasher`` interface (``pkg/processor/serial.go:21-23,180-198``).
@@ -37,7 +48,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .sha256 import _H0, _K  # round constants / initial state (FIPS 180-4)
 
-TILE = 256  # messages per grid program; multiple of the 128-lane VPU width
+TILE = 128  # messages per grid program (256 exceeds scoped VMEM on v5e)
 
 
 def _rotr(x: jnp.ndarray, r: int) -> jnp.ndarray:
@@ -106,25 +117,26 @@ def _compiled(batch: int, n_block_bucket: int, interpret: bool):
     kernel = functools.partial(
         _sha256_tile_kernel, n_block_bucket=n_block_bucket
     )
-    return jax.jit(
-        pl.pallas_call(
-            kernel,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (TILE, n_block_bucket, 16),
-                    lambda i: (i, 0, 0),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec((TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            ],
-            out_specs=pl.BlockSpec(
-                (TILE, 8), lambda i: (i, 0), memory_space=pltpu.VMEM
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (TILE, n_block_bucket, 16),
+                lambda i: (i, 0, 0),
+                memory_space=pltpu.VMEM,
             ),
-            out_shape=jax.ShapeDtypeStruct((batch, 8), jnp.uint32),
-            interpret=interpret,
-        )
+            pl.BlockSpec((TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE, 8), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((batch, 8), jnp.uint32),
+        interpret=interpret,
     )
+    # Off-TPU the interpreter runs eagerly: jitting it would trace the whole
+    # unrolled compression into one enormous HLO and compile for minutes.
+    return call if interpret else jax.jit(call)
 
 
 def sha256_batch_kernel_pallas(
