@@ -76,6 +76,67 @@ def warm_kernels():
     verifier.collect(verifier.dispatch(pubs, msgs, sigs))
 
 
+def run_fast_engine(
+    node_count,
+    client_count,
+    reqs_per_client,
+    batch_size,
+    signed=False,
+    device=True,
+    timeout=100_000_000,
+):
+    """One native-engine run (bit-identical twin of the Python engine; see
+    tests/test_fastengine.py).  Device crypto: Ed25519 verdicts come from
+    pipelined device waves before the run; wave-eligible hash content is
+    mirrored to the device asynchronously during the run and verified at
+    collect.  Returns the same result-dict shape as run_engine."""
+    from mirbft_tpu import metrics
+    from mirbft_tpu.testengine import Spec
+    from mirbft_tpu.testengine.fastengine import FastRecording
+
+    metrics.default_registry.reset()
+    spec = Spec(
+        node_count=node_count,
+        client_count=client_count,
+        reqs_per_client=reqs_per_client,
+        batch_size=batch_size,
+        signed_requests=signed,
+    )
+    # The timed window covers construction too: signed-request verification
+    # (device waves or host fallback) happens at FastRecording construction,
+    # and the Python engine pays the equivalent work inside its drain.
+    start = time.perf_counter()
+    recording = FastRecording(spec, device=device)
+    steps = recording.drain_clients(timeout=timeout)
+    elapsed = time.perf_counter() - start
+    by_seq = {}
+    for node in recording.nodes:
+        by_seq.setdefault(node.checkpoint_seq_no, set()).add(
+            node.checkpoint_hash
+        )
+    assert all(len(h) == 1 for h in by_seq.values()), "divergent state"
+    snap = metrics.snapshot()
+    unique = client_count * reqs_per_client
+    _, _, commit_ops = recording.stats()
+    return {
+        "wall_s": elapsed,
+        "steps": steps,
+        "unique": unique,
+        "unique_per_s": unique / elapsed,
+        "commit_ops": commit_ops,
+        "commit_ops_per_s": commit_ops / elapsed,
+        "host_crypto_s": recording.host_crypto_seconds(),
+        "device_wait_s": float(snap.get("device_wait_seconds", 0.0)),
+        # Same definition as the Python engine's: host crypto over wall.
+        "host_crypto_share": recording.host_crypto_seconds() / elapsed,
+        "hash_dispatches": int(snap.get("device_hash_dispatches", 0)),
+        "hash_msgs": int(snap.get("device_hashed_messages", 0)),
+        "verify_dispatches": int(snap.get("device_verify_dispatches", 0)),
+        "verify_sigs": int(snap.get("device_verified_signatures", 0)),
+        "recording": recording,
+    }
+
+
 def run_engine(
     node_count,
     client_count,
@@ -380,26 +441,62 @@ def main():
     except Exception:
         pass
 
+    # Configs 1-3 run on the NATIVE fast engine (a bit-identical twin of the
+    # Python engine — tests/test_fastengine.py pins the full evolution), with
+    # the Python engine's own runs reported alongside as `*py_*` so both
+    # implementations' numbers are on record.  On any FastEngineUnsupported
+    # the Python result doubles as the primary.
+    from mirbft_tpu.testengine.fastengine import FastEngineUnsupported
+
     # Config 1: 4-node green path (host crypto: batches too small to win on
     # a device; this is the latency-bound smoke config).
-    res = run_engine(4, 4, 500, 100, device=False)
-    put(detail, "c1_4n", res, engaged_keys=False)
+    res_py = run_engine(4, 4, 500, 100, device=False)
+    put(detail, "c1py_4n", res_py, engaged_keys=False)
+    try:
+        res = run_fast_engine(4, 4, 500, 100, device=False)
+        assert res["steps"] == detail["c1py_4n_sim_steps"], "engine divergence"
+        put(detail, "c1_4n", res, engaged_keys=False)
+    except FastEngineUnsupported as exc:
+        detail["c1_fast_unsupported"] = str(exc)[:120]
+        put(detail, "c1_4n", res_py, engaged_keys=False)
 
     # Config 2: 16-node, Ed25519-signed client requests, device crypto —
-    # plus the unsigned twin for the signing-cost ratio.
-    res_u = run_engine(16, 16, 50, 100, device=False)
+    # plus the unsigned twin for the signing-cost ratio (always computed
+    # within ONE engine so the ratio never conflates engine speeds).
+    res_py = run_engine(16, 16, 50, 100, signed=True, device=True)
+    put(detail, "c2py_16n_signed", res_py)
+    try:
+        res_u = run_fast_engine(16, 16, 50, 100, device=False)
+        res = run_fast_engine(16, 16, 50, 100, signed=True, device=True)
+        assert res["steps"] == detail["c2py_16n_signed_sim_steps"], "engine divergence"
+        put(detail, "c2_16n_signed", res)
+    except FastEngineUnsupported as exc:
+        detail["c2_fast_unsupported"] = str(exc)[:120]
+        res_u = run_engine(16, 16, 50, 100, device=False)
+        res = res_py
+        put(detail, "c2_16n_signed", res)
     detail["c2u_16n_unique_req_per_s"] = round(res_u["unique_per_s"], 1)
-    res = run_engine(16, 16, 50, 100, signed=True, device=True)
-    put(detail, "c2_16n_signed", res)
     detail["c2_signed_over_unsigned_slowdown"] = round(
         res_u["unique_per_s"] / res["unique_per_s"], 2
     )
 
     # Config 3 (north star): 64-replica stress, device crypto.
-    res = run_engine(64, 64, 100, 100, device=True)
-    put(detail, "c3_64n", res)
+    res_py = run_engine(64, 64, 100, 100, device=True)
+    put(detail, "c3py_64n", res_py)
+    try:
+        res = run_fast_engine(64, 64, 100, 100, device=True)
+        assert res["steps"] == detail["c3py_64n_sim_steps"], "engine divergence"
+        put(detail, "c3_64n", res)
+    except FastEngineUnsupported as exc:
+        detail["c3_fast_unsupported"] = str(exc)[:120]
+        res = res_py
+        put(detail, "c3_64n", res)
     headline = res["unique_per_s"]
     detail["c3_64n_commit_ops"] = res["commit_ops"]
+    if res is not res_py:
+        detail["c3_engine_speedup"] = round(
+            res_py["wall_s"] / max(res["wall_s"], 1e-9), 1
+        )
 
     # Configs 4 and 5 (BASELINE configs[3..4]).
     try:

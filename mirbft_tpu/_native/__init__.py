@@ -22,53 +22,82 @@ import sysconfig
 
 available = False
 core = None
+fast_available = False
+fast = None
 
 _HERE = os.path.dirname(__file__)
 _SRC = os.path.join(_HERE, "ackplane.cpp")
 _SO = os.path.join(_HERE, "_core.so")
+_FAST_SRC = os.path.join(_HERE, "fastengine.cpp")
+_FAST_SO = os.path.join(_HERE, "_fast.so")
 
 
-def _build() -> bool:
+def _build(src: str, so: str) -> bool:
     include = sysconfig.get_paths()["include"]
-    tmp = _SO + ".tmp"
+    tmp = so + ".tmp"
     cmd = [
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-        "-I", include, _SRC, "-o", tmp,
+        "-I", include, src, "-o", tmp,
     ]
     try:
         subprocess.run(
-            cmd, check=True, capture_output=True, timeout=120
+            cmd, check=True, capture_output=True, timeout=300
         )
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
         return False
-    os.replace(tmp, _SO)  # atomic: concurrent builders race benignly
+    os.replace(tmp, so)  # atomic: concurrent builders race benignly
     return True
+
+
+def _load_one(src: str, so: str, modname: str):
+    """Build (if stale) and import one extension; returns the module or None."""
+    try:
+        needs_build = (not os.path.exists(so)) or (
+            os.path.getmtime(src) > os.path.getmtime(so)
+        )
+    except OSError:
+        needs_build = True
+    if needs_build and not _build(src, so):
+        return None
+    import importlib
+
+    try:
+        return importlib.import_module(f"{__name__}.{modname}")
+    except ImportError:
+        # A stale ABI-incompatible artifact: rebuild once.
+        if not _build(src, so):
+            return None
+        try:
+            return importlib.import_module(f"{__name__}.{modname}")
+        except ImportError:
+            return None
 
 
 def _load() -> None:
     global available, core
     if os.environ.get("MIRBFT_TPU_NATIVE", "1") == "0":
         return
-    try:
-        needs_build = (not os.path.exists(_SO)) or (
-            os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-        )
-    except OSError:
-        needs_build = True
-    if needs_build and not _build():
-        return
-    try:
-        from . import _core as _core_mod  # type: ignore
-    except ImportError:
-        # A stale ABI-incompatible artifact: rebuild once.
-        if not _build():
-            return
-        try:
-            from . import _core as _core_mod  # type: ignore
-        except ImportError:
-            return
-    core = _core_mod
-    available = True
+    core = _load_one(_SRC, _SO, "_core")
+    available = core is not None
+
+
+_fast_attempted = False
+
+
+def load_fast():
+    """Build/load the fast-engine extension on first use (lazy: a cold
+    compile of fastengine.cpp takes ~35 s, which plain package importers —
+    tests of unrelated modules, the graft entry compile check — should not
+    pay).  Returns the module or None."""
+    global fast, fast_available, _fast_attempted
+    if _fast_attempted:
+        return fast
+    _fast_attempted = True
+    if os.environ.get("MIRBFT_TPU_NATIVE", "1") == "0":
+        return None
+    fast = _load_one(_FAST_SRC, _FAST_SO, "_fast")
+    fast_available = fast is not None
+    return fast
 
 
 _load()

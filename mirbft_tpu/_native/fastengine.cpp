@@ -1,0 +1,5551 @@
+// Native fast-path cluster engine: a C++ twin of the Python testengine
+// (mirbft_tpu/testengine/{recorder,queue}.py + the state machine under
+// mirbft_tpu/statemachine/) for the protocol's green envelope.
+//
+// Purpose (docs/PERFORMANCE.md §5 "Roadmap to 100k", step 1): the simulated
+// 64-replica cluster is host-bound in the Python interpreter at ~44 us per
+// replica-request with no single hot loop left.  This engine moves the WHOLE
+// steady-state simulation — event queue, scheduling, and every green-path
+// protocol component — into C++, leaving Python in charge of configuration,
+// device-crypto waves, and everything outside the envelope.
+//
+// Equivalence contract (enforced by tests/test_fastengine.py):
+//   The engine is a BIT-IDENTICAL twin of the Python engine on supported
+//   configs: same simulation step counts, same fake-time, same per-node app
+//   hash chains, same checkpoint sequence/values, same epoch numbers, same
+//   committed-request maps.  Every method below is a faithful transcription
+//   of its Python counterpart (cited by file/class); any divergence is a bug.
+//
+// Supported envelope (outside it, construction or stepping raises
+// RuntimeError and callers fall back to the Python engine):
+//   * <= 64 nodes (single-word replica bitmasks), dense ids 0..n-1
+//   * no manglers, no reconfigurations, no state transfer, no restarts
+//   * signed-request mode via precomputed verdicts (the device auth plane
+//     verifies envelopes; the engine consumes the verdict bitmap)
+//
+// Device crypto: protocol digests are SHA-256 over the same bytes either
+// way, so the engine hashes inline (host) and mirrors every wave-eligible
+// message (same rule as testengine/crypto.py::_host_fast's complement) into
+// a wave log; the Python wrapper dispatches those waves to the TPU hasher
+// asynchronously during the run and verifies the device digests match.
+//
+// CPython C API only (no pybind11 in the image) — same build scheme as
+// ackplane.cpp.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <x86intrin.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <optional>
+#include <queue>
+#include <set>
+#include <stdexcept>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+using std::deque;
+using std::map;
+using std::set;
+using std::shared_ptr;
+using std::string;
+using std::vector;
+using i32 = int32_t;
+using i64 = int64_t;
+using u8 = uint8_t;
+using u32 = uint32_t;
+using u64 = uint64_t;
+
+// Process-wide profiling counters (0 ackbatch, 1 votes, 2 fixpoint,
+// 3 coalesce): cumulative across all engines — never dangle, safe under
+// concurrent engines (relaxed atomics; profiling only).
+std::atomic<u64> g_parts[6] = {};
+
+struct EngineError : std::runtime_error {
+    explicit EngineError(const string &what) : std::runtime_error(what) {}
+};
+
+// ---------------------------------------------------------------------------
+// SHA-256 (streaming; standard FIPS 180-4 implementation).
+// ---------------------------------------------------------------------------
+
+struct Sha256 {
+    u32 h[8];
+    u64 len = 0;
+    u8 buf[64];
+    size_t buflen = 0;
+
+    Sha256() { reset(); }
+
+    void reset() {
+        static const u32 iv[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                  0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                  0x1f83d9ab, 0x5be0cd19};
+        std::memcpy(h, iv, sizeof(iv));
+        len = 0;
+        buflen = 0;
+    }
+
+    static u32 rotr(u32 x, int n) { return (x >> n) | (x << (32 - n)); }
+
+    void block(const u8 *p) {
+        static const u32 K[64] = {
+            0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+            0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+            0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+            0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+            0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+            0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+            0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+            0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+            0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+            0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+            0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+            0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+            0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+        u32 w[64];
+        for (int i = 0; i < 16; i++)
+            w[i] = (u32(p[i * 4]) << 24) | (u32(p[i * 4 + 1]) << 16) |
+                   (u32(p[i * 4 + 2]) << 8) | u32(p[i * 4 + 3]);
+        for (int i = 16; i < 64; i++) {
+            u32 s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+            u32 s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+            w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+        }
+        u32 a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+            g = h[6], hh = h[7];
+        for (int i = 0; i < 64; i++) {
+            u32 S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+            u32 ch = (e & f) ^ (~e & g);
+            u32 t1 = hh + S1 + ch + K[i] + w[i];
+            u32 S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+            u32 maj = (a & b) ^ (a & c) ^ (b & c);
+            u32 t2 = S0 + maj;
+            hh = g; g = f; f = e; e = d + t1;
+            d = c; c = b; b = a; a = t1 + t2;
+        }
+        h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+        h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+    }
+
+    void update(const void *data, size_t n) {
+        const u8 *p = (const u8 *)data;
+        len += n;
+        if (buflen) {
+            size_t take = std::min(n, 64 - buflen);
+            std::memcpy(buf + buflen, p, take);
+            buflen += take;
+            p += take;
+            n -= take;
+            if (buflen == 64) {
+                block(buf);
+                buflen = 0;
+            }
+        }
+        while (n >= 64) {
+            block(p);
+            p += 64;
+            n -= 64;
+        }
+        if (n) {
+            std::memcpy(buf, p, n);
+            buflen = n;
+        }
+    }
+
+    void update(const string &s) { update(s.data(), s.size()); }
+
+    // Non-destructive finalize (Python hashlib .digest() semantics).
+    string digest() const {
+        Sha256 c = *this;
+        u64 bits = c.len * 8;
+        u8 pad = 0x80;
+        c.update(&pad, 1);
+        u8 zero = 0;
+        while (c.buflen != 56) c.update(&zero, 1);
+        u8 lb[8];
+        for (int i = 0; i < 8; i++) lb[i] = (u8)(bits >> (56 - 8 * i));
+        c.update(lb, 8);
+        string out(32, '\0');
+        for (int i = 0; i < 8; i++) {
+            out[i * 4] = (char)(c.h[i] >> 24);
+            out[i * 4 + 1] = (char)(c.h[i] >> 16);
+            out[i * 4 + 2] = (char)(c.h[i] >> 8);
+            out[i * 4 + 3] = (char)(c.h[i]);
+        }
+        return out;
+    }
+};
+
+string sha256(const string &data) {
+    Sha256 h;
+    h.update(data);
+    return h.digest();
+}
+
+// ---------------------------------------------------------------------------
+// Byte-string interner: digests / checkpoint values / payloads by id.
+// id 0 is always the empty string (the null digest).
+// ---------------------------------------------------------------------------
+
+struct Interner {
+    vector<string> vals;
+    std::unordered_map<string, i32> ids;
+
+    Interner() { vals.push_back(string()); ids.emplace(string(), 0); }
+
+    i32 put(const string &s) {
+        auto it = ids.find(s);
+        if (it != ids.end()) return it->second;
+        i32 id = (i32)vals.size();
+        vals.push_back(s);
+        ids.emplace(s, id);
+        return id;
+    }
+
+    const string &get(i32 id) const { return vals[(size_t)id]; }
+};
+
+// ---------------------------------------------------------------------------
+// Schema structs (mirbft_tpu/messages.py).  Digests and opaque byte values
+// are interner ids.
+// ---------------------------------------------------------------------------
+
+struct AckS {
+    i64 client;
+    i64 reqno;
+    i32 dig;
+    bool operator==(const AckS &o) const {
+        return client == o.client && reqno == o.reqno && dig == o.dig;
+    }
+    bool operator<(const AckS &o) const {
+        if (client != o.client) return client < o.client;
+        if (reqno != o.reqno) return reqno < o.reqno;
+        return dig < o.dig;
+    }
+};
+
+struct AckHash {
+    size_t operator()(const AckS &a) const {
+        u64 x = (u64)a.client * 0x9e3779b97f4a7c15ULL;
+        x ^= (u64)a.reqno + 0x9e3779b97f4a7c15ULL + (x << 6) + (x >> 2);
+        x ^= (u64)(u32)a.dig + (x << 6) + (x >> 2);
+        return (size_t)x;
+    }
+};
+
+struct ClientStateS {
+    i64 id, width, wclc, lw;
+    string mask;
+    bool operator==(const ClientStateS &o) const {
+        return id == o.id && width == o.width && wclc == o.wclc &&
+               lw == o.lw && mask == o.mask;
+    }
+};
+
+struct NetConfigS {
+    vector<i32> nodes;
+    i64 ci, mel, nb, f;
+};
+
+struct NetStateS {
+    // config is engine-global (no reconfiguration in the envelope);
+    // pending_reconfigurations always empty.
+    vector<ClientStateS> clients;
+};
+using NetStateP = shared_ptr<const NetStateS>;
+
+struct EpochCfgS {
+    i64 number;
+    vector<i32> leaders;
+    i64 planned_expiration;
+    bool operator==(const EpochCfgS &o) const {
+        return number == o.number && leaders == o.leaders &&
+               planned_expiration == o.planned_expiration;
+    }
+};
+
+struct NewEpochCfgS {
+    EpochCfgS config;
+    i64 cp_seq;
+    i32 cp_value;
+    vector<i32> final_preprepares;
+    bool operator==(const NewEpochCfgS &o) const {
+        return config == o.config && cp_seq == o.cp_seq &&
+               cp_value == o.cp_value &&
+               final_preprepares == o.final_preprepares;
+    }
+};
+using NewEpochCfgP = shared_ptr<const NewEpochCfgS>;
+
+struct ECSetEntryS {
+    i64 epoch, seq;
+    i32 dig;
+};
+
+struct EpochChangeS {
+    i64 new_epoch;
+    vector<std::pair<i64, i32>> checkpoints;  // (seq_no, value id)
+    vector<ECSetEntryS> p_set, q_set;
+};
+using EpochChangeP = shared_ptr<const EpochChangeS>;
+
+enum class MT : u8 {
+    Preprepare, Prepare, Commit, Checkpoint, Suspect,
+    EpochChange, EpochChangeAck, NewEpoch, NewEpochEcho, NewEpochReady,
+    FetchBatch, ForwardBatch, FetchRequest, AckMsg, AckBatch, MsgBatch,
+};
+
+struct MsgS;
+using MsgP = shared_ptr<const MsgS>;
+
+struct MsgS {
+    MT t;
+    i64 seq = 0, epoch = 0;
+    i32 dig = 0;              // Prepare/Commit digest, Checkpoint value, Fetch*/Forward* digest
+    vector<AckS> acks;        // Preprepare batch / AckBatch / ForwardBatch; AckMsg+FetchRequest use acks[0]
+    EpochChangeP ec;          // EpochChange / EpochChangeAck
+    i32 originator = 0;       // EpochChangeAck
+    NewEpochCfgP necfg;       // NewEpoch / Echo / Ready
+    vector<std::pair<i32, i32>> remote_changes;  // NewEpoch (node_id, digest)
+    vector<MsgP> inner;       // MsgBatch
+    mutable i64 wire_size_cache = -1;
+};
+
+// QEntry / PEntry and the persisted-entry union (messages.py Persistent).
+struct QEntryS {
+    i64 seq;
+    i32 dig;
+    vector<AckS> reqs;
+};
+using QEntryP = shared_ptr<const QEntryS>;
+
+enum class PET : u8 { Q, P, C, N, F, EC, Suspect };
+
+struct PersistEntS {
+    PET t;
+    QEntryP q;                 // Q
+    i64 seq = 0;               // P / C / N
+    i32 dig = 0;               // P digest / C value
+    NetStateP netstate;        // C
+    EpochCfgS epoch_config;    // N / F
+    i64 num = 0;               // EC epoch_number / Suspect epoch
+};
+using PersistEntP = shared_ptr<const PersistEntS>;
+
+// ---------------------------------------------------------------------------
+// Wire codec (encode only) — must match mirbft_tpu/wire.py byte-for-byte:
+// uvarint tags from _REGISTRY_ORDER, fields in dataclass declaration order.
+// Used for (a) checkpoint snapshot values, which embed
+// wire.encode(NetworkState), and (b) msg_size() buffer accounting.
+// ---------------------------------------------------------------------------
+
+enum WireTag : u32 {
+    TAG_NetworkConfig = 0, TAG_ClientState = 1, TAG_NetworkState = 5,
+    TAG_RequestAck = 6, TAG_EpochConfig = 8, TAG_CheckpointMsg = 9,
+    TAG_EpochChangeSetEntry = 10, TAG_EpochChange = 11,
+    TAG_EpochChangeAck = 12, TAG_NewEpochConfig = 13,
+    TAG_RemoteEpochChange = 14, TAG_NewEpoch = 15, TAG_Preprepare = 16,
+    TAG_Prepare = 17, TAG_Commit = 18, TAG_Suspect = 19,
+    TAG_NewEpochEcho = 20, TAG_NewEpochReady = 21, TAG_FetchBatch = 22,
+    TAG_ForwardBatch = 23, TAG_FetchRequest = 24, TAG_AckMsg = 26,
+    TAG_AckBatch = 60, TAG_MsgBatch = 61,
+};
+
+void enc_uv(string &buf, u64 v) {
+    while (true) {
+        u8 b = v & 0x7f;
+        v >>= 7;
+        if (v) buf.push_back((char)(b | 0x80));
+        else { buf.push_back((char)b); return; }
+    }
+}
+
+void enc_bytes(string &buf, const string &s) {
+    enc_uv(buf, s.size());
+    buf.append(s);
+}
+
+struct Wire {
+    const Interner *in;
+
+    void net_config(string &buf, const NetConfigS &c) const {
+        enc_uv(buf, TAG_NetworkConfig);
+        enc_uv(buf, c.nodes.size());
+        for (i32 n : c.nodes) enc_uv(buf, (u64)n);
+        enc_uv(buf, (u64)c.ci);
+        enc_uv(buf, (u64)c.mel);
+        enc_uv(buf, (u64)c.nb);
+        enc_uv(buf, (u64)c.f);
+    }
+
+    void client_state(string &buf, const ClientStateS &c) const {
+        enc_uv(buf, TAG_ClientState);
+        enc_uv(buf, (u64)c.id);
+        enc_uv(buf, (u64)c.width);
+        enc_uv(buf, (u64)c.wclc);
+        enc_uv(buf, (u64)c.lw);
+        enc_bytes(buf, c.mask);
+    }
+
+    void net_state(string &buf, const NetConfigS &cfg, const NetStateS &s) const {
+        enc_uv(buf, TAG_NetworkState);
+        net_config(buf, cfg);
+        enc_uv(buf, s.clients.size());
+        for (const auto &c : s.clients) client_state(buf, c);
+        enc_uv(buf, 0);  // pending_reconfigurations: always empty here
+    }
+
+    void ack(string &buf, const AckS &a) const {
+        enc_uv(buf, TAG_RequestAck);
+        enc_uv(buf, (u64)a.client);
+        enc_uv(buf, (u64)a.reqno);
+        enc_bytes(buf, in->get(a.dig));
+    }
+
+    void epoch_config(string &buf, const EpochCfgS &c) const {
+        enc_uv(buf, TAG_EpochConfig);
+        enc_uv(buf, (u64)c.number);
+        enc_uv(buf, c.leaders.size());
+        for (i32 n : c.leaders) enc_uv(buf, (u64)n);
+        enc_uv(buf, (u64)c.planned_expiration);
+    }
+
+    void checkpoint_msg(string &buf, i64 seq, i32 value) const {
+        enc_uv(buf, TAG_CheckpointMsg);
+        enc_uv(buf, (u64)seq);
+        enc_bytes(buf, in->get(value));
+    }
+
+    void ec_set_entry(string &buf, const ECSetEntryS &e) const {
+        enc_uv(buf, TAG_EpochChangeSetEntry);
+        enc_uv(buf, (u64)e.epoch);
+        enc_uv(buf, (u64)e.seq);
+        enc_bytes(buf, in->get(e.dig));
+    }
+
+    void epoch_change(string &buf, const EpochChangeS &e) const {
+        enc_uv(buf, TAG_EpochChange);
+        enc_uv(buf, (u64)e.new_epoch);
+        enc_uv(buf, e.checkpoints.size());
+        for (const auto &cp : e.checkpoints) checkpoint_msg(buf, cp.first, cp.second);
+        enc_uv(buf, e.p_set.size());
+        for (const auto &p : e.p_set) ec_set_entry(buf, p);
+        enc_uv(buf, e.q_set.size());
+        for (const auto &q : e.q_set) ec_set_entry(buf, q);
+    }
+
+    void new_epoch_config(string &buf, const NewEpochCfgS &c) const {
+        enc_uv(buf, TAG_NewEpochConfig);
+        epoch_config(buf, c.config);
+        checkpoint_msg(buf, c.cp_seq, c.cp_value);
+        enc_uv(buf, c.final_preprepares.size());
+        for (i32 d : c.final_preprepares) enc_bytes(buf, in->get(d));
+    }
+
+    void msg(string &buf, const MsgS &m) const {
+        switch (m.t) {
+            case MT::Preprepare:
+                enc_uv(buf, TAG_Preprepare);
+                enc_uv(buf, (u64)m.seq);
+                enc_uv(buf, (u64)m.epoch);
+                enc_uv(buf, m.acks.size());
+                for (const auto &a : m.acks) ack(buf, a);
+                break;
+            case MT::Prepare:
+            case MT::Commit:
+                enc_uv(buf, m.t == MT::Prepare ? TAG_Prepare : TAG_Commit);
+                enc_uv(buf, (u64)m.seq);
+                enc_uv(buf, (u64)m.epoch);
+                enc_bytes(buf, in->get(m.dig));
+                break;
+            case MT::Checkpoint:
+                checkpoint_msg(buf, m.seq, m.dig);
+                break;
+            case MT::Suspect:
+                enc_uv(buf, TAG_Suspect);
+                enc_uv(buf, (u64)m.epoch);
+                break;
+            case MT::EpochChange:
+                epoch_change(buf, *m.ec);
+                break;
+            case MT::EpochChangeAck:
+                enc_uv(buf, TAG_EpochChangeAck);
+                enc_uv(buf, (u64)m.originator);
+                epoch_change(buf, *m.ec);
+                break;
+            case MT::NewEpoch:
+                enc_uv(buf, TAG_NewEpoch);
+                new_epoch_config(buf, *m.necfg);
+                enc_uv(buf, m.remote_changes.size());
+                for (const auto &rc : m.remote_changes) {
+                    enc_uv(buf, TAG_RemoteEpochChange);
+                    enc_uv(buf, (u64)rc.first);
+                    enc_bytes(buf, in->get(rc.second));
+                }
+                break;
+            case MT::NewEpochEcho:
+            case MT::NewEpochReady:
+                enc_uv(buf, m.t == MT::NewEpochEcho ? TAG_NewEpochEcho
+                                                    : TAG_NewEpochReady);
+                new_epoch_config(buf, *m.necfg);
+                break;
+            case MT::FetchBatch:
+                enc_uv(buf, TAG_FetchBatch);
+                enc_uv(buf, (u64)m.seq);
+                enc_bytes(buf, in->get(m.dig));
+                break;
+            case MT::ForwardBatch:
+                enc_uv(buf, TAG_ForwardBatch);
+                enc_uv(buf, (u64)m.seq);
+                enc_uv(buf, m.acks.size());
+                for (const auto &a : m.acks) ack(buf, a);
+                enc_bytes(buf, in->get(m.dig));
+                break;
+            case MT::FetchRequest:
+                enc_uv(buf, TAG_FetchRequest);
+                ack(buf, m.acks[0]);
+                break;
+            case MT::AckMsg:
+                enc_uv(buf, TAG_AckMsg);
+                ack(buf, m.acks[0]);
+                break;
+            case MT::AckBatch:
+                enc_uv(buf, TAG_AckBatch);
+                enc_uv(buf, m.acks.size());
+                for (const auto &a : m.acks) ack(buf, a);
+                break;
+            case MT::MsgBatch:
+                enc_uv(buf, TAG_MsgBatch);
+                enc_uv(buf, m.inner.size());
+                for (const auto &im : m.inner) msg(buf, *im);
+                break;
+        }
+    }
+
+    i64 msg_size(const MsgS &m) const {
+        if (m.wire_size_cache >= 0) return m.wire_size_cache;
+        string buf;
+        msg(buf, m);
+        m.wire_size_cache = (i64)buf.size();
+        return m.wire_size_cache;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Hash origins, actions, events (mirbft_tpu/state.py).
+// ---------------------------------------------------------------------------
+
+enum class OT : u8 { Batch, EpochChange, VerifyBatch };
+
+struct HashOriginS {
+    OT t;
+    i32 source = 0;
+    i64 epoch = 0;   // Batch
+    i64 seq = 0;     // Batch / VerifyBatch
+    vector<AckS> request_acks;  // Batch / VerifyBatch
+    i32 origin = 0;             // EpochChange: originating node
+    EpochChangeP ec;            // EpochChange
+    i32 expected_digest = 0;    // VerifyBatch
+};
+
+struct HashReqS {
+    vector<string> parts;
+    HashOriginS origin;
+};
+using HashReqP = shared_ptr<const HashReqS>;
+
+enum class AT : u8 {
+    Send, Hash, Persist, Truncate, Commit, Checkpoint,
+    AllocatedRequest, CorrectRequest, ForwardRequest, StateApplied,
+};
+
+struct ActionS {
+    AT t;
+    vector<i32> targets;        // Send / ForwardRequest
+    MsgP msg;                   // Send
+    HashReqP hash;              // Hash
+    i64 index = 0;              // Persist / Truncate
+    PersistEntP entry;          // Persist
+    QEntryP qentry;             // Commit
+    i64 seq = 0;                // Checkpoint / StateApplied
+    shared_ptr<const vector<ClientStateS>> cstates;  // Checkpoint
+    i64 client = 0, reqno = 0;  // AllocatedRequest
+    AckS ack{0, 0, 0};          // CorrectRequest / ForwardRequest
+    NetStateP netstate;         // StateApplied
+};
+
+using Actions = vector<ActionS>;
+
+enum class ET : u8 {
+    InitialParameters, LoadPersistedEntry, LoadCompleted,
+    HashResult, CheckpointResult, RequestPersisted,
+    Step, TickElapsed, ActionsReceived,
+};
+
+struct EventS {
+    ET t;
+    i64 index = 0;              // LoadPersistedEntry
+    PersistEntP entry;          // LoadPersistedEntry
+    i32 digest = 0;             // HashResult
+    shared_ptr<const HashOriginS> origin;  // HashResult
+    i64 seq = 0;                // CheckpointResult
+    i32 value = 0;              // CheckpointResult
+    NetStateP netstate;         // CheckpointResult
+    bool reconfigured = false;  // CheckpointResult
+    AckS ack{0, 0, 0};          // RequestPersisted
+    i32 source = 0;             // Step
+    MsgP msg;                   // Step
+};
+
+using Events = vector<EventS>;
+
+// ---------------------------------------------------------------------------
+// Simulation event queue (testengine/queue.py; no mangler in the envelope).
+// ---------------------------------------------------------------------------
+
+enum class SK : u8 {
+    Initialize, MsgReceived, ClientProposal, Tick,
+    ProcessWal, ProcessNet, ProcessHash, ProcessClient, ProcessApp,
+    ProcessReqStore, ProcessResult,
+};
+
+struct SimEv {
+    i64 time;
+    i64 ctr;
+    SK kind;
+    i32 target;
+    i32 src = 0;
+    MsgP msg;
+    i64 client = 0, reqno = 0;
+    i32 data = 0;                        // payload interner id (proposal)
+    shared_ptr<Actions> actions;         // Process{Wal,Net,Hash,Client,App}
+    shared_ptr<Events> events;           // Process{ReqStore,Result}
+};
+
+struct SimEvCmp {
+    bool operator()(const SimEv &a, const SimEv &b) const {
+        if (a.time != b.time) return a.time > b.time;
+        return a.ctr > b.ctr;
+    }
+};
+
+struct EventQueue {
+    vector<SimEv> heap;
+    i64 counter = 0;
+    i64 fake_time = 0;
+
+    size_t size() const { return heap.size(); }
+
+    void insert(SimEv ev) {
+        if (ev.time < fake_time) throw EngineError("attempted to modify the past");
+        ev.ctr = counter++;
+        heap.push_back(std::move(ev));
+        std::push_heap(heap.begin(), heap.end(), SimEvCmp());
+    }
+
+    SimEv consume() {
+        if (heap.empty())
+            throw EngineError("event queue drained to empty");
+        std::pop_heap(heap.begin(), heap.end(), SimEvCmp());
+        SimEv ev = std::move(heap.back());
+        heap.pop_back();
+        fake_time = ev.time;
+        return ev;
+    }
+
+    void remove_events_for(i32 target) {
+        heap.erase(std::remove_if(heap.begin(), heap.end(),
+                                  [target](const SimEv &e) {
+                                      return e.target == target;
+                                  }),
+                   heap.end());
+        std::make_heap(heap.begin(), heap.end(), SimEvCmp());
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Quorums / bucket math (statemachine/stateless.py).
+// ---------------------------------------------------------------------------
+
+struct Quorums {
+    i64 n, f;
+    i64 iq() const { return (n + f + 2) / 2; }
+    i64 wq() const { return f + 1; }
+};
+
+// is_committed (stateless.py:100): MSB-first committed mask, exact-width window.
+bool is_committed(i64 req_no, const ClientStateS &cs) {
+    i64 offset = req_no - cs.lw;
+    if (offset < 0) return true;
+    if (offset >= cs.width) return false;
+    size_t byte_index = (size_t)(offset >> 3);
+    if (byte_index >= cs.mask.size()) return false;
+    return (u8(cs.mask[byte_index]) & (0x80u >> (offset & 7))) != 0;
+}
+
+string u64be(u64 v) {
+    string s(8, '\0');
+    for (int i = 0; i < 8; i++) s[i] = (char)(v >> (56 - 8 * i));
+    return s;
+}
+
+// Flatten an EpochChange into its canonical hash parts
+// (stateless.py epoch_change_hash_data).
+vector<string> ec_hash_data(const Interner &in, const EpochChangeS &ec) {
+    vector<string> out;
+    out.push_back(u64be((u64)ec.new_epoch));
+    for (const auto &cp : ec.checkpoints) {
+        out.push_back(u64be((u64)cp.first));
+        out.push_back(in.get(cp.second));
+    }
+    for (const auto &e : ec.p_set) {
+        out.push_back(u64be((u64)e.epoch));
+        out.push_back(u64be((u64)e.seq));
+        out.push_back(in.get(e.dig));
+    }
+    for (const auto &e : ec.q_set) {
+        out.push_back(u64be((u64)e.epoch));
+        out.push_back(u64be((u64)e.seq));
+        out.push_back(in.get(e.dig));
+    }
+    return out;
+}
+
+string join_with_lengths(const vector<string> &parts) {
+    string key;
+    for (const auto &p : parts) {
+        enc_uv(key, p.size());
+        key.append(p);
+    }
+    return key;
+}
+
+// ---------------------------------------------------------------------------
+// Shared engine context.
+// ---------------------------------------------------------------------------
+
+struct InitParms {
+    i32 id;
+    i64 batch_size, heartbeat_ticks, suspect_ticks, new_epoch_timeout_ticks,
+        buffer_size;
+};
+
+struct Ctx {
+    Interner intern;
+    Wire wire{nullptr};
+    NetConfigS cfg;
+    vector<ClientStateS> init_clients;
+    i64 iq, wq;
+
+    void finish_init() {
+        wire.in = &intern;
+        Quorums q{(i64)cfg.nodes.size(), cfg.f};
+        iq = q.iq();
+        wq = q.wq();
+    }
+};
+
+// Action builder helpers (statemachine/actions.py fluent constructors).
+ActionS act_send(vector<i32> targets, MsgP msg) {
+    ActionS a; a.t = AT::Send; a.targets = std::move(targets); a.msg = std::move(msg); return a;
+}
+ActionS act_hash(vector<string> parts, HashOriginS origin) {
+    ActionS a; a.t = AT::Hash;
+    auto hr = std::make_shared<HashReqS>();
+    hr->parts = std::move(parts);
+    hr->origin = std::move(origin);
+    a.hash = hr; return a;
+}
+ActionS act_persist(i64 index, PersistEntP entry) {
+    ActionS a; a.t = AT::Persist; a.index = index; a.entry = std::move(entry); return a;
+}
+ActionS act_truncate(i64 index) {
+    ActionS a; a.t = AT::Truncate; a.index = index; return a;
+}
+ActionS act_commit(QEntryP q) {
+    ActionS a; a.t = AT::Commit; a.qentry = std::move(q); return a;
+}
+ActionS act_checkpoint(i64 seq, shared_ptr<const vector<ClientStateS>> cs) {
+    ActionS a; a.t = AT::Checkpoint; a.seq = seq; a.cstates = std::move(cs); return a;
+}
+ActionS act_allocate(i64 client, i64 reqno) {
+    ActionS a; a.t = AT::AllocatedRequest; a.client = client; a.reqno = reqno; return a;
+}
+ActionS act_correct(AckS ack) {
+    ActionS a; a.t = AT::CorrectRequest; a.ack = ack; return a;
+}
+ActionS act_forward(vector<i32> targets, AckS ack) {
+    ActionS a; a.t = AT::ForwardRequest; a.targets = std::move(targets); a.ack = ack; return a;
+}
+ActionS act_state_applied(i64 seq, NetStateP ns) {
+    ActionS a; a.t = AT::StateApplied; a.seq = seq; a.netstate = std::move(ns); return a;
+}
+
+void concat(Actions &into, Actions &&from) {
+    for (auto &a : from) into.push_back(std::move(a));
+}
+
+vector<i32> mask_to_nodes(u64 mask) {
+    vector<i32> out;
+    for (i32 i = 0; i < 64; i++)
+        if ((mask >> i) & 1) out.push_back(i);
+    return out;
+}
+
+// Message constructors.
+MsgP mk_prepare(i64 seq, i64 epoch, i32 dig) {
+    auto m = std::make_shared<MsgS>(); m->t = MT::Prepare; m->seq = seq;
+    m->epoch = epoch; m->dig = dig; return m;
+}
+MsgP mk_commit(i64 seq, i64 epoch, i32 dig) {
+    auto m = std::make_shared<MsgS>(); m->t = MT::Commit; m->seq = seq;
+    m->epoch = epoch; m->dig = dig; return m;
+}
+MsgP mk_preprepare(i64 seq, i64 epoch, vector<AckS> batch) {
+    auto m = std::make_shared<MsgS>(); m->t = MT::Preprepare; m->seq = seq;
+    m->epoch = epoch; m->acks = std::move(batch); return m;
+}
+MsgP mk_ack_msg(AckS a) {
+    auto m = std::make_shared<MsgS>(); m->t = MT::AckMsg; m->acks.push_back(a);
+    return m;
+}
+MsgP mk_ack_batch(vector<AckS> acks) {
+    auto m = std::make_shared<MsgS>(); m->t = MT::AckBatch;
+    m->acks = std::move(acks); return m;
+}
+MsgP mk_checkpoint_msg(i64 seq, i32 value) {
+    auto m = std::make_shared<MsgS>(); m->t = MT::Checkpoint; m->seq = seq;
+    m->dig = value; return m;
+}
+MsgP mk_suspect(i64 epoch) {
+    auto m = std::make_shared<MsgS>(); m->t = MT::Suspect; m->epoch = epoch;
+    return m;
+}
+MsgP mk_fetch_request(AckS a) {
+    auto m = std::make_shared<MsgS>(); m->t = MT::FetchRequest;
+    m->acks.push_back(a); return m;
+}
+MsgP mk_fetch_batch(i64 seq, i32 dig) {
+    auto m = std::make_shared<MsgS>(); m->t = MT::FetchBatch; m->seq = seq;
+    m->dig = dig; return m;
+}
+MsgP mk_forward_batch(i64 seq, vector<AckS> acks, i32 dig) {
+    auto m = std::make_shared<MsgS>(); m->t = MT::ForwardBatch; m->seq = seq;
+    m->acks = std::move(acks); m->dig = dig; return m;
+}
+
+// ---------------------------------------------------------------------------
+// Persisted log (statemachine/persisted.py).
+// ---------------------------------------------------------------------------
+
+struct PersistedLog {
+    i64 next_index = 0;
+    vector<std::pair<i64, PersistEntP>> entries;
+
+    void append_initial_load(i64 index, PersistEntP entry) {
+        if (!entries.empty()) {
+            if (next_index != index)
+                throw EngineError("WAL indexes out of order");
+        } else {
+            next_index = index;
+        }
+        entries.emplace_back(index, std::move(entry));
+        next_index = index + 1;
+    }
+
+    Actions append(PersistEntP entry) {
+        if (entries.empty())
+            throw EngineError("appending to an unseeded log");
+        i64 index = next_index;
+        entries.emplace_back(index, entry);
+        next_index += 1;
+        Actions a;
+        a.push_back(act_persist(index, std::move(entry)));
+        return a;
+    }
+
+    Actions truncate(i64 low_watermark) {
+        for (size_t pos = 0; pos < entries.size(); pos++) {
+            const auto &e = *entries[pos].second;
+            if (e.t == PET::C) {
+                if (e.seq < low_watermark) continue;
+            } else if (e.t == PET::N) {
+                if (e.seq <= low_watermark) continue;
+            } else {
+                continue;
+            }
+            if (pos == 0) break;
+            i64 index = entries[pos].first;
+            entries.erase(entries.begin(), entries.begin() + (std::ptrdiff_t)pos);
+            Actions a;
+            a.push_back(act_truncate(index));
+            return a;
+        }
+        return Actions();
+    }
+
+    EpochChangeP construct_epoch_change(i64 new_epoch) const {
+        // Pass 1: count PEntries per sequence so only the last one is kept.
+        std::unordered_map<i64, i64> p_counts;
+        bool have_epoch = false;
+        i64 log_epoch = 0;
+        for (const auto &pr : entries) {
+            if (have_epoch && log_epoch >= new_epoch) break;
+            const auto &e = *pr.second;
+            if (e.t == PET::P) {
+                p_counts[e.seq] += 1;
+            } else if (e.t == PET::N) {
+                log_epoch = e.epoch_config.number;
+                have_epoch = true;
+            } else if (e.t == PET::F) {
+                log_epoch = e.epoch_config.number;
+                have_epoch = true;
+            }
+        }
+        auto ec = std::make_shared<EpochChangeS>();
+        ec->new_epoch = new_epoch;
+        have_epoch = false;
+        log_epoch = 0;
+        for (const auto &pr : entries) {
+            if (have_epoch && log_epoch >= new_epoch) break;
+            const auto &e = *pr.second;
+            if (e.t == PET::P) {
+                i64 &remaining = p_counts[e.seq];
+                if (remaining != 1) {
+                    remaining -= 1;
+                    continue;
+                }
+                ec->p_set.push_back(ECSetEntryS{log_epoch, e.seq, e.dig});
+            } else if (e.t == PET::Q) {
+                ec->q_set.push_back(
+                    ECSetEntryS{log_epoch, e.q->seq, e.q->dig});
+            } else if (e.t == PET::N || e.t == PET::F) {
+                log_epoch = e.epoch_config.number;
+                have_epoch = true;
+            } else if (e.t == PET::C) {
+                ec->checkpoints.emplace_back(e.seq, e.dig);
+            }
+        }
+        return ec;
+    }
+};
+
+PersistEntP pe_q(QEntryP q) {
+    auto e = std::make_shared<PersistEntS>(); e->t = PET::Q; e->q = std::move(q); return e;
+}
+PersistEntP pe_p(i64 seq, i32 dig) {
+    auto e = std::make_shared<PersistEntS>(); e->t = PET::P; e->seq = seq; e->dig = dig; return e;
+}
+PersistEntP pe_c(i64 seq, i32 value, NetStateP ns) {
+    auto e = std::make_shared<PersistEntS>(); e->t = PET::C; e->seq = seq;
+    e->dig = value; e->netstate = std::move(ns); return e;
+}
+PersistEntP pe_n(i64 seq, EpochCfgS cfg) {
+    auto e = std::make_shared<PersistEntS>(); e->t = PET::N; e->seq = seq;
+    e->epoch_config = std::move(cfg); return e;
+}
+PersistEntP pe_f(EpochCfgS cfg) {
+    auto e = std::make_shared<PersistEntS>(); e->t = PET::F;
+    e->epoch_config = std::move(cfg); return e;
+}
+PersistEntP pe_ec(i64 num) {
+    auto e = std::make_shared<PersistEntS>(); e->t = PET::EC; e->num = num; return e;
+}
+PersistEntP pe_suspect(i64 epoch) {
+    auto e = std::make_shared<PersistEntS>(); e->t = PET::Suspect; e->num = epoch; return e;
+}
+
+// ---------------------------------------------------------------------------
+// Message buffers (statemachine/msgbuffers.py).
+// ---------------------------------------------------------------------------
+
+enum class Applyable : u8 { PAST = 0, CURRENT = 1, FUTURE = 2, INVALID = 3 };
+
+struct NodeBuffer {
+    i64 total_size = 0;
+    i64 buffer_size;  // my_config.buffer_size
+    bool over_capacity() const { return total_size > buffer_size; }
+};
+
+struct MsgBuffer {
+    deque<std::pair<MsgP, i64>> buffer;
+    NodeBuffer *nb = nullptr;
+    i64 *group = nullptr;
+    const Wire *wire = nullptr;
+
+    void store(MsgP msg) {
+        while (nb->over_capacity() && !buffer.empty()) {
+            auto old = buffer.front();
+            buffer.pop_front();
+            if (group) (*group)--;
+            nb->total_size -= old.second;
+        }
+        i64 size = wire->msg_size(*msg);
+        buffer.emplace_back(std::move(msg), size);
+        if (group) (*group)++;
+        nb->total_size += size;
+    }
+
+    template <typename F>
+    MsgP next(F &&filter_fn) {
+        size_t i = 0;
+        while (i < buffer.size()) {
+            MsgP msg = buffer[i].first;
+            i64 size = buffer[i].second;
+            Applyable verdict = filter_fn(*msg);
+            if (verdict == Applyable::FUTURE) {
+                i++;
+                continue;
+            }
+            buffer.erase(buffer.begin() + (std::ptrdiff_t)i);
+            if (group) (*group)--;
+            nb->total_size -= size;
+            if (verdict == Applyable::CURRENT) return msg;
+        }
+        return nullptr;
+    }
+
+    template <typename F, typename A>
+    void iterate(F &&filter_fn, A &&apply_fn) {
+        size_t i = 0;
+        while (i < buffer.size()) {
+            MsgP msg = buffer[i].first;
+            i64 size = buffer[i].second;
+            Applyable verdict = filter_fn(*msg);
+            if (verdict == Applyable::FUTURE) {
+                i++;
+                continue;
+            }
+            buffer.erase(buffer.begin() + (std::ptrdiff_t)i);
+            if (group) (*group)--;
+            nb->total_size -= size;
+            if (verdict == Applyable::CURRENT) apply_fn(msg);
+        }
+    }
+
+    bool empty() const { return buffer.empty(); }
+    size_t size() const { return buffer.size(); }
+};
+
+// Per-node registry of per-peer buffers (NodeBuffers).
+struct NodeBuffers {
+    std::map<i32, NodeBuffer> node_map;
+    i64 buffer_size;
+
+    NodeBuffer *node_buffer(i32 source) {
+        auto it = node_map.find(source);
+        if (it == node_map.end()) {
+            it = node_map.emplace(source, NodeBuffer{0, buffer_size}).first;
+        }
+        return &it->second;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Checkpoint agreement tracking (statemachine/checkpoints.py).
+// ---------------------------------------------------------------------------
+
+struct Checkpoint {
+    i64 seq_no;
+    i32 my_id;
+    const Ctx *ctx;
+    // (value, supporters) insertion-ordered.
+    vector<std::pair<i32, vector<i32>>> values;
+    i32 committed_value = -1;  // -1 = None
+    i32 my_value = -1;
+    bool stable = false;
+
+    void apply_checkpoint_msg(i32 source, i32 value) {
+        vector<i32> *supporters = nullptr;
+        for (auto &pr : values)
+            if (pr.first == value) { supporters = &pr.second; break; }
+        if (!supporters) {
+            values.emplace_back(value, vector<i32>());
+            supporters = &values.back().second;
+        }
+        for (i32 s : *supporters)
+            if (s == source) return;  // dedup double-votes (hardening)
+        supporters->push_back(source);
+        i64 agreements = (i64)supporters->size();
+
+        if (agreements == ctx->wq) committed_value = value;
+        if (source == my_id) my_value = value;
+
+        if (my_value >= 0 && committed_value >= 0 && !stable) {
+            if (value != committed_value)
+                throw EngineError("my checkpoint disagrees with the committed network view");
+            if (agreements >= ctx->iq) stable = true;
+        }
+    }
+};
+
+struct CheckpointState_ { enum V { IDLE = 0, GARBAGE_COLLECTABLE = 1 }; };
+
+struct CheckpointTracker {
+    int state = CheckpointState_::IDLE;
+    PersistedLog *persisted;
+    NodeBuffers *node_buffers;
+    InitParms my_config;
+    const Ctx *ctx;
+    std::map<i32, i64> highest_checkpoints;
+    std::map<i64, shared_ptr<Checkpoint>> checkpoint_map;
+    vector<shared_ptr<Checkpoint>> active_checkpoints;
+    std::map<i32, MsgBuffer> msg_buffers;
+    bool have_config = false;
+
+    shared_ptr<Checkpoint> checkpoint(i64 seq_no) {
+        auto it = checkpoint_map.find(seq_no);
+        if (it != checkpoint_map.end()) return it->second;
+        auto cp = std::make_shared<Checkpoint>();
+        cp->seq_no = seq_no;
+        cp->my_id = my_config.id;
+        cp->ctx = ctx;
+        checkpoint_map.emplace(seq_no, cp);
+        return cp;
+    }
+
+    i64 high_watermark() const { return active_checkpoints.back()->seq_no; }
+    i64 low_watermark() const { return active_checkpoints.front()->seq_no; }
+
+    Applyable filter(const MsgS &msg) const {
+        if (msg.seq < active_checkpoints.front()->seq_no) return Applyable::PAST;
+        if (msg.seq > high_watermark()) return Applyable::FUTURE;
+        return Applyable::CURRENT;
+    }
+
+    void reinitialize() {
+        auto old_checkpoint_map = std::move(checkpoint_map);
+        auto old_msg_buffers = std::move(msg_buffers);
+
+        highest_checkpoints.clear();
+        checkpoint_map.clear();
+        active_checkpoints.clear();
+        msg_buffers.clear();
+        have_config = false;
+
+        for (const auto &pr : persisted->entries) {
+            if (pr.second->t != PET::C) continue;
+            have_config = true;  // network config fixed engine-wide
+            auto cp = checkpoint(pr.second->seq);
+            cp->apply_checkpoint_msg(my_config.id, pr.second->dig);
+            active_checkpoints.push_back(cp);
+        }
+        if (active_checkpoints.empty())
+            throw EngineError("log must contain a CEntry");
+        active_checkpoints[0]->stable = true;
+
+        for (i32 node : ctx->cfg.nodes) {
+            auto it = old_msg_buffers.find(node);
+            if (it != old_msg_buffers.end()) {
+                msg_buffers.emplace(node, std::move(it->second));
+            } else {
+                MsgBuffer mb;
+                mb.nb = node_buffers->node_buffer(node);
+                mb.wire = &ctx->wire;
+                msg_buffers.emplace(node, std::move(mb));
+            }
+        }
+
+        // Re-apply remembered agreements (commutative).
+        for (const auto &pr : old_checkpoint_map) {
+            if (pr.first < low_watermark()) continue;
+            for (const auto &val : pr.second->values)
+                for (i32 node : val.second)
+                    apply_checkpoint_msg(node, pr.first, val.first);
+        }
+        garbage_collect();
+    }
+
+    void step(i32 source, MsgP msg) {
+        Applyable verdict = filter(*msg);
+        if (verdict == Applyable::PAST) return;
+        if (verdict == Applyable::FUTURE) msg_buffers.at(source).store(msg);
+        apply_checkpoint_msg(source, msg->seq, msg->dig);
+    }
+
+    i64 garbage_collect() {
+        size_t highest_stable_idx = 0;
+        for (size_t i = 0; i < active_checkpoints.size(); i++) {
+            if (!active_checkpoints[i]->stable) break;
+            highest_stable_idx = i;
+        }
+        for (size_t i = 0; i < highest_stable_idx; i++)
+            checkpoint_map.erase(active_checkpoints[i]->seq_no);
+        active_checkpoints.erase(active_checkpoints.begin(),
+                                 active_checkpoints.begin() +
+                                     (std::ptrdiff_t)highest_stable_idx);
+
+        while (active_checkpoints.size() < 3) {
+            i64 next_seq = high_watermark() + ctx->cfg.ci;
+            active_checkpoints.push_back(checkpoint(next_seq));
+        }
+
+        for (i32 node : ctx->cfg.nodes) {
+            auto &mb = msg_buffers.at(node);
+            mb.iterate([this](const MsgS &m) { return filter(m); },
+                       [this, node](MsgP m) {
+                           apply_checkpoint_msg(node, m->seq, m->dig);
+                       });
+        }
+        state = CheckpointState_::IDLE;
+        return active_checkpoints[0]->seq_no;
+    }
+
+    void apply_checkpoint_msg(i32 source, i64 seq_no, i32 value) {
+        bool above_high = seq_no > high_watermark();
+        if (above_high) {
+            auto it = highest_checkpoints.find(source);
+            if (it != highest_checkpoints.end() && it->second <= seq_no)
+                return;  // mirrors the reference's replace-only-if-greater rule
+            highest_checkpoints[source] = seq_no;
+        }
+        auto cp = checkpoint(seq_no);
+        cp->apply_checkpoint_msg(source, value);
+
+        if (cp->stable && seq_no > low_watermark() && !above_high) {
+            state = CheckpointState_::GARBAGE_COLLECTABLE;
+            return;
+        }
+        if (!above_high) return;
+
+        std::set<i64> referenced;
+        for (const auto &acp : active_checkpoints) referenced.insert(acp->seq_no);
+        for (const auto &pr : highest_checkpoints) referenced.insert(pr.second);
+        for (auto it = checkpoint_map.begin(); it != checkpoint_map.end();) {
+            if (!referenced.count(it->first)) it = checkpoint_map.erase(it);
+            else ++it;
+        }
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Ready / available lists (statemachine/client_tracker.py).
+// ---------------------------------------------------------------------------
+
+struct ClientReqNoD;  // disseminator's per-(client, req_no) record
+using CRNP = shared_ptr<ClientReqNoD>;
+
+template <typename T>
+struct AppendList {
+    deque<T> consumed;
+    deque<T> pending;
+
+    void reset_iterator() {
+        for (auto &v : pending) consumed.push_back(std::move(v));
+        pending = std::move(consumed);
+        consumed.clear();
+    }
+    bool has_next() const { return !pending.empty(); }
+    T next() {
+        T v = std::move(pending.front());
+        pending.pop_front();
+        consumed.push_back(v);
+        return v;
+    }
+    void push_back(T v) { pending.push_back(std::move(v)); }
+
+    template <typename F>
+    void garbage_collect(F &&should_remove) {
+        deque<T> nc, np;
+        for (auto &v : consumed)
+            if (!should_remove(v)) nc.push_back(std::move(v));
+        for (auto &v : pending)
+            if (!should_remove(v)) np.push_back(std::move(v));
+        consumed = std::move(nc);
+        pending = std::move(np);
+    }
+};
+
+struct ClientTracker {
+    InitParms my_config;
+    shared_ptr<AppendList<CRNP>> ready_list;
+    shared_ptr<AppendList<AckS>> available_list;
+    vector<ClientStateS> client_states;
+
+    void reinitialize(const NetStateS &ns) {
+        client_states = ns.clients;
+        available_list = std::make_shared<AppendList<AckS>>();
+        ready_list = std::make_shared<AppendList<CRNP>>();
+    }
+
+    void add_ready(CRNP crn) { ready_list->push_back(std::move(crn)); }
+    void add_available(AckS ack) { available_list->push_back(ack); }
+
+    // allocate(): GC both lists against post-checkpoint client states.
+    void allocate(const NetStateS &state);
+};
+
+// ---------------------------------------------------------------------------
+// Batch tracker (statemachine/batch_tracker.py).
+// ---------------------------------------------------------------------------
+
+struct BatchRec {
+    std::set<i64> observed_for;
+    vector<AckS> request_acks;
+};
+
+struct BatchTracker {
+    std::map<i32, BatchRec> batches_by_digest;
+    std::map<i32, vector<i64>> fetch_in_flight;
+    PersistedLog *persisted;
+
+    void reinitialize() {
+        batches_by_digest.clear();
+        fetch_in_flight.clear();
+        for (const auto &pr : persisted->entries)
+            if (pr.second->t == PET::Q)
+                add_batch(pr.second->q->seq, pr.second->q->dig,
+                          pr.second->q->reqs);
+    }
+
+    void truncate(i64 seq_no) {
+        for (auto it = batches_by_digest.begin();
+             it != batches_by_digest.end();) {
+            auto &b = it->second;
+            std::set<i64> keep;
+            for (i64 s : b.observed_for)
+                if (s >= seq_no) keep.insert(s);
+            b.observed_for = std::move(keep);
+            if (b.observed_for.empty()) it = batches_by_digest.erase(it);
+            else ++it;
+        }
+    }
+
+    void add_batch(i64 seq_no, i32 digest, const vector<AckS> &request_acks) {
+        auto it = batches_by_digest.find(digest);
+        if (it == batches_by_digest.end()) {
+            it = batches_by_digest.emplace(digest, BatchRec{{}, request_acks})
+                     .first;
+        }
+        it->second.observed_for.insert(seq_no);
+        auto fit = fetch_in_flight.find(digest);
+        if (fit != fetch_in_flight.end()) {
+            for (i64 s : fit->second) it->second.observed_for.insert(s);
+            fetch_in_flight.erase(fit);
+        }
+    }
+
+    Actions fetch_batch(i64 seq_no, i32 digest, vector<i32> sources) {
+        auto it = fetch_in_flight.find(digest);
+        if (it != fetch_in_flight.end())
+            for (i64 s : it->second)
+                if (s == seq_no) return Actions();
+        fetch_in_flight[digest].push_back(seq_no);
+        Actions a;
+        a.push_back(act_send(std::move(sources), mk_fetch_batch(seq_no, digest)));
+        return a;
+    }
+
+    Actions reply_fetch_batch(i32 source, i64 seq_no, i32 digest) {
+        auto it = batches_by_digest.find(digest);
+        if (it == batches_by_digest.end()) return Actions();
+        Actions a;
+        a.push_back(act_send({source},
+                             mk_forward_batch(seq_no, it->second.request_acks,
+                                              digest)));
+        return a;
+    }
+
+    Actions apply_forward_batch_msg(i32 source, i64 seq_no, i32 digest,
+                                    const vector<AckS> &request_acks,
+                                    const Interner &in) {
+        if (!fetch_in_flight.count(digest)) return Actions();
+        vector<string> parts;
+        for (const auto &a : request_acks) parts.push_back(in.get(a.dig));
+        HashOriginS origin;
+        origin.t = OT::VerifyBatch;
+        origin.source = source;
+        origin.seq = seq_no;
+        origin.request_acks = request_acks;
+        origin.expected_digest = digest;
+        Actions acts;
+        acts.push_back(act_hash(std::move(parts), std::move(origin)));
+        return acts;
+    }
+
+    void apply_verify_batch_hash_result(i32 digest, const HashOriginS &origin) {
+        if (origin.expected_digest != digest)
+            throw EngineError("forwarded batch hash mismatch (byzantine forwarder)");
+        auto it = fetch_in_flight.find(digest);
+        if (it == fetch_in_flight.end()) return;
+        vector<i64> in_flight = std::move(it->second);
+        fetch_in_flight.erase(it);
+        auto bit = batches_by_digest.find(digest);
+        if (bit == batches_by_digest.end())
+            bit = batches_by_digest
+                      .emplace(digest, BatchRec{{}, origin.request_acks})
+                      .first;
+        for (i64 s : in_flight) bit->second.observed_for.insert(s);
+    }
+
+    bool has_fetch_in_flight() const { return !fetch_in_flight.empty(); }
+    const BatchRec *get_batch(i32 digest) const {
+        auto it = batches_by_digest.find(digest);
+        return it == batches_by_digest.end() ? nullptr : &it->second;
+    }
+    BatchRec *get_batch_mut(i32 digest) {
+        auto it = batches_by_digest.find(digest);
+        return it == batches_by_digest.end() ? nullptr : &it->second;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Client request dissemination (statemachine/disseminator.py).
+// Vote masks are single u64 words (engine envelope: <= 64 nodes).
+// ---------------------------------------------------------------------------
+
+constexpr i64 CORRECT_FETCH_TICKS = 4;
+constexpr i64 FETCH_TIMEOUT_TICKS = 4;
+constexpr i64 ACK_RESEND_TICKS = 20;
+
+struct ClientRequestD {
+    AckS ack;
+    u64 agreements = 0;
+    bool stored = false;
+    bool fetching = false;
+    i64 ticks_fetching = 0;
+    i64 ticks_correct = 0;
+
+    Actions fetch() {
+        if (fetching) return Actions();
+        fetching = true;
+        ticks_fetching = 0;
+        Actions a;
+        a.push_back(act_send(mask_to_nodes(agreements), mk_fetch_request(ack)));
+        return a;
+    }
+};
+using CRP = shared_ptr<ClientRequestD>;
+
+// Small insertion-ordered map digest-id -> value (1-2 entries typical).
+template <typename V>
+struct SmallDigMap {
+    vector<std::pair<i32, V>> items;
+    V *get(i32 k) {
+        for (auto &pr : items)
+            if (pr.first == k) return &pr.second;
+        return nullptr;
+    }
+    const V *get(i32 k) const {
+        for (const auto &pr : items)
+            if (pr.first == k) return &pr.second;
+        return nullptr;
+    }
+    V &put(i32 k, V v) {
+        for (auto &pr : items)
+            if (pr.first == k) { pr.second = std::move(v); return pr.second; }
+        items.emplace_back(k, std::move(v));
+        return items.back().second;
+    }
+    bool contains(i32 k) const { return get(k) != nullptr; }
+    size_t size() const { return items.size(); }
+    bool empty() const { return items.empty(); }
+};
+
+struct ClientReqNoD {
+    i64 client_id, req_no;
+    i64 valid_after_seq_no;
+    u64 non_null_voters = 0;
+    SmallDigMap<CRP> requests;         // all observed candidates
+    SmallDigMap<CRP> weak_requests;    // correct
+    SmallDigMap<CRP> strong_requests;  // proposable
+    SmallDigMap<CRP> my_requests;      // locally persisted
+    bool committed = false;
+    i64 acks_sent = 0;
+    i32 acked_digest = -1;  // -1 = None
+    i64 resend_nonce = 0;
+
+    CRP client_req(const AckS &ack) {
+        CRP *existing = requests.get(ack.dig);
+        if (existing) return *existing;
+        auto cr = std::make_shared<ClientRequestD>();
+        cr->ack = ack;
+        requests.put(ack.dig, cr);
+        return cr;
+    }
+
+    void apply_new_request(const AckS &ack) {
+        if (my_requests.contains(ack.dig)) return;
+        CRP req = client_req(ack);
+        req->stored = true;
+        my_requests.put(ack.dig, req);
+    }
+
+    // generate_ack() -> (has_ack, ack) (disseminator.py:215-232).
+    bool generate_ack(AckS *out) {
+        if (my_requests.empty()) return false;
+        if (my_requests.size() == 1) {
+            acks_sent = 1;
+            CRP req = my_requests.items[0].second;
+            acked_digest = req->ack.dig;
+            *out = req->ack;
+            return true;
+        }
+        AckS null_ack{client_id, req_no, 0};
+        CRP null_req = client_req(null_ack);
+        null_req->stored = true;
+        my_requests.put(0, null_req);
+        acks_sent = 1;
+        acked_digest = 0;
+        *out = null_ack;
+        return true;
+    }
+
+    bool needs_attention() const {
+        const auto &wr = weak_requests;
+        if (wr.empty()) return false;
+        if (wr.size() == 1) {
+            const CRP &req = wr.items[0].second;
+            if (req->fetching) return true;
+            return !req->stored;
+        }
+        if (!my_requests.contains(0)) return true;  // null promotion pending
+        for (const auto &pr : wr.items)
+            if (pr.second->fetching) return true;
+        return false;
+    }
+
+    // attention_tick (disseminator.py:270-318); returns promoted.
+    bool attention_tick(Actions &actions, const vector<i32> &nodes,
+                        const Interner &intern) {
+        bool promoted = false;
+        if (!my_requests.contains(0) && weak_requests.size() > 1) {
+            AckS null_ack{client_id, req_no, 0};
+            CRP null_req = client_req(null_ack);
+            null_req->stored = true;
+            my_requests.put(0, null_req);
+            acks_sent = 1;
+            acked_digest = 0;
+            promoted = true;
+            actions.push_back(act_send(nodes, mk_ack_msg(null_ack)));
+            actions.push_back(act_correct(null_ack));
+        }
+        if (weak_requests.size() == 1) {
+            CRP req = weak_requests.items[0].second;
+            if (!req->stored && !req->fetching) {
+                if (req->ticks_correct <= CORRECT_FETCH_TICKS)
+                    req->ticks_correct += 1;
+                else
+                    concat(actions, req->fetch());
+            }
+        }
+        vector<CRP> to_fetch;
+        for (auto &pr : weak_requests.items) {
+            CRP &req = pr.second;
+            if (!req->fetching) continue;
+            if (req->ticks_fetching <= FETCH_TIMEOUT_TICKS) {
+                req->ticks_fetching += 1;
+                continue;
+            }
+            req->fetching = false;
+            to_fetch.push_back(req);
+        }
+        if (!to_fetch.empty()) {
+            // Python: to_fetch.sort(key=digest bytes, reverse=True).
+            std::stable_sort(to_fetch.begin(), to_fetch.end(),
+                             [&intern](const CRP &a, const CRP &b) {
+                                 return intern.get(a->ack.dig) >
+                                        intern.get(b->ack.dig);
+                             });
+            for (auto &req : to_fetch) concat(actions, req->fetch());
+        }
+        return promoted;
+    }
+};
+
+struct ClientD {
+    const Ctx *ctx = nullptr;
+    InitParms my_config;
+    ClientTracker *client_tracker = nullptr;
+    ClientStateS client_state;
+    bool has_state = false;
+    i64 high_watermark = 0;
+    i64 next_ready_mark = 0;
+    i64 next_ack_mark = 0;
+    // Dense window [win_base, win_base+win.size()-1] — Python's insertion-
+    // ordered dict over an ascending contiguous window.
+    i64 win_base = 0;
+    deque<CRNP> win;
+    i64 tick_count = 0;
+    std::set<i64> attention;
+    std::map<i64, vector<std::pair<i64, i64>>> resend_schedule;
+    i64 resend_seq = 0;
+    i64 weak_quorum = 0, strong_quorum = 0;
+
+    CRNP win_get(i64 req_no) const {
+        i64 off = req_no - win_base;
+        if (off < 0 || off >= (i64)win.size()) return nullptr;
+        return win[(size_t)off];
+    }
+
+    CRNP req_no_of(i64 req_no) {
+        CRNP crn = win_get(req_no);
+        if (!crn) throw EngineError("client should have req_no");
+        return crn;
+    }
+
+    bool in_watermarks(i64 req_no) const {
+        return client_state.lw <= req_no && req_no <= high_watermark;
+    }
+
+    Actions reinitialize(i64 seq_no, i64 client_id,
+                         const ClientStateS &state, bool reconfiguring) {
+        Actions actions;
+        weak_quorum = ctx->wq;
+        strong_quorum = ctx->iq;
+        deque<CRNP> old_win = std::move(win);
+        i64 old_base = win_base;
+        win.clear();
+
+        i64 intermediate_high = state.lw + state.width - state.wclc - 1;
+        client_state = state;
+        has_state = true;
+        high_watermark =
+            !reconfiguring ? state.lw + state.width - 1 : intermediate_high;
+        next_ready_mark = state.lw;
+        if (next_ack_mark < state.lw) next_ack_mark = state.lw;
+
+        win_base = state.lw;
+        // Config never changes within the engine envelope (same_config=True
+        // after the first call; the first call has no prior req_nos at all).
+        for (i64 rn = state.lw; rn <= high_watermark; rn++) {
+            CRNP crn;
+            i64 old_off = rn - old_base;
+            if (old_off >= 0 && old_off < (i64)old_win.size() &&
+                !old_win.empty()) {
+                crn = old_win[(size_t)old_off];
+                // same_config reinitialize: reset per-candidate fetch state.
+                for (auto &pr : crn->requests.items) {
+                    pr.second->fetching = false;
+                    pr.second->ticks_fetching = 0;
+                    pr.second->ticks_correct = 0;
+                }
+            } else {
+                i64 valid_after =
+                    rn > intermediate_high ? seq_no + ctx->cfg.ci : seq_no;
+                crn = std::make_shared<ClientReqNoD>();
+                crn->client_id = client_id;
+                crn->req_no = rn;
+                crn->valid_after_seq_no = valid_after;
+                actions.push_back(act_allocate(client_id, rn));
+            }
+            crn->committed = is_committed(rn, state);
+            win.push_back(std::move(crn));
+        }
+        attention.clear();
+        for (const auto &crn : win)
+            if (!crn->committed && crn->needs_attention())
+                attention.insert(crn->req_no);
+        advance_ready();
+        return actions;
+    }
+
+    Actions allocate(i64 seq_no, const ClientStateS &state, bool reconfiguring) {
+        Actions actions;
+        i64 intermediate_high = state.lw + state.width - state.wclc - 1;
+        if (intermediate_high != high_watermark)
+            throw EngineError("new intermediate high watermark mismatch");
+        i64 new_high =
+            !reconfiguring ? state.lw + state.width - 1 : intermediate_high;
+
+        if (state.lw > next_ready_mark) next_ready_mark = state.lw;
+        if (state.lw > next_ack_mark) next_ack_mark = state.lw;
+
+        // Drop window prefix below the new low watermark.
+        while (!win.empty() && win_base != state.lw) {
+            win.pop_front();
+            win_base += 1;
+        }
+        if (win.empty()) win_base = state.lw;
+        for (i64 rn = state.lw; rn <= high_watermark; rn++)
+            if (is_committed(rn, state)) req_no_of(rn)->committed = true;
+
+        client_state = state;
+
+        i64 valid_after = seq_no + ctx->cfg.ci;
+        for (i64 rn = intermediate_high + 1; rn <= new_high; rn++) {
+            actions.push_back(act_allocate(state.id, rn));
+            auto crn = std::make_shared<ClientReqNoD>();
+            crn->client_id = state.id;
+            crn->req_no = rn;
+            crn->valid_after_seq_no = valid_after;
+            win.push_back(std::move(crn));
+        }
+        high_watermark = new_high;
+        advance_ready();
+        return actions;
+    }
+
+    // ack_into (disseminator.py:488-539) — the per-ack hot path.
+    CRP ack_into(Actions &actions, i32 source, const AckS &ack,
+                 bool force = false) {
+        CRNP crnp = win_get(ack.reqno);
+        if (!crnp) throw EngineError("ack outside watermarks");
+        ClientReqNoD &crn = *crnp;
+
+        u64 bit = 1ull << source;
+        if (ack.dig != 0 && !force) {
+            CRP *existing = crn.requests.get(ack.dig);
+            bool already_voted_this =
+                existing && ((*existing)->agreements & bit);
+            if ((crn.non_null_voters & bit) && !already_voted_this)
+                return crn.client_req(ack);
+        }
+        if (ack.dig != 0) crn.non_null_voters |= bit;
+
+        CRP cr = crn.client_req(ack);
+        cr->agreements |= bit;
+        i64 agreement_count = (i64)__builtin_popcountll(cr->agreements);
+
+        bool newly_correct = agreement_count == weak_quorum;
+        if (newly_correct) {
+            crn.weak_requests.put(ack.dig, cr);
+            if (!cr->stored) actions.push_back(act_correct(ack));
+            update_attention(crn);
+        }
+        if (cr->stored &&
+            (newly_correct ||
+             (agreement_count >= weak_quorum && source == my_config.id)))
+            client_tracker->add_available(ack);
+        if (agreement_count == strong_quorum) {
+            crn.strong_requests.put(ack.dig, cr);
+            advance_ready();
+        }
+        return cr;
+    }
+
+    // ack_run (disseminator.py:541-604): a run of in-window acks from one
+    // source for this client starting at acks[start]; returns index after.
+    size_t ack_run(Actions &actions, i32 source, const vector<AckS> &acks,
+                   size_t start) {
+        u64 bit = 1ull << source;
+        i64 weak_q = weak_quorum, strong_q = strong_quorum;
+        i64 low = client_state.lw, high = high_watermark;
+        i64 client_id = acks[start].client;
+        size_t n = acks.size();
+        size_t i = start;
+        while (i < n) {
+            const AckS &ack = acks[i];
+            if (ack.client != client_id) break;
+            i64 req_no = ack.reqno;
+            if (req_no < low || req_no > high) break;
+            i++;
+            i32 digest = ack.dig;
+            ClientReqNoD &crn = *win[(size_t)(req_no - win_base)];
+            CRP cr;
+            if (digest != 0 && (crn.non_null_voters & bit)) {
+                CRP *existing = crn.requests.get(digest);
+                if (!existing) {
+                    auto fresh = std::make_shared<ClientRequestD>();
+                    fresh->ack = ack;
+                    crn.requests.put(digest, fresh);
+                    continue;
+                }
+                if (!((*existing)->agreements & bit)) continue;
+                cr = *existing;
+            } else {
+                if (digest != 0) crn.non_null_voters |= bit;
+                CRP *existing = crn.requests.get(digest);
+                if (existing) {
+                    cr = *existing;
+                } else {
+                    cr = std::make_shared<ClientRequestD>();
+                    cr->ack = ack;
+                    crn.requests.put(digest, cr);
+                }
+            }
+            u64 votes = cr->agreements | bit;
+            cr->agreements = votes;
+            i64 count = (i64)__builtin_popcountll(votes);
+            if (count < weak_q) continue;
+            bool newly_correct = count == weak_q;
+            if (newly_correct) {
+                crn.weak_requests.put(digest, cr);
+                if (!cr->stored) actions.push_back(act_correct(ack));
+                update_attention(crn);
+            }
+            if (cr->stored && (newly_correct || source == my_config.id))
+                client_tracker->add_available(ack);
+            if (count == strong_q) {
+                crn.strong_requests.put(digest, cr);
+                advance_ready();
+            }
+        }
+        return i;
+    }
+
+    void advance_ready() {
+        for (i64 i = next_ready_mark; i <= high_watermark; i++) {
+            if (i != next_ready_mark) return;
+            CRNP crn = req_no_of(i);
+            if (crn->committed) {
+                next_ready_mark = i + 1;
+                continue;
+            }
+            for (const auto &pr : crn->strong_requests.items) {
+                if (!crn->my_requests.contains(pr.first)) continue;
+                client_tracker->add_ready(crn);
+                next_ready_mark = i + 1;
+                break;
+            }
+        }
+    }
+
+    Actions advance_acks(const vector<i32> &nodes) {
+        Actions actions;
+        vector<AckS> acks;
+        for (i64 i = next_ack_mark; i <= high_watermark; i++) {
+            CRNP crn = req_no_of(i);
+            AckS ack{0, 0, 0};
+            if (!crn->generate_ack(&ack)) break;
+            acks.push_back(ack);
+            schedule_resend(*crn, tick_count + ACK_RESEND_TICKS + 1);
+            update_attention(*crn);
+            next_ack_mark = i + 1;
+        }
+        if (acks.size() == 1)
+            actions.push_back(act_send(nodes, mk_ack_msg(acks[0])));
+        else if (!acks.empty())
+            actions.push_back(act_send(nodes, mk_ack_batch(std::move(acks))));
+        return actions;
+    }
+
+    void update_attention(ClientReqNoD &crn) {
+        if (!crn.committed && crn.needs_attention())
+            attention.insert(crn.req_no);
+        else
+            attention.erase(crn.req_no);
+    }
+
+    void schedule_resend(ClientReqNoD &crn, i64 due_tick) {
+        resend_seq += 1;
+        crn.resend_nonce = resend_seq;
+        resend_schedule[due_tick].emplace_back(crn.req_no, crn.resend_nonce);
+    }
+
+    void apply_new_request(const AckS &ack) {
+        CRNP crn = req_no_of(ack.reqno);
+        crn->apply_new_request(ack);
+        update_attention(*crn);
+    }
+
+    void note_fetching(const AckS &ack) {
+        CRNP crn = win_get(ack.reqno);
+        if (crn) update_attention(*crn);
+    }
+
+    void tick(Actions &actions, const vector<i32> &nodes) {
+        tick_count += 1;
+        if (!attention.empty()) {
+            // Python iterates sorted(attention) over a snapshot.
+            vector<i64> snapshot(attention.begin(), attention.end());
+            for (i64 rn : snapshot) {
+                CRNP crn = win_get(rn);
+                if (!crn || crn->committed) {
+                    attention.erase(rn);
+                    continue;
+                }
+                if (crn->attention_tick(actions, nodes, ctx->intern))
+                    schedule_resend(*crn, tick_count + ACK_RESEND_TICKS);
+                update_attention(*crn);
+            }
+        }
+        vector<AckS> resend;
+        auto due_it = resend_schedule.find(tick_count);
+        if (due_it != resend_schedule.end()) {
+            vector<std::pair<i64, i64>> due = std::move(due_it->second);
+            resend_schedule.erase(due_it);
+            for (const auto &pr : due) {
+                CRNP crnp = win_get(pr.first);
+                if (!crnp || crnp->committed || crnp->resend_nonce != pr.second)
+                    continue;
+                ClientReqNoD &crn = *crnp;
+                CRP *req = crn.my_requests.get(crn.acked_digest);
+                if (!req)
+                    throw EngineError("sent an ack for a request we do not have");
+                crn.acks_sent += 1;
+                resend.push_back((*req)->ack);
+                schedule_resend(crn,
+                                tick_count + crn.acks_sent * ACK_RESEND_TICKS + 1);
+            }
+        }
+        if (resend.size() == 1)
+            actions.push_back(act_send(nodes, mk_ack_msg(resend[0])));
+        else if (!resend.empty())
+            actions.push_back(act_send(nodes, mk_ack_batch(std::move(resend))));
+    }
+};
+
+struct Disseminator {
+    const Ctx *ctx = nullptr;
+    InitParms my_config;
+    NodeBuffers *node_buffers = nullptr;
+    ClientTracker *client_tracker = nullptr;
+    i64 allocated_through = 0;
+    bool initialized = false;
+    vector<ClientStateS> client_states;
+    std::map<i32, MsgBuffer> msg_buffers;
+    std::map<i64, shared_ptr<ClientD>> clients;
+    std::set<i64> ack_dirty;
+
+    ClientD *client(i64 client_id) {
+        auto it = clients.find(client_id);
+        return it == clients.end() ? nullptr : it->second.get();
+    }
+
+    Actions reinitialize(i64 seq_no, const NetStateS &network_state) {
+        Actions actions;
+        // Envelope: no pending reconfigurations ever.
+        bool reconfiguring = false;
+        allocated_through = seq_no;
+
+        auto old_clients = std::move(clients);
+        clients.clear();
+        client_states = network_state.clients;
+        for (const auto &cs : client_states) {
+            shared_ptr<ClientD> c;
+            auto it = old_clients.find(cs.id);
+            if (it != old_clients.end()) {
+                c = it->second;
+            } else {
+                c = std::make_shared<ClientD>();
+                c->ctx = ctx;
+                c->my_config = my_config;
+                c->client_tracker = client_tracker;
+            }
+            clients.emplace(cs.id, c);
+            concat(actions, c->reinitialize(seq_no, cs.id, cs, reconfiguring));
+        }
+        auto old_msg_buffers = std::move(msg_buffers);
+        msg_buffers.clear();
+        for (i32 node : ctx->cfg.nodes) {
+            auto it = old_msg_buffers.find(node);
+            if (it != old_msg_buffers.end()) {
+                msg_buffers.emplace(node, std::move(it->second));
+            } else {
+                MsgBuffer mb;
+                mb.nb = node_buffers->node_buffer(node);
+                mb.wire = &ctx->wire;
+                msg_buffers.emplace(node, std::move(mb));
+            }
+        }
+        initialized = true;
+        return actions;
+    }
+
+    Actions tick() {
+        Actions actions;
+        for (const auto &cs : client_states)
+            clients.at(cs.id)->tick(actions, ctx->cfg.nodes);
+        return actions;
+    }
+
+    Applyable filter(const MsgS &msg) {
+        if (msg.t == MT::AckMsg) {
+            const AckS &ack = msg.acks[0];
+            ClientD *c = client(ack.client);
+            if (!c) return Applyable::FUTURE;
+            if (c->client_state.lw > ack.reqno) return Applyable::PAST;
+            if (c->high_watermark < ack.reqno) return Applyable::FUTURE;
+            return Applyable::CURRENT;
+        }
+        if (msg.t == MT::FetchRequest) return Applyable::CURRENT;
+        throw EngineError("unexpected client message type");
+    }
+
+    Actions step(i32 source, const MsgP &msg) {
+        if (msg->t == MT::AckBatch) {
+            u64 t0 = __rdtsc();
+            // Per-ack classification; in-window same-client runs go through
+            // ack_run (the AckBatch arm of disseminator.py:1056-1085 — the
+            // pure semantics the native plane replays).
+            Actions actions;
+            const vector<AckS> &acks = msg->acks;
+            size_t n = acks.size();
+            size_t i = 0;
+            while (i < n) {
+                const AckS &ack = acks[i];
+                ClientD *c = client(ack.client);
+                if (!c) {
+                    msg_buffers.at(source).store(mk_ack_msg(ack));  // FUTURE
+                    i++;
+                    continue;
+                }
+                i64 req_no = ack.reqno;
+                if (c->client_state.lw > req_no) {
+                    i++;
+                    continue;  // PAST
+                }
+                if (c->high_watermark < req_no) {
+                    msg_buffers.at(source).store(mk_ack_msg(ack));  // FUTURE
+                    i++;
+                    continue;
+                }
+                i = c->ack_run(actions, source, acks, i);
+            }
+            g_parts[0].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
+            return actions;
+        }
+        Applyable verdict = filter(*msg);
+        if (verdict == Applyable::PAST) return Actions();
+        if (verdict == Applyable::FUTURE) {
+            msg_buffers.at(source).store(msg);
+            return Actions();
+        }
+        return apply_msg(source, msg);
+    }
+
+    Actions apply_msg(i32 source, const MsgP &msg) {
+        if (msg->t == MT::AckMsg) {
+            Actions actions;
+            ack(actions, source, msg->acks[0], false);
+            return actions;
+        }
+        if (msg->t == MT::FetchRequest) {
+            const AckS &a = msg->acks[0];
+            return reply_fetch_request(source, a);
+        }
+        throw EngineError("unexpected client message type");
+    }
+
+    Actions apply_new_request(const AckS &ack) {
+        ClientD *c = client(ack.client);
+        if (!c) return Actions();
+        if (!c->in_watermarks(ack.reqno)) return Actions();
+        c->apply_new_request(ack);
+        ack_dirty.insert(ack.client);
+        return Actions();
+    }
+
+    Actions flush_acks() {
+        if (ack_dirty.empty()) return Actions();
+        Actions actions;
+        for (i64 client_id : ack_dirty) {  // std::set: sorted like Python
+            ClientD *c = client(client_id);
+            if (c) concat(actions, c->advance_acks(ctx->cfg.nodes));
+        }
+        ack_dirty.clear();
+        return actions;
+    }
+
+    Actions allocate(i64 seq_no, const NetStateS &network_state) {
+        if (seq_no != ctx->cfg.ci + allocated_through)
+            throw EngineError("unexpected skip in allocate");
+        Actions actions;
+        allocated_through = seq_no;
+        bool reconfiguring = false;  // envelope
+        for (const auto &cs : network_state.clients) {
+            ClientD *c = client(cs.id);
+            concat(actions, c->allocate(seq_no, cs, reconfiguring));
+        }
+        for (i32 node : ctx->cfg.nodes) {
+            msg_buffers.at(node).iterate(
+                [this](const MsgS &m) { return filter(m); },
+                [this, node, &actions](MsgP m) {
+                    concat(actions, apply_msg(node, m));
+                });
+        }
+        return actions;
+    }
+
+    Actions reply_fetch_request(i32 source, const AckS &a) {
+        ClientD *c = client(a.client);
+        if (!c || !c->in_watermarks(a.reqno)) return Actions();
+        CRNP crn = c->req_no_of(a.reqno);
+        CRP *data = crn->requests.get(a.dig);
+        if (!data || !(((*data)->agreements >> my_config.id) & 1))
+            return Actions();
+        Actions actions;
+        actions.push_back(act_forward({source}, a));
+        return actions;
+    }
+
+    CRP ack(Actions &actions, i32 source, const AckS &a, bool force) {
+        ClientD *c = client(a.client);
+        if (!c)
+            throw EngineError("step filtering should delay reqs for non-existent clients");
+        return c->ack_into(actions, source, a, force);
+    }
+
+    void note_fetching(const AckS &a) {
+        ClientD *c = client(a.client);
+        if (c) c->note_fetching(a);
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Proposer (statemachine/proposer.py).
+// ---------------------------------------------------------------------------
+
+struct ProposalBucket {
+    i64 bucket_id;
+    i64 current_checkpoint;
+    i64 checkpoint_interval;
+    i64 request_count;
+    vector<CRP> pending;
+    deque<CRP> ready_list;
+    deque<CRP> next_ready_list;
+
+    void queue_request(i64 valid_after_seq_no, CRP cr) {
+        if (current_checkpoint >= valid_after_seq_no) {
+            ready_list.push_back(std::move(cr));
+        } else {
+            if (valid_after_seq_no != current_checkpoint + checkpoint_interval)
+                throw EngineError(
+                    "requests should never become ready beyond the next "
+                    "checkpoint interval");
+            next_ready_list.push_back(std::move(cr));
+        }
+    }
+
+    void advance(i64 to_seq_no) {
+        if (to_seq_no >= current_checkpoint + checkpoint_interval) {
+            current_checkpoint += checkpoint_interval;
+            for (auto &cr : next_ready_list) ready_list.push_back(std::move(cr));
+            next_ready_list.clear();
+        }
+        while ((i64)pending.size() < request_count && !ready_list.empty()) {
+            pending.push_back(std::move(ready_list.front()));
+            ready_list.pop_front();
+        }
+    }
+
+    bool has_outstanding(i64 for_seq_no) {
+        advance(for_seq_no);
+        return !pending.empty();
+    }
+
+    bool has_pending(i64 for_seq_no) {
+        advance(for_seq_no);
+        return !pending.empty() && (i64)pending.size() == request_count;
+    }
+
+    vector<CRP> next() {
+        vector<CRP> result = std::move(pending);
+        pending.clear();
+        return result;
+    }
+};
+
+struct Proposer {
+    const Ctx *ctx;
+    InitParms my_config;
+    std::map<i64, ProposalBucket> proposal_buckets;
+    shared_ptr<AppendList<CRNP>> ready_iterator;
+
+    Proposer(const Ctx *c, i64 base_checkpoint, InitParms mc,
+             shared_ptr<AppendList<CRNP>> ready_list,
+             const std::map<i64, i32> &buckets)
+        : ctx(c), my_config(mc) {
+        for (const auto &pr : buckets) {
+            if (pr.second != mc.id) continue;
+            ProposalBucket b;
+            b.bucket_id = pr.first;
+            b.current_checkpoint = base_checkpoint;
+            b.checkpoint_interval = c->cfg.ci;
+            b.request_count = mc.batch_size;
+            proposal_buckets.emplace(pr.first, std::move(b));
+        }
+        ready_list->reset_iterator();
+        ready_iterator = std::move(ready_list);
+    }
+
+    void advance(i64 to_seq_no) {
+        while (ready_iterator->has_next()) {
+            CRNP crn = ready_iterator->next();
+            if (crn->committed) continue;
+            i64 bucket_id =
+                (crn->client_id + crn->req_no) % ctx->cfg.nb;
+            auto it = proposal_buckets.find(bucket_id);
+            if (it == proposal_buckets.end()) continue;
+            ProposalBucket &bucket = it->second;
+            bucket.advance(to_seq_no);
+            if (crn->strong_requests.size() > 1) {
+                // Conflicting strong certs: prefer the null request.
+                CRP *null_req = crn->strong_requests.get(0);
+                if (!null_req)
+                    throw EngineError(
+                        "if multiple requests have quorum, one must be null");
+                bucket.queue_request(crn->valid_after_seq_no, *null_req);
+            } else {
+                if (crn->strong_requests.size() != 1)
+                    throw EngineError("exactly one strong request must exist");
+                bucket.queue_request(crn->valid_after_seq_no,
+                                     crn->strong_requests.items[0].second);
+            }
+        }
+    }
+
+    ProposalBucket *proposal_bucket(i64 bucket_id) {
+        auto it = proposal_buckets.find(bucket_id);
+        return it == proposal_buckets.end() ? nullptr : &it->second;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Commit state (statemachine/commitstate.py).
+// ---------------------------------------------------------------------------
+
+struct CommittingClient {
+    ClientStateS last_state;
+    vector<i64> committed;  // -1 = None, seq_no otherwise
+
+    CommittingClient() = default;
+    CommittingClient(i64 seq_no, const ClientStateS &cs) {
+        committed.assign((size_t)cs.width, -1);
+        i64 bits = 8 * (i64)cs.mask.size();
+        for (i64 i = 0; i < bits; i++) {
+            bool set = (u8(cs.mask[(size_t)(i >> 3)]) & (0x80u >> (i & 7))) != 0;
+            if (set && i < (i64)committed.size()) committed[(size_t)i] = seq_no;
+        }
+        last_state = cs;
+    }
+
+    void mark_committed(i64 seq_no, i64 req_no) {
+        if (req_no < last_state.lw) return;
+        i64 offset = req_no - last_state.lw;
+        if (offset >= (i64)committed.size()) {
+            if (offset >= last_state.width)
+                throw EngineError("commit beyond client window");
+            committed.resize((size_t)(offset + 1), -1);
+        }
+        committed[(size_t)offset] = seq_no;
+    }
+
+    ClientStateS create_checkpoint_state() {
+        ClientStateS old = last_state;
+        i64 first_uncommitted = -1, last_committed = -1;
+        bool have_fu = false, have_lc = false;
+        for (i64 i = 0; i < old.width; i++) {
+            i64 seq = i < (i64)committed.size() ? committed[(size_t)i] : -1;
+            i64 req_no = old.lw + i;
+            if (seq != -1) {
+                last_committed = req_no;
+                have_lc = true;
+            } else if (!have_fu) {
+                first_uncommitted = req_no;
+                have_fu = true;
+            }
+        }
+        if (!have_lc) {
+            ClientStateS ns{old.id, old.width, 0, old.lw, string()};
+            last_state = ns;
+            return ns;
+        }
+        if (!have_fu) first_uncommitted = last_committed + 1;
+
+        i64 width_consumed = first_uncommitted - old.lw;
+        {
+            vector<i64> next;
+            for (i64 i = width_consumed; i < (i64)committed.size(); i++)
+                next.push_back(committed[(size_t)i]);
+            next.resize((size_t)(next.size() + old.width), -1);
+            next.resize((size_t)old.width);
+            committed = std::move(next);
+        }
+
+        string mask_bytes;
+        if (last_committed != first_uncommitted) {
+            i64 nbits = 8 * ((last_committed - first_uncommitted) / 8 + 1);
+            mask_bytes.assign((size_t)(nbits / 8), '\0');
+            for (i64 i = 0; i <= last_committed - first_uncommitted; i++) {
+                if (committed[(size_t)i] == -1) continue;
+                if (i == 0)
+                    throw EngineError(
+                        "the first uncommitted request cannot be committed");
+                mask_bytes[(size_t)(i >> 3)] =
+                    (char)(u8(mask_bytes[(size_t)(i >> 3)]) | (0x80u >> (i & 7)));
+            }
+        }
+        ClientStateS ns{old.id, old.width, width_consumed, first_uncommitted,
+                        mask_bytes};
+        last_state = ns;
+        return ns;
+    }
+};
+
+struct CommitState {
+    const Ctx *ctx = nullptr;
+    PersistedLog *persisted = nullptr;
+    std::map<i64, CommittingClient> committing_clients;
+    i64 low_watermark = 0;
+    i64 last_applied_commit = 0;
+    i64 highest_commit = 0;
+    i64 stop_at_seq_no = 0;
+    NetStateP active_state;
+    vector<QEntryP> lower_half_commits, upper_half_commits;
+    bool checkpoint_pending = false;
+    bool transferring = false;
+
+    Actions reinitialize() {
+        const PersistEntS *last_c = nullptr;
+        for (const auto &pr : persisted->entries)
+            if (pr.second->t == PET::C) last_c = pr.second.get();
+        if (!last_c) throw EngineError("log must contain a CEntry");
+
+        active_state = last_c->netstate;
+        low_watermark = last_c->seq;
+
+        Actions actions;
+        actions.push_back(act_state_applied(low_watermark, active_state));
+
+        i64 ci = ctx->cfg.ci;
+        // pending_reconfigurations: always empty in the envelope
+        stop_at_seq_no = last_c->seq + 2 * ci;
+        last_applied_commit = last_c->seq;
+        highest_commit = last_c->seq;
+        lower_half_commits.assign((size_t)ci, nullptr);
+        upper_half_commits.assign((size_t)ci, nullptr);
+        checkpoint_pending = false;
+
+        committing_clients.clear();
+        for (const auto &cs : active_state->clients)
+            committing_clients.emplace(cs.id,
+                                       CommittingClient(low_watermark, cs));
+        transferring = false;
+        return actions;
+    }
+
+    Actions transfer_to(i64, i32) {
+        throw EngineError("fastengine: state transfer outside envelope");
+    }
+
+    Actions apply_checkpoint_result(i64 seq_no, i32 value, NetStateP ns) {
+        i64 ci = ctx->cfg.ci;
+        if (transferring) return Actions();
+        if (seq_no != low_watermark + ci)
+            throw EngineError("stale checkpoint result");
+        stop_at_seq_no = seq_no + 2 * ci;  // no reconfigurations in envelope
+        active_state = ns;
+        lower_half_commits = std::move(upper_half_commits);
+        upper_half_commits.assign((size_t)ci, nullptr);
+        low_watermark = seq_no;
+        checkpoint_pending = false;
+
+        Actions actions = persisted->append(pe_c(seq_no, value, ns));
+        actions.push_back(
+            act_send(ctx->cfg.nodes, mk_checkpoint_msg(seq_no, value)));
+        actions.push_back(act_state_applied(seq_no, ns));
+        return actions;
+    }
+
+    std::pair<vector<QEntryP> *, size_t> slot(i64 seq_no, i64 ci) {
+        bool upper = seq_no - low_watermark > ci;
+        size_t offset = (size_t)((seq_no - (low_watermark + 1)) % ci);
+        return {upper ? &upper_half_commits : &lower_half_commits, offset};
+    }
+
+    void commit(const QEntryP &q_entry) {
+        if (transferring)
+            throw EngineError("must never commit during state transfer");
+        if (q_entry->seq > stop_at_seq_no)
+            throw EngineError("commit seq exceeds stop");
+        if (q_entry->seq <= low_watermark) return;
+        if (highest_commit < q_entry->seq) {
+            if (highest_commit + 1 != q_entry->seq)
+                throw EngineError("out-of-order commit");
+            highest_commit = q_entry->seq;
+        }
+        i64 ci = ctx->cfg.ci;
+        auto [commits, offset] = slot(q_entry->seq, ci);
+        QEntryP &existing = (*commits)[offset];
+        if (existing) {
+            if (existing->dig != q_entry->dig)
+                throw EngineError("conflicting commit digests");
+        } else {
+            existing = q_entry;
+        }
+    }
+
+    // drain() needs next_network_config; implemented after the helper below.
+    Actions drain();
+};
+
+// next_network_config (commitstate.py:141-182) — no reconfigurations.
+shared_ptr<const vector<ClientStateS>> next_client_states(
+    const NetStateS &starting_state,
+    std::map<i64, CommittingClient> &committing_clients) {
+    auto out = std::make_shared<vector<ClientStateS>>();
+    for (const auto &old_client : starting_state.clients) {
+        auto it = committing_clients.find(old_client.id);
+        if (it == committing_clients.end())
+            throw EngineError("no committing client instance");
+        out->push_back(it->second.create_checkpoint_state());
+    }
+    return out;
+}
+
+Actions CommitState::drain() {
+    i64 ci = ctx->cfg.ci;
+    // Fast path (commitstate.py:370-384).
+    i64 lac = last_applied_commit;
+    if (lac < low_watermark + 2 * ci &&
+        !(lac == low_watermark + ci && !checkpoint_pending)) {
+        auto [commits, offset] = slot(lac + 1, ci);
+        if (!(*commits)[offset]) return Actions();
+    }
+
+    Actions actions;
+    while (last_applied_commit < low_watermark + 2 * ci) {
+        if (last_applied_commit == low_watermark + ci && !checkpoint_pending) {
+            auto client_configs =
+                next_client_states(*active_state, committing_clients);
+            actions.push_back(
+                act_checkpoint(last_applied_commit, client_configs));
+            checkpoint_pending = true;
+        }
+        i64 next_commit = last_applied_commit + 1;
+        auto [commits, offset] = slot(next_commit, ci);
+        QEntryP commit = (*commits)[offset];
+        if (!commit) break;
+        if (commit->seq != next_commit)
+            throw EngineError("attempted out-of-order commit");
+        actions.push_back(act_commit(commit));
+        for (const auto &req : commit->reqs)
+            committing_clients.at(req.client).mark_committed(commit->seq,
+                                                             req.reqno);
+        last_applied_commit = next_commit;
+    }
+    return actions;
+}
+
+// ---------------------------------------------------------------------------
+// Per-sequence three-phase commit (statemachine/sequence.py, dict path; the
+// Python engine's native-plane path is observably identical to it).
+// ---------------------------------------------------------------------------
+
+enum class SeqState : u8 {
+    UNINITIALIZED = 0, ALLOCATED = 1, PENDING_REQUESTS = 2, READY = 3,
+    PREPREPARED = 4, PREPARED = 5, COMMITTED = 6,
+};
+
+struct Sequence {
+    const Ctx *ctx;
+    i32 owner;
+    i64 seq_no, epoch;
+    i32 my_id;
+    PersistedLog *persisted;
+    SeqState state = SeqState::UNINITIALIZED;
+    QEntryP q_entry;
+    vector<CRP> client_requests;
+    vector<AckS> batch;
+    std::unordered_set<AckS, AckHash> outstanding_reqs;
+    bool has_outstanding_set = false;
+    i32 digest = -1;  // -1 = None
+    u64 prep_mask = 0, commit_mask = 0;
+    SmallDigMap<i64> prepares, commits;
+    i32 my_prepare_digest = -1;
+
+    Sequence(const Ctx *c, i32 own, i64 ep, i64 sn, PersistedLog *p, i32 my)
+        : ctx(c), owner(own), seq_no(sn), epoch(ep), my_id(my), persisted(p) {}
+
+    i32 key_of(i32 d) const { return d < 0 ? 0 : d; }
+
+    Actions advance_state() {
+        Actions actions;
+        while (true) {
+            SeqState old_state = state;
+            if (state == SeqState::PENDING_REQUESTS) {
+                if (!(has_outstanding_set && !outstanding_reqs.empty()))
+                    state = SeqState::READY;
+            } else if (state == SeqState::READY) {
+                if (digest != -1 || batch.empty()) concat(actions, prepare_());
+            } else if (state == SeqState::PREPREPARED) {
+                concat(actions, check_prepare_quorum());
+            } else if (state == SeqState::PREPARED) {
+                check_commit_quorum();
+            }
+            if (state == old_state) return actions;
+        }
+    }
+
+    Actions allocate_as_owner(vector<CRP> crs) {
+        client_requests = std::move(crs);
+        vector<AckS> acks;
+        for (const auto &cr : client_requests) acks.push_back(cr->ack);
+        return allocate(std::move(acks), nullptr);
+    }
+
+    Actions allocate(vector<AckS> request_acks,
+                     std::unordered_set<AckS, AckHash> *outstanding) {
+        if (state != SeqState::UNINITIALIZED)
+            throw EngineError("sequence must be uninitialized to allocate");
+        state = SeqState::ALLOCATED;
+        batch = std::move(request_acks);
+        if (outstanding) {
+            outstanding_reqs = std::move(*outstanding);
+            has_outstanding_set = true;
+        } else {
+            has_outstanding_set = false;
+        }
+        if (batch.empty()) {
+            state = SeqState::READY;
+            return apply_batch_hash_result(-1);
+        }
+        vector<string> parts;
+        for (const auto &a : batch) parts.push_back(ctx->intern.get(a.dig));
+        HashOriginS origin;
+        origin.t = OT::Batch;
+        origin.source = owner;
+        origin.epoch = epoch;
+        origin.seq = seq_no;
+        origin.request_acks = batch;
+        Actions actions;
+        actions.push_back(act_hash(std::move(parts), std::move(origin)));
+        state = SeqState::PENDING_REQUESTS;
+        concat(actions, advance_state());
+        return actions;
+    }
+
+    Actions satisfy_outstanding(const AckS &ack) {
+        auto it = outstanding_reqs.find(ack);
+        if (!has_outstanding_set || it == outstanding_reqs.end())
+            throw EngineError("told request was ready but we weren't waiting");
+        outstanding_reqs.erase(it);
+        return advance_state();
+    }
+
+    Actions apply_batch_hash_result(i32 dig) {
+        digest = dig;
+        return apply_prepare_msg(owner, dig);
+    }
+
+    Actions prepare_() {
+        auto q = std::make_shared<QEntryS>();
+        q->seq = seq_no;
+        q->dig = key_of(digest);
+        q->reqs = batch;
+        q_entry = q;
+        state = SeqState::PREPREPARED;
+
+        Actions actions = persisted->append(pe_q(q_entry));
+
+        if (owner == my_id) {
+            for (const auto &cr : client_requests) {
+                u64 agreements = cr->agreements;
+                vector<i32> missing;
+                for (i32 node : ctx->cfg.nodes)
+                    if (!((agreements >> node) & 1)) missing.push_back(node);
+                if (!missing.empty())
+                    actions.push_back(act_forward(std::move(missing), cr->ack));
+            }
+            actions.push_back(
+                act_send(ctx->cfg.nodes, mk_preprepare(seq_no, epoch, batch)));
+        } else {
+            actions.push_back(act_send(
+                ctx->cfg.nodes, mk_prepare(seq_no, epoch, key_of(digest))));
+        }
+        return actions;
+    }
+
+    // apply_prepare_msg (sequence.py:255-291); dig -1 = None.
+    Actions apply_prepare_msg(i32 source, i32 dig) {
+        u64 bit = 1ull << source;
+        if ((prep_mask | commit_mask) & bit) return Actions();  // duplicate
+        prep_mask |= bit;
+        if (source == my_id) my_prepare_digest = dig;
+        i32 key = key_of(dig);
+        i64 *cnt = prepares.get(key);
+        i64 count = cnt ? *cnt + 1 : 1;
+        prepares.put(key, count);
+        SeqState s = state;
+        if (s == SeqState::PREPREPARED) {
+            if (count >= ctx->iq) return advance_state();
+            return Actions();
+        }
+        if (s == SeqState::READY || s == SeqState::PENDING_REQUESTS)
+            return advance_state();
+        return Actions();
+    }
+
+    Actions check_prepare_quorum() {
+        i32 my_key = key_of(digest);
+        const i64 *cntp = prepares.get(my_key);
+        i64 agreements = cntp ? *cntp : 0;
+        if (!(((prep_mask | commit_mask) >> my_id) & 1)) return Actions();
+        i32 my_digest = key_of(my_prepare_digest);
+        if (my_digest != my_key) return Actions();
+        if (agreements < ctx->iq) return Actions();
+
+        state = SeqState::PREPARED;
+        Actions actions = persisted->append(pe_p(seq_no, my_key));
+        actions.push_back(
+            act_send(ctx->cfg.nodes, mk_commit(seq_no, epoch, my_key)));
+        return actions;
+    }
+
+    void apply_commit_msg(i32 source, i32 dig) {
+        u64 bit = 1ull << source;
+        if (commit_mask & bit) return;  // duplicate
+        commit_mask |= bit;
+        i32 key = key_of(dig);
+        i64 *cnt = commits.get(key);
+        i64 count = cnt ? *cnt + 1 : 1;
+        commits.put(key, count);
+        if (state == SeqState::PREPARED && count >= ctx->iq)
+            check_commit_quorum();
+    }
+
+    void check_commit_quorum() {
+        i32 my_key = key_of(digest);
+        const i64 *cntp = commits.get(my_key);
+        i64 agreements = cntp ? *cntp : 0;
+        if (!((commit_mask >> my_id) & 1)) return;
+        if (agreements < ctx->iq) return;
+        state = SeqState::COMMITTED;
+    }
+};
+using SeqP = shared_ptr<Sequence>;
+
+// ---------------------------------------------------------------------------
+// Outstanding-request bookkeeping (statemachine/outstanding.py).
+// ---------------------------------------------------------------------------
+
+struct ClientOutstandingReqs {
+    i64 next_req_no;
+    i64 num_buckets;
+    ClientStateS client;
+
+    void skip_previously_committed() {
+        while (is_committed(next_req_no, client)) next_req_no += num_buckets;
+    }
+};
+
+struct AllOutstandingReqs {
+    shared_ptr<AppendList<AckS>> available_iterator;
+    std::unordered_set<AckS, AckHash> correct_requests;
+    std::unordered_map<AckS, SeqP, AckHash> outstanding_requests;
+    std::map<i64, std::map<i64, ClientOutstandingReqs>> buckets;
+
+    AllOutstandingReqs(shared_ptr<AppendList<AckS>> available_list,
+                       const NetStateS &network_state, const Ctx *ctx) {
+        available_list->reset_iterator();
+        available_iterator = std::move(available_list);
+        i64 num_buckets = ctx->cfg.nb;
+        for (i64 bucket = 0; bucket < num_buckets; bucket++) {
+            auto &clients = buckets[bucket];
+            for (const auto &client : network_state.clients) {
+                i64 lw = client.lw;
+                i64 first_uncommitted =
+                    lw + ((((bucket - client.id - lw) % num_buckets) +
+                           num_buckets) %
+                          num_buckets);
+                ClientOutstandingReqs cors{first_uncommitted, num_buckets,
+                                           client};
+                cors.skip_previously_committed();
+                clients.emplace(client.id, cors);
+            }
+        }
+        advance_requests();  // no sequences allocated yet -> no actions
+    }
+
+    Actions advance_requests() {
+        Actions actions;
+        while (available_iterator->has_next()) {
+            AckS ack = available_iterator->next();
+            auto it = outstanding_requests.find(ack);
+            if (it != outstanding_requests.end()) {
+                SeqP seq = it->second;
+                outstanding_requests.erase(it);
+                concat(actions, seq->satisfy_outstanding(ack));
+                continue;
+            }
+            correct_requests.insert(ack);
+        }
+        return actions;
+    }
+
+    Actions apply_acks(i64 bucket, const SeqP &seq, vector<AckS> batch) {
+        auto bit = buckets.find(bucket);
+        if (bit == buckets.end()) throw EngineError("no such bucket");
+        auto &clients = bit->second;
+
+        std::unordered_set<AckS, AckHash> outstanding;
+        for (const auto &req : batch) {
+            auto cit = clients.find(req.client);
+            if (cit == clients.end())
+                throw EngineError("fastengine: batch references unknown client");
+            ClientOutstandingReqs &co = cit->second;
+            if (co.next_req_no != req.reqno)
+                throw EngineError("fastengine: out-of-order batch req_no");
+            auto crit = correct_requests.find(req);
+            if (crit != correct_requests.end()) {
+                correct_requests.erase(crit);
+            } else {
+                outstanding_requests.emplace(req, seq);
+                outstanding.insert(req);
+            }
+            co.next_req_no += co.num_buckets;
+            co.skip_previously_committed();
+        }
+        return seq->allocate(std::move(batch), &outstanding);
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Active epoch (statemachine/epoch_active.py).
+// ---------------------------------------------------------------------------
+
+std::map<i64, i32> assign_buckets(const EpochCfgS &epoch_config,
+                                  const NetConfigS &cfg) {
+    std::set<i32> leaders(epoch_config.leaders.begin(),
+                          epoch_config.leaders.end());
+    std::map<i64, i32> buckets;
+    i64 overflow_index = 0;
+    const auto &nodes = cfg.nodes;
+    for (i64 i = 0; i < cfg.nb; i++) {
+        i32 natural = nodes[(size_t)((i + epoch_config.number) % (i64)nodes.size())];
+        if (leaders.count(natural)) {
+            buckets[i] = natural;
+        } else {
+            buckets[i] = epoch_config.leaders[(size_t)(
+                overflow_index % (i64)epoch_config.leaders.size())];
+            overflow_index += 1;
+        }
+    }
+    return buckets;
+}
+
+struct PreprepareBuffer {
+    i64 next_seq_no;
+    MsgBuffer buffer;
+};
+
+struct ActiveEpoch {
+    const Ctx *ctx;
+    EpochCfgS epoch_config;
+    InitParms my_config;
+    shared_ptr<AllOutstandingReqs> outstanding_reqs;
+    shared_ptr<Proposer> proposer;
+    PersistedLog *persisted;
+    CommitState *commit_state;
+    std::map<i64, i32> buckets;
+    deque<vector<SeqP>> sequences;
+    vector<PreprepareBuffer> preprepare_buffers;
+    std::map<i32, MsgBuffer> other_buffers;
+    vector<i64> lowest_unallocated;
+    i64 lowest_uncommitted;
+    i64 last_committed_at_tick = 0;
+    i64 ticks_since_progress = 0;
+    i64 buffered = 0;  // shared live count across this epoch's buffers
+    i64 nb, ci;
+    vector<i64> owned_buckets;
+
+    ActiveEpoch(const Ctx *c, const EpochCfgS &ecfg, PersistedLog *p,
+                NodeBuffers *node_buffers, CommitState *cs,
+                ClientTracker *client_tracker, InitParms mc)
+        : ctx(c), epoch_config(ecfg), my_config(mc), persisted(p),
+          commit_state(cs) {
+        i64 starting_seq_no = cs->highest_commit;
+        outstanding_reqs = std::make_shared<AllOutstandingReqs>(
+            client_tracker->available_list, *cs->active_state, c);
+        buckets = assign_buckets(ecfg, c->cfg);
+        nb = (i64)buckets.size();
+        ci = c->cfg.ci;
+        for (i64 b = 0; b < nb; b++)
+            if (buckets[b] == mc.id) owned_buckets.push_back(b);
+        lowest_unallocated.assign((size_t)nb, 0);
+        for (i64 i = 0; i < nb; i++) {
+            i64 first_seq_no = starting_seq_no + i + 1;
+            lowest_unallocated[(size_t)(first_seq_no % nb)] = first_seq_no;
+        }
+        lowest_uncommitted = cs->highest_commit + 1;
+        proposer = std::make_shared<Proposer>(
+            c, starting_seq_no, mc, client_tracker->ready_list, buckets);
+        for (i64 i = 0; i < nb; i++) {
+            PreprepareBuffer pb;
+            pb.next_seq_no = lowest_unallocated[(size_t)i];
+            pb.buffer.nb = node_buffers->node_buffer(buckets[i]);
+            pb.buffer.wire = &c->wire;
+            pb.buffer.group = &buffered;
+            preprepare_buffers.push_back(std::move(pb));
+        }
+        for (i32 node : c->cfg.nodes) {
+            MsgBuffer mb;
+            mb.nb = node_buffers->node_buffer(node);
+            mb.wire = &c->wire;
+            mb.group = &buffered;
+            other_buffers.emplace(node, std::move(mb));
+        }
+    }
+
+    i64 seq_to_bucket(i64 seq_no) const { return seq_no % nb; }
+    i64 low_watermark() const { return sequences.front()[0]->seq_no; }
+    i64 high_watermark() const {
+        if (sequences.empty()) return commit_state->low_watermark;
+        return sequences.back().back()->seq_no;
+    }
+    bool in_watermarks(i64 seq_no) const {
+        return !sequences.empty() && low_watermark() <= seq_no &&
+               seq_no <= high_watermark();
+    }
+
+    SeqP sequence(i64 seq_no) {
+        i64 index = (seq_no - low_watermark()) / ci;
+        i64 offset = (seq_no - low_watermark()) % ci;
+        SeqP seq = sequences[(size_t)index][(size_t)offset];
+        if (seq->seq_no != seq_no)
+            throw EngineError("sequence retrieved had unexpected seq_no");
+        return seq;
+    }
+
+    Applyable filter(i32 source, const MsgS &msg) {
+        if (msg.t == MT::Preprepare) {
+            i64 seq_no = msg.seq;
+            i64 bucket = seq_to_bucket(seq_no);
+            if (buckets[bucket] != source) return Applyable::INVALID;
+            if (seq_no > epoch_config.planned_expiration)
+                return Applyable::INVALID;
+            if (seq_no > high_watermark()) return Applyable::FUTURE;
+            if (seq_no < low_watermark()) return Applyable::PAST;
+            i64 next_preprepare = preprepare_buffers[(size_t)bucket].next_seq_no;
+            if (seq_no < next_preprepare) return Applyable::PAST;
+            if (seq_no > next_preprepare) return Applyable::FUTURE;
+            return Applyable::CURRENT;
+        }
+        if (msg.t == MT::Prepare) {
+            i64 seq_no = msg.seq;
+            i64 bucket = seq_to_bucket(seq_no);
+            if (buckets[bucket] == source) return Applyable::INVALID;
+            if (seq_no > epoch_config.planned_expiration)
+                return Applyable::INVALID;
+            if (seq_no < low_watermark()) return Applyable::PAST;
+            if (seq_no > high_watermark()) return Applyable::FUTURE;
+            return Applyable::CURRENT;
+        }
+        if (msg.t == MT::Commit) {
+            i64 seq_no = msg.seq;
+            if (seq_no > epoch_config.planned_expiration)
+                return Applyable::INVALID;
+            if (seq_no < low_watermark()) return Applyable::PAST;
+            if (seq_no > high_watermark()) return Applyable::FUTURE;
+            return Applyable::CURRENT;
+        }
+        throw EngineError("unexpected msg type in active epoch filter");
+    }
+
+    Actions apply(i32 source, const MsgP &msg) {
+        Actions actions;
+        if (msg->t == MT::Preprepare) {
+            i64 bucket = seq_to_bucket(msg->seq);
+            PreprepareBuffer &buffer = preprepare_buffers[(size_t)bucket];
+            MsgP next_msg = msg;
+            while (next_msg) {
+                concat(actions, apply_preprepare_msg(source, next_msg->seq,
+                                                     next_msg->acks));
+                buffer.next_seq_no += nb;
+                next_msg = buffer.buffer.next([this, source](const MsgS &m) {
+                    return filter(source, m);
+                });
+            }
+        } else if (msg->t == MT::Prepare) {
+            concat(actions,
+                   sequence(msg->seq)->apply_prepare_msg(source, msg->dig));
+        } else if (msg->t == MT::Commit) {
+            concat(actions, apply_commit_msg(source, msg->seq, msg->dig));
+        } else {
+            throw EngineError("unexpected msg type in active epoch apply");
+        }
+        return actions;
+    }
+
+    Actions step(i32 source, const MsgP &msg) {
+        if (msg->t == MT::Prepare) return step_prepare(source, msg);
+        if (msg->t == MT::Commit) return step_commit(source, msg);
+        Applyable verdict = filter(source, *msg);
+        if (verdict == Applyable::CURRENT) return apply(source, msg);
+        if (verdict == Applyable::FUTURE) {
+            if (msg->t == MT::Preprepare) {
+                i64 bucket = seq_to_bucket(msg->seq);
+                preprepare_buffers[(size_t)bucket].buffer.store(msg);
+            } else {
+                other_buffers.at(source).store(msg);
+            }
+        }
+        return Actions();
+    }
+
+    Actions step_prepare(i32 source, const MsgP &msg) {
+        i64 seq_no = msg->seq;
+        if (buckets[seq_no % nb] == source) return Actions();  // INVALID
+        if (seq_no > epoch_config.planned_expiration) return Actions();
+        i64 low = sequences.front()[0]->seq_no;
+        if (seq_no < low) return Actions();  // PAST
+        if (seq_no > sequences.back().back()->seq_no) {
+            other_buffers.at(source).store(msg);  // FUTURE
+            return Actions();
+        }
+        i64 offset = seq_no - low;
+        SeqP seq = sequences[(size_t)(offset / ci)][(size_t)(offset % ci)];
+        return seq->apply_prepare_msg(source, msg->dig);
+    }
+
+    Actions step_commit(i32 source, const MsgP &msg) {
+        i64 seq_no = msg->seq;
+        if (seq_no > epoch_config.planned_expiration) return Actions();
+        i64 low = sequences.front()[0]->seq_no;
+        if (seq_no < low) return Actions();  // PAST
+        i64 high = sequences.back().back()->seq_no;
+        if (seq_no > high) {
+            other_buffers.at(source).store(msg);  // FUTURE
+            return Actions();
+        }
+        i64 offset = seq_no - low;
+        SeqP seq = sequences[(size_t)(offset / ci)][(size_t)(offset % ci)];
+        seq->apply_commit_msg(source, msg->dig);
+        if (seq->state != SeqState::COMMITTED || seq_no != lowest_uncommitted)
+            return Actions();
+        commit_cascade();
+        return Actions();
+    }
+
+    // Envelope vote application — replicates the Python native-plane path
+    // (voteplane.py + ackplane.cpp seq_apply_core + machine.py MsgBatch arm):
+    // Phase A applies every vote's mask/count update in envelope order,
+    // recording fallbacks (wrong epoch / future) and transition hints;
+    // Phase B runs the records in order, re-validating each quorum.
+    template <typename StepFn>
+    Actions apply_envelope_votes(const vector<MsgP> &votes, i32 source,
+                                 StepFn &&generic_step) {
+        struct Rec {
+            bool fallback;
+            size_t idx;
+            int kind;
+            i64 seq;
+        };
+        vector<Rec> records;
+        for (size_t k = 0; k < votes.size(); k++) {
+            const MsgS &m = *votes[k];
+            int kind = m.t == MT::Prepare ? 0 : 1;
+            if (m.epoch != epoch_config.number) {
+                records.push_back({true, k, 0, 0});
+                continue;
+            }
+            i64 seq_no = m.seq;
+            i64 low = sequences.front()[0]->seq_no;
+            if (seq_no < low) continue;  // PAST
+            if (kind == 0 && nb > 0 && buckets[seq_no % nb] == source)
+                continue;  // INVALID: owners never send Prepare
+            if (seq_no > epoch_config.planned_expiration) continue;  // INVALID
+            if (seq_no > sequences.back().back()->seq_no) {
+                records.push_back({true, k, 0, 0});  // FUTURE
+                continue;
+            }
+            i64 offset = seq_no - low;
+            Sequence &s =
+                *sequences[(size_t)(offset / ci)][(size_t)(offset % ci)];
+            u64 bit = 1ull << source;
+            i32 key = s.key_of(m.dig);
+            i32 expected = s.key_of(s.digest);
+            bool matches = key == expected;
+            bool hint = false;
+            if (kind == 0) {
+                if ((s.prep_mask | s.commit_mask) & bit) continue;  // dup
+                s.prep_mask |= bit;
+                if (source == s.my_id) s.my_prepare_digest = m.dig;
+                i64 *cnt = s.prepares.get(key);
+                i64 n = cnt ? *cnt + 1 : 1;
+                s.prepares.put(key, n);
+                if (s.state == SeqState::PREPREPARED) {
+                    if (matches && n >= ctx->iq) hint = true;
+                } else if (s.state == SeqState::READY ||
+                           s.state == SeqState::PENDING_REQUESTS) {
+                    hint = true;
+                }
+            } else {
+                if (s.commit_mask & bit) continue;  // dup
+                s.commit_mask |= bit;
+                i64 *cnt = s.commits.get(key);
+                i64 n = cnt ? *cnt + 1 : 1;
+                s.commits.put(key, n);
+                if (s.state == SeqState::PREPARED && matches && n >= ctx->iq)
+                    hint = true;
+            }
+            if (hint) records.push_back({false, k, kind, seq_no});
+        }
+        Actions actions;
+        for (const Rec &rec : records) {
+            if (rec.fallback) {
+                concat(actions, generic_step(source, votes[rec.idx]));
+                continue;
+            }
+            SeqP seq = sequence(rec.seq);
+            if (rec.kind == 0) {
+                SeqState s = seq->state;
+                if (s == SeqState::PREPREPARED || s == SeqState::READY ||
+                    s == SeqState::PENDING_REQUESTS)
+                    concat(actions, seq->advance_state());
+            } else {
+                seq->check_commit_quorum();
+            }
+            if (seq->state == SeqState::COMMITTED &&
+                seq->seq_no == lowest_uncommitted)
+                commit_cascade();
+        }
+        return actions;
+    }
+
+    void commit_cascade() {
+        i64 low = sequences.front()[0]->seq_no;
+        i64 high = sequences.back().back()->seq_no;
+        i64 lowest = lowest_uncommitted;
+        while (lowest <= high) {
+            i64 offset = lowest - low;
+            SeqP seq = sequences[(size_t)(offset / ci)][(size_t)(offset % ci)];
+            if (seq->state != SeqState::COMMITTED) break;
+            commit_state->commit(seq->q_entry);
+            lowest += 1;
+        }
+        lowest_uncommitted = lowest;
+    }
+
+    Actions apply_preprepare_msg(i32 source, i64 seq_no, vector<AckS> batch) {
+        SeqP seq = sequence(seq_no);
+        if (seq->owner == my_config.id)
+            return seq->apply_prepare_msg(source, seq->digest);
+        i64 bucket = seq_to_bucket(seq_no);
+        if (seq_no != lowest_unallocated[(size_t)bucket])
+            throw EngineError("step should defer all but the next expected preprepare");
+        lowest_unallocated[(size_t)bucket] += nb;
+        return outstanding_reqs->apply_acks(bucket, seq, std::move(batch));
+    }
+
+    Actions apply_commit_msg(i32 source, i64 seq_no, i32 dig) {
+        SeqP seq = sequence(seq_no);
+        seq->apply_commit_msg(source, dig);
+        if (seq->state != SeqState::COMMITTED || seq_no != lowest_uncommitted)
+            return Actions();
+        commit_cascade();
+        return Actions();
+    }
+
+    Actions apply_batch_hash_result(i64 seq_no, i32 digest) {
+        if (!in_watermarks(seq_no)) return Actions();
+        return sequence(seq_no)->apply_batch_hash_result(digest);
+    }
+
+    // move_low_watermark -> (actions, epoch_done)
+    std::pair<Actions, bool> move_low_watermark(i64 seq_no) {
+        if (seq_no == epoch_config.planned_expiration)
+            return {Actions(), true};
+        if (seq_no == commit_state->stop_at_seq_no) return {Actions(), true};
+        Actions actions = advance();
+        while (seq_no > low_watermark()) sequences.pop_front();
+        return {std::move(actions), false};
+    }
+
+    Actions drain_buffers() {
+        Actions actions;
+        if (!buffered) return actions;
+        for (i64 bucket = 0; bucket < nb; bucket++) {
+            PreprepareBuffer &buffer = preprepare_buffers[(size_t)bucket];
+            if (buffer.buffer.empty()) continue;
+            i32 source = buckets[bucket];
+            MsgP next_msg = buffer.buffer.next(
+                [this, source](const MsgS &m) { return filter(source, m); });
+            if (!next_msg) continue;
+            concat(actions, apply(source, next_msg));
+        }
+        for (i32 node : ctx->cfg.nodes) {
+            MsgBuffer &other = other_buffers.at(node);
+            if (other.empty()) continue;
+            other.iterate(
+                [this, node](const MsgS &m) { return filter(node, m); },
+                [this, node, &actions](MsgP m) {
+                    concat(actions, apply(node, m));
+                });
+        }
+        return actions;
+    }
+
+    bool needs_advance() {
+        i64 hw = high_watermark();
+        if (hw < epoch_config.planned_expiration &&
+            hw < commit_state->stop_at_seq_no)
+            return true;
+        if (buffered) return true;
+        if (proposer->ready_iterator->has_next()) return true;
+        for (i64 bucket : owned_buckets) {
+            i64 seq_no = lowest_unallocated[(size_t)bucket];
+            if (seq_no <= hw &&
+                proposer->proposal_bucket(bucket)->has_pending(seq_no))
+                return true;
+        }
+        return false;
+    }
+
+    Actions advance() {
+        Actions actions;
+        if (high_watermark() > epoch_config.planned_expiration)
+            throw EngineError("window extends beyond planned expiration");
+        if (high_watermark() > commit_state->stop_at_seq_no)
+            throw EngineError("window extends beyond the stop sequence");
+
+        while (high_watermark() < epoch_config.planned_expiration &&
+               high_watermark() < commit_state->stop_at_seq_no) {
+            i64 base = high_watermark() + 1;
+            concat(actions, persisted->append(pe_n(base, epoch_config)));
+            vector<SeqP> chunk;
+            for (i64 i = 0; i < ci; i++) {
+                chunk.push_back(std::make_shared<Sequence>(
+                    ctx, buckets[seq_to_bucket(base + i)],
+                    epoch_config.number, base + i, persisted, my_config.id));
+            }
+            sequences.push_back(std::move(chunk));
+        }
+
+        concat(actions, drain_buffers());
+        proposer->advance(lowest_uncommitted);
+
+        for (i64 bucket : owned_buckets) {
+            ProposalBucket *prb = proposer->proposal_bucket(bucket);
+            while (true) {
+                i64 seq_no = lowest_unallocated[(size_t)bucket];
+                if (seq_no > high_watermark()) break;
+                if (!prb->has_pending(seq_no)) break;
+                SeqP seq = sequence(seq_no);
+                concat(actions, seq->allocate_as_owner(prb->next()));
+                lowest_unallocated[(size_t)bucket] += nb;
+            }
+        }
+        return actions;
+    }
+
+    Actions tick() {
+        if (last_committed_at_tick < commit_state->highest_commit) {
+            last_committed_at_tick = commit_state->highest_commit;
+            ticks_since_progress = 0;
+            return Actions();
+        }
+        ticks_since_progress += 1;
+        Actions actions;
+
+        if (ticks_since_progress > my_config.suspect_ticks) {
+            actions.push_back(act_send(ctx->cfg.nodes,
+                                       mk_suspect(epoch_config.number)));
+            concat(actions, persisted->append(pe_suspect(epoch_config.number)));
+        }
+        if (my_config.heartbeat_ticks == 0 ||
+            ticks_since_progress % my_config.heartbeat_ticks != 0)
+            return actions;
+
+        for (i64 bucket : owned_buckets) {
+            i64 unallocated_seq_no = lowest_unallocated[(size_t)bucket];
+            if (unallocated_seq_no > high_watermark()) continue;
+            SeqP seq = sequence(unallocated_seq_no);
+            ProposalBucket *prb = proposer->proposal_bucket(bucket);
+            vector<CRP> client_reqs;
+            if (prb->has_outstanding(unallocated_seq_no))
+                client_reqs = prb->next();
+            concat(actions, seq->allocate_as_owner(std::move(client_reqs)));
+            lowest_unallocated[(size_t)bucket] += nb;
+        }
+        return actions;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Epoch-change parsing and ack accumulation (statemachine/epoch_change.py).
+// ---------------------------------------------------------------------------
+
+struct ParsedEC {
+    EpochChangeP underlying;
+    i64 low_watermark = 0;
+    std::map<i64, ECSetEntryS> p_set;              // seq -> entry
+    std::map<i64, std::map<i64, i32>> q_set;       // seq -> epoch -> digest
+    std::set<i32> acks;
+};
+using ParsedECP = shared_ptr<ParsedEC>;
+
+// try_parse (raising variant returns nullptr on malformed content).
+ParsedECP try_parse_epoch_change(const EpochChangeP &underlying) {
+    if (underlying->checkpoints.empty()) return nullptr;
+    auto out = std::make_shared<ParsedEC>();
+    out->underlying = underlying;
+    out->low_watermark = underlying->checkpoints[0].first;
+    std::set<i64> seen_cp;
+    for (const auto &cp : underlying->checkpoints) {
+        out->low_watermark = std::min(out->low_watermark, cp.first);
+        if (seen_cp.count(cp.first)) return nullptr;
+        seen_cp.insert(cp.first);
+    }
+    for (const auto &e : underlying->p_set) {
+        if (out->p_set.count(e.seq)) return nullptr;
+        out->p_set.emplace(e.seq, e);
+    }
+    for (const auto &e : underlying->q_set) {
+        auto &views = out->q_set[e.seq];
+        if (views.count(e.epoch)) return nullptr;
+        views.emplace(e.epoch, e.dig);
+    }
+    return out;
+}
+
+struct EpochChangeVotes {
+    // (digest, parsed) insertion-ordered.
+    vector<std::pair<i32, ParsedECP>> parsed_by_digest;
+    i32 strong_cert = -1;
+
+    ParsedECP get(i32 digest) const {
+        for (const auto &pr : parsed_by_digest)
+            if (pr.first == digest) return pr.second;
+        return nullptr;
+    }
+
+    void add_ack(i32 source, const EpochChangeP &msg, i32 digest, i64 iq) {
+        ParsedECP parsed = get(digest);
+        if (!parsed) {
+            parsed = try_parse_epoch_change(msg);
+            if (!parsed) return;  // malformed; drop
+            parsed_by_digest.emplace_back(digest, parsed);
+        }
+        parsed->acks.insert(source);
+        if (strong_cert == -1 && (i64)parsed->acks.size() >= iq)
+            strong_cert = digest;
+    }
+};
+
+// construct_new_epoch_config (statemachine/stateless.py:164-315).
+NewEpochCfgP construct_new_epoch_config(
+    const Ctx *ctx, const vector<i32> &new_leaders,
+    const std::map<i32, ParsedECP> &epoch_changes) {
+    const NetConfigS &config = ctx->cfg;
+    // (seq, value) -> supporters, insertion-ordered.
+    vector<std::pair<std::pair<i64, i32>, vector<i32>>> checkpoint_supporters;
+    i64 new_epoch_number = 0;
+    for (i32 node : config.nodes) {
+        auto it = epoch_changes.find(node);
+        if (it == epoch_changes.end()) continue;
+        const ParsedEC &ec = *it->second;
+        new_epoch_number = ec.underlying->new_epoch;
+        std::set<std::pair<i64, i32>> seen;
+        for (const auto &cp : ec.underlying->checkpoints) {
+            std::pair<i64, i32> key(cp.first, cp.second);
+            if (seen.count(key)) continue;
+            seen.insert(key);
+            bool found = false;
+            for (auto &pr : checkpoint_supporters)
+                if (pr.first == key) {
+                    pr.second.push_back(node);
+                    found = true;
+                    break;
+                }
+            if (!found)
+                checkpoint_supporters.emplace_back(key, vector<i32>{node});
+        }
+    }
+
+    bool have_max = false;
+    std::pair<i64, i32> max_checkpoint{0, 0};
+    for (const auto &pr : checkpoint_supporters) {
+        if ((i64)pr.second.size() < ctx->wq) continue;
+        i64 lower_watermarks = 0;
+        for (const auto &ec : epoch_changes)
+            if (ec.second->low_watermark <= pr.first.first) lower_watermarks++;
+        if (lower_watermarks < ctx->iq) continue;
+        if (!have_max) {
+            max_checkpoint = pr.first;
+            have_max = true;
+            continue;
+        }
+        if (max_checkpoint.first > pr.first.first) continue;
+        if (max_checkpoint.first == pr.first.first)
+            throw EngineError("two correct quorums disagree on checkpoint value");
+        max_checkpoint = pr.first;
+    }
+    if (!have_max) return nullptr;
+
+    i64 cp_seq = max_checkpoint.first;
+    i32 cp_value = max_checkpoint.second;
+    i64 window = 2 * config.ci;
+    vector<i32> final_preprepares((size_t)window, 0);
+    bool any_selected = false;
+
+    vector<vector<const ECSetEntryS *>> candidates((size_t)window);
+    vector<i64> entry_counts((size_t)window, 0);
+    for (i32 node : config.nodes) {
+        auto it = epoch_changes.find(node);
+        if (it == epoch_changes.end()) continue;
+        const ParsedEC &node_ec = *it->second;
+        i64 lw = node_ec.low_watermark;
+        for (const auto &pr : node_ec.p_set) {
+            i64 p_off = pr.first - cp_seq - 1;
+            if (0 <= p_off && p_off < window) {
+                candidates[(size_t)p_off].push_back(&pr.second);
+                if (lw < pr.first) entry_counts[(size_t)p_off]++;
+            }
+        }
+    }
+    vector<i64> sorted_lws;
+    for (const auto &ec : epoch_changes)
+        sorted_lws.push_back(ec.second->low_watermark);
+    std::sort(sorted_lws.begin(), sorted_lws.end());
+
+    for (i64 offset = 0; offset < window; offset++) {
+        i64 seq_no = cp_seq + 1 + offset;
+        const ECSetEntryS *selected = nullptr;
+        for (const ECSetEntryS *entry : candidates[(size_t)offset]) {
+            i64 a1 = 0;
+            for (const auto &opr : epoch_changes) {
+                const ParsedEC &other = *opr.second;
+                if (other.low_watermark >= seq_no) continue;
+                auto oit = other.p_set.find(seq_no);
+                if (oit == other.p_set.end() ||
+                    oit->second.epoch < entry->epoch) {
+                    a1++;
+                    continue;
+                }
+                if (oit->second.epoch > entry->epoch) continue;
+                if (oit->second.dig == entry->dig) a1++;
+            }
+            if (a1 < ctx->iq) continue;
+            i64 a2 = 0;
+            for (const auto &opr : epoch_changes) {
+                const ParsedEC &other = *opr.second;
+                auto qit = other.q_set.find(seq_no);
+                if (qit == other.q_set.end() || qit->second.empty()) continue;
+                for (const auto &ed : qit->second) {
+                    if (ed.first >= entry->epoch && ed.second == entry->dig) {
+                        a2++;
+                        break;
+                    }
+                }
+            }
+            if (a2 < ctx->wq) continue;
+            selected = entry;
+            break;
+        }
+        if (selected) {
+            final_preprepares[(size_t)offset] = selected->dig;
+            any_selected = true;
+            continue;
+        }
+        i64 b_count =
+            (i64)(std::lower_bound(sorted_lws.begin(), sorted_lws.end(),
+                                   seq_no) -
+                  sorted_lws.begin()) -
+            entry_counts[(size_t)offset];
+        if (b_count < ctx->iq) return nullptr;
+    }
+
+    auto out = std::make_shared<NewEpochCfgS>();
+    out->config.number = new_epoch_number;
+    out->config.leaders = new_leaders;
+    out->config.planned_expiration = cp_seq + config.mel;
+    out->cp_seq = cp_seq;
+    out->cp_value = cp_value;
+    if (any_selected) out->final_preprepares = std::move(final_preprepares);
+    return out;
+}
+
+// ---------------------------------------------------------------------------
+// Epoch target: the 11-state lifecycle machine (statemachine/epoch_target.py).
+// ---------------------------------------------------------------------------
+
+struct ETS {
+    enum V {
+        PREPENDING = 0, PENDING = 1, VERIFYING = 2, FETCHING = 3,
+        ECHOING = 4, READYING = 5, RESUMING = 6, READY = 7,
+        IN_PROGRESS = 8, ENDING = 9, DONE = 10,
+    };
+};
+
+struct EpochTarget {
+    const Ctx *ctx;
+    int state = ETS::PREPENDING;
+    CommitState *commit_state;
+    i64 state_ticks = 0;
+    i64 number;
+    i64 starting_seq_no = 0;
+    std::map<i32, EpochChangeVotes> changes;
+    std::map<i32, ParsedECP> strong_changes;
+    vector<std::pair<NewEpochCfgP, std::set<i32>>> echos, readies;
+    shared_ptr<ActiveEpoch> active_epoch;
+    std::set<i32> suspicions;
+    MsgP my_new_epoch;              // NewEpoch message (nullptr = None)
+    ParsedECP my_epoch_change;
+    vector<i32> my_leader_choice;
+    bool have_leader_choice = false;
+    MsgP leader_new_epoch;          // NewEpoch message
+    NewEpochCfgP network_new_epoch;
+    bool is_primary;
+    std::map<i32, MsgBuffer> prestart_buffers;
+    PersistedLog *persisted;
+    NodeBuffers *node_buffers;
+    ClientTracker *client_tracker;
+    Disseminator *client_hash_disseminator;
+    BatchTracker *batch_tracker;
+    InitParms my_config;
+    // content-key -> (digest or -1 pending, waiters (source, origin))
+    std::unordered_map<string, std::pair<i32, vector<std::pair<i32, i32>>>>
+        ec_digests;
+
+    EpochTarget(const Ctx *c, i64 num, PersistedLog *p, NodeBuffers *nbufs,
+                CommitState *cs, ClientTracker *ct, Disseminator *dis,
+                BatchTracker *bt, InitParms mc)
+        : ctx(c), commit_state(cs), number(num), persisted(p),
+          node_buffers(nbufs), client_tracker(ct),
+          client_hash_disseminator(dis), batch_tracker(bt), my_config(mc) {
+        is_primary = num % (i64)c->cfg.nodes.size() == mc.id;
+        for (i32 node : c->cfg.nodes) {
+            MsgBuffer mb;
+            mb.nb = nbufs->node_buffer(node);
+            mb.wire = &c->wire;
+            prestart_buffers.emplace(node, std::move(mb));
+        }
+    }
+
+    Actions step(i32 source, const MsgP &msg) {
+        if (state < ETS::IN_PROGRESS) {
+            prestart_buffers.at(source).store(msg);
+            return Actions();
+        }
+        if (state == ETS::DONE) return Actions();
+        return active_epoch->step(source, msg);
+    }
+
+    MsgP construct_new_epoch(const vector<i32> &new_leaders) {
+        if ((i64)strong_changes.size() < ctx->iq)
+            throw EngineError("need more acked epoch changes");
+        NewEpochCfgP new_config =
+            construct_new_epoch_config(ctx, new_leaders, strong_changes);
+        if (!new_config) return nullptr;
+        auto m = std::make_shared<MsgS>();
+        m->t = MT::NewEpoch;
+        m->necfg = new_config;
+        for (i32 node : ctx->cfg.nodes) {
+            if (!strong_changes.count(node)) continue;
+            m->remote_changes.emplace_back(node,
+                                           changes.at(node).strong_cert);
+        }
+        return m;
+    }
+
+    void verify_new_epoch_state() {
+        std::map<i32, ParsedECP> epoch_changes;
+        for (const auto &remote : leader_new_epoch->remote_changes) {
+            if (epoch_changes.count(remote.first)) return;  // malformed
+            auto vit = changes.find(remote.first);
+            if (vit == changes.end()) return;
+            ParsedECP parsed = vit->second.get(remote.second);
+            if (!parsed || (i64)parsed->acks.size() < ctx->wq) return;
+            epoch_changes.emplace(remote.first, parsed);
+        }
+        NewEpochCfgP reconstructed = construct_new_epoch_config(
+            ctx, leader_new_epoch->necfg->config.leaders, epoch_changes);
+        if (!reconstructed || !(*reconstructed == *leader_new_epoch->necfg))
+            return;  // byzantine primary
+        state = ETS::FETCHING;
+    }
+
+    Actions fetch_new_epoch_state() {
+        const NewEpochCfgS &nec = *leader_new_epoch->necfg;
+        if (commit_state->transferring) return Actions();
+        if (nec.cp_seq > commit_state->highest_commit)
+            throw EngineError("fastengine: state transfer outside envelope");
+
+        Actions actions;
+        bool fetch_pending = false;
+        for (size_t i = 0; i < nec.final_preprepares.size(); i++) {
+            i32 digest = nec.final_preprepares[i];
+            if (digest == 0) continue;  // null request
+            i64 seq_no = (i64)i + nec.cp_seq + 1;
+            if (seq_no <= commit_state->highest_commit) continue;
+
+            vector<i32> sources;
+            for (const auto &remote : leader_new_epoch->remote_changes) {
+                ParsedECP parsed = changes.at(remote.first).get(remote.second);
+                auto qit = parsed->q_set.find(seq_no);
+                if (qit == parsed->q_set.end()) continue;
+                for (const auto &ed : qit->second)
+                    if (ed.second == digest) {
+                        sources.push_back(remote.first);
+                        break;
+                    }
+            }
+            if ((i64)sources.size() < ctx->wq)
+                throw EngineError("too few sources for new-epoch batch");
+
+            BatchRec *batch = batch_tracker->get_batch_mut(digest);
+            if (!batch) {
+                concat(actions,
+                       batch_tracker->fetch_batch(seq_no, digest, sources));
+                fetch_pending = true;
+                continue;
+            }
+            batch->observed_for.insert(seq_no);
+            for (const auto &request_ack : batch->request_acks) {
+                CRP cr;
+                for (i32 node : sources)
+                    cr = client_hash_disseminator->ack(actions, node,
+                                                       request_ack, true);
+                if (cr->stored) continue;
+                fetch_pending = true;
+                concat(actions, cr->fetch());
+                client_hash_disseminator->note_fetching(request_ack);
+            }
+        }
+        if (fetch_pending) return actions;
+        if (nec.cp_seq > commit_state->low_watermark) return actions;
+
+        state = ETS::ECHOING;
+        if (nec.cp_seq == commit_state->stop_at_seq_no &&
+            !nec.final_preprepares.empty())
+            throw EngineError(
+                "fastengine: new-epoch spanning a reconfiguration boundary");
+
+        concat(actions,
+               persisted->append(pe_n(nec.cp_seq + 1, nec.config)));
+        for (size_t i = 0; i < nec.final_preprepares.size(); i++) {
+            i32 digest = nec.final_preprepares[i];
+            i64 seq_no = (i64)i + nec.cp_seq + 1;
+            if (digest == 0) {
+                auto q = std::make_shared<QEntryS>();
+                q->seq = seq_no;
+                q->dig = 0;
+                concat(actions, persisted->append(pe_q(q)));
+                continue;
+            }
+            const BatchRec *batch = batch_tracker->get_batch(digest);
+            if (!batch)
+                throw EngineError("batch verified above is now missing");
+            auto q = std::make_shared<QEntryS>();
+            q->seq = seq_no;
+            q->dig = digest;
+            q->reqs = batch->request_acks;
+            concat(actions, persisted->append(pe_q(q)));
+            if (seq_no % ctx->cfg.ci == 0 &&
+                seq_no < commit_state->stop_at_seq_no)
+                concat(actions,
+                       persisted->append(pe_n(seq_no + 1, nec.config)));
+        }
+        starting_seq_no = nec.cp_seq + (i64)nec.final_preprepares.size() + 1;
+
+        auto echo = std::make_shared<MsgS>();
+        echo->t = MT::NewEpochEcho;
+        echo->necfg = leader_new_epoch->necfg;
+        actions.push_back(act_send(ctx->cfg.nodes, echo));
+        return actions;
+    }
+
+    Actions repeat_epoch_change_broadcast() {
+        auto m = std::make_shared<MsgS>();
+        m->t = MT::EpochChange;
+        m->ec = my_epoch_change->underlying;
+        Actions a;
+        a.push_back(act_send(ctx->cfg.nodes, m));
+        return a;
+    }
+
+    Actions tick_prepending() {
+        if (!my_new_epoch) {
+            i64 half = my_config.new_epoch_timeout_ticks / 2;
+            if (half && state_ticks % half == 0 && my_epoch_change)
+                return repeat_epoch_change_broadcast();
+            return Actions();
+        }
+        if (is_primary) {
+            Actions a;
+            a.push_back(act_send(ctx->cfg.nodes, my_new_epoch));
+            return a;
+        }
+        return Actions();
+    }
+
+    Actions tick_pending() {
+        if (!my_new_epoch || !my_epoch_change) return Actions();
+        i64 pending_ticks = state_ticks % my_config.new_epoch_timeout_ticks;
+        if (is_primary) {
+            if (pending_ticks % 2 == 0) {
+                Actions a;
+                a.push_back(act_send(ctx->cfg.nodes, my_new_epoch));
+                return a;
+            }
+        } else {
+            if (pending_ticks == 0) {
+                Actions a;
+                a.push_back(act_send(
+                    ctx->cfg.nodes,
+                    mk_suspect(my_new_epoch->necfg->config.number)));
+                concat(a, persisted->append(
+                              pe_suspect(my_new_epoch->necfg->config.number)));
+                return a;
+            }
+            if (pending_ticks % 2 == 0) return repeat_epoch_change_broadcast();
+        }
+        return Actions();
+    }
+
+    Actions tick() {
+        state_ticks += 1;
+        if (state == ETS::PREPENDING) return tick_prepending();
+        if (state <= ETS::RESUMING) return tick_pending();
+        if (state <= ETS::IN_PROGRESS) return active_epoch->tick();
+        return Actions();
+    }
+
+    Actions apply_epoch_change_msg(i32 source, const MsgP &msg) {
+        Actions actions;
+        if (source != my_config.id) {
+            auto ack = std::make_shared<MsgS>();
+            ack->t = MT::EpochChangeAck;
+            ack->originator = source;
+            ack->ec = msg->ec;
+            actions.push_back(act_send(ctx->cfg.nodes, ack));
+        }
+        concat(actions, apply_epoch_change_ack_msg(source, source, msg->ec));
+        return actions;
+    }
+
+    Actions apply_epoch_change_ack_msg(i32 source, i32 origin,
+                                       const EpochChangeP &ec) {
+        vector<string> parts = ec_hash_data(ctx->intern, *ec);
+        string key = join_with_lengths(parts);
+        auto it = ec_digests.find(key);
+        if (it != ec_digests.end()) {
+            if (it->second.first != -1)
+                return apply_ec_digest(source, origin, ec, it->second.first);
+            it->second.second.emplace_back(source, origin);
+            return Actions();
+        }
+        ec_digests.emplace(key,
+                           std::make_pair(-1, vector<std::pair<i32, i32>>()));
+        HashOriginS ho;
+        ho.t = OT::EpochChange;
+        ho.source = source;
+        ho.origin = origin;
+        ho.ec = ec;
+        Actions actions;
+        actions.push_back(act_hash(std::move(parts), std::move(ho)));
+        return actions;
+    }
+
+    Actions apply_epoch_change_digest(const HashOriginS &origin, i32 digest) {
+        const EpochChangeP &msg = origin.ec;
+        string key = join_with_lengths(ec_hash_data(ctx->intern, *msg));
+        vector<std::pair<i32, i32>> waiters;
+        auto it = ec_digests.find(key);
+        if (it != ec_digests.end() && it->second.first == -1)
+            waiters = std::move(it->second.second);
+        ec_digests[key] = std::make_pair(digest, vector<std::pair<i32, i32>>());
+        Actions actions =
+            apply_ec_digest(origin.source, origin.origin, msg, digest);
+        for (const auto &w : waiters)
+            concat(actions, apply_ec_digest(w.first, w.second, msg, digest));
+        return actions;
+    }
+
+    Actions apply_ec_digest(i32 source_node, i32 origin_node,
+                            const EpochChangeP &msg, i32 digest) {
+        EpochChangeVotes &votes = changes[origin_node];
+        votes.add_ack(source_node, msg, digest, ctx->iq);
+        if (votes.strong_cert != -1 && !strong_changes.count(origin_node)) {
+            strong_changes.emplace(origin_node, votes.get(votes.strong_cert));
+            return advance_state();
+        }
+        return Actions();
+    }
+
+    Actions check_epoch_quorum() {
+        if ((i64)strong_changes.size() < ctx->iq || !my_epoch_change)
+            return Actions();
+        my_new_epoch = construct_new_epoch(my_leader_choice);
+        if (!my_new_epoch) return Actions();
+        state_ticks = 0;
+        state = ETS::PENDING;
+        if (is_primary) {
+            Actions a;
+            a.push_back(act_send(ctx->cfg.nodes, my_new_epoch));
+            return a;
+        }
+        return Actions();
+    }
+
+    Actions apply_new_epoch_msg(const MsgP &msg) {
+        leader_new_epoch = msg;
+        return advance_state();
+    }
+
+    std::set<i32> *cfg_set(vector<std::pair<NewEpochCfgP, std::set<i32>>> &m,
+                           const NewEpochCfgP &config) {
+        for (auto &pr : m)
+            if (*pr.first == *config) return &pr.second;
+        m.emplace_back(config, std::set<i32>());
+        return &m.back().second;
+    }
+
+    Actions apply_new_epoch_echo_msg(i32 source, const NewEpochCfgP &config) {
+        cfg_set(echos, config)->insert(source);
+        return advance_state();
+    }
+
+    Actions check_new_epoch_echo_quorum() {
+        Actions actions;
+        for (auto &pr : echos) {
+            if ((i64)pr.second.size() < ctx->iq) continue;
+            state = ETS::READYING;
+            const NewEpochCfgS &config = *pr.first;
+            for (size_t i = 0; i < config.final_preprepares.size(); i++) {
+                i64 seq_no = (i64)i + config.cp_seq + 1;
+                concat(actions,
+                       persisted->append(
+                           pe_p(seq_no, config.final_preprepares[i])));
+            }
+            auto ready = std::make_shared<MsgS>();
+            ready->t = MT::NewEpochReady;
+            ready->necfg = pr.first;
+            actions.push_back(act_send(ctx->cfg.nodes, ready));
+            return actions;
+        }
+        return actions;
+    }
+
+    Actions apply_new_epoch_ready_msg(i32 source, const NewEpochCfgP &config) {
+        if (state > ETS::READYING) return Actions();
+        std::set<i32> *rs = cfg_set(readies, config);
+        rs->insert(source);
+        if ((i64)rs->size() < ctx->wq) return Actions();
+        if (state < ETS::ECHOING) return advance_state();
+        if (state < ETS::READYING) {
+            state = ETS::READYING;
+            auto ready = std::make_shared<MsgS>();
+            ready->t = MT::NewEpochReady;
+            ready->necfg = config;
+            Actions a;
+            a.push_back(act_send(ctx->cfg.nodes, ready));
+            return a;
+        }
+        return advance_state();
+    }
+
+    void check_new_epoch_ready_quorum() {
+        for (auto &pr : readies) {
+            if ((i64)pr.second.size() < ctx->iq) continue;
+            state = ETS::RESUMING;
+            network_new_epoch = pr.first;
+
+            bool current_epoch = false;
+            for (const auto &e : persisted->entries) {
+                if (e.second->t == PET::Q) {
+                    if (current_epoch) commit_state->commit(e.second->q);
+                } else if (e.second->t == PET::EC) {
+                    if (e.second->num < pr.first->config.number) continue;
+                    if (pr.first->config.number < e.second->num)
+                        throw EngineError(
+                            "epoch change entries cannot exceed the target epoch");
+                    current_epoch = true;
+                }
+            }
+        }
+    }
+
+    void check_epoch_resumed() {
+        if (commit_state->stop_at_seq_no < starting_seq_no) return;
+        if (commit_state->low_watermark + 1 != starting_seq_no) return;
+        state = ETS::READY;
+    }
+
+    template <typename StepFn>
+    Actions advance_state_with(StepFn &&generic_step_unused) {
+        return advance_state();
+    }
+
+    Actions advance_state() {
+        if (state == ETS::IN_PROGRESS) {
+            ActiveEpoch *ae = active_epoch.get();
+            if (!ae->outstanding_reqs->available_iterator->has_next() &&
+                !ae->needs_advance())
+                return Actions();
+        }
+        Actions actions;
+        while (true) {
+            int old_state = state;
+            if (state == ETS::PREPENDING) {
+                concat(actions, check_epoch_quorum());
+            } else if (state == ETS::PENDING) {
+                if (!leader_new_epoch) return actions;
+                state = ETS::VERIFYING;
+            } else if (state == ETS::VERIFYING) {
+                verify_new_epoch_state();
+            } else if (state == ETS::FETCHING) {
+                concat(actions, fetch_new_epoch_state());
+            } else if (state == ETS::ECHOING) {
+                concat(actions, check_new_epoch_echo_quorum());
+            } else if (state == ETS::READYING) {
+                check_new_epoch_ready_quorum();
+            } else if (state == ETS::RESUMING) {
+                check_epoch_resumed();
+            } else if (state == ETS::READY) {
+                const EpochCfgS &epoch_config = network_new_epoch
+                                                    ? network_new_epoch->config
+                                                    : EpochCfgS{};
+                if (!network_new_epoch)
+                    throw EngineError(
+                        "fastengine: crash-resume epoch outside envelope");
+                active_epoch = std::make_shared<ActiveEpoch>(
+                    ctx, epoch_config, persisted, node_buffers, commit_state,
+                    client_tracker, my_config);
+                concat(actions, active_epoch->advance());
+                state = ETS::IN_PROGRESS;
+                for (i32 node : ctx->cfg.nodes) {
+                    prestart_buffers.at(node).iterate(
+                        [](const MsgS &) { return Applyable::CURRENT; },
+                        [this, node, &actions](MsgP m) {
+                            concat(actions, active_epoch->step(node, m));
+                        });
+                }
+                concat(actions, active_epoch->drain_buffers());
+            } else if (state == ETS::IN_PROGRESS) {
+                ActiveEpoch *ae = active_epoch.get();
+                if (ae->outstanding_reqs->available_iterator->has_next())
+                    concat(actions, ae->outstanding_reqs->advance_requests());
+                if (ae->needs_advance()) concat(actions, ae->advance());
+            }
+            if (state == old_state) return actions;
+        }
+    }
+
+    Actions move_low_watermark(i64 seq_no) {
+        if (state != ETS::IN_PROGRESS) return Actions();
+        auto [actions, done] = active_epoch->move_low_watermark(seq_no);
+        if (done) state = ETS::DONE;
+        return actions;
+    }
+
+    void apply_suspect_msg(i32 source) {
+        suspicions.insert(source);
+        if ((i64)suspicions.size() >= ctx->iq) state = ETS::DONE;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Epoch tracker (statemachine/epoch_tracker.py).
+// ---------------------------------------------------------------------------
+
+constexpr i64 TICKS_OUT_OF_CORRECT_EPOCH_LIMIT = 10;
+
+i64 epoch_for_msg(const MsgS &msg) {
+    switch (msg.t) {
+        case MT::Preprepare:
+        case MT::Prepare:
+        case MT::Commit:
+        case MT::Suspect:
+            return msg.epoch;
+        case MT::EpochChange:
+            return msg.ec->new_epoch;
+        case MT::EpochChangeAck:
+            return msg.ec->new_epoch;
+        case MT::NewEpoch:
+        case MT::NewEpochEcho:
+        case MT::NewEpochReady:
+            return msg.necfg->config.number;
+        default:
+            throw EngineError("unexpected epoch message type");
+    }
+}
+
+struct EpochTracker {
+    const Ctx *ctx;
+    shared_ptr<EpochTarget> current_epoch;
+    PersistedLog *persisted;
+    NodeBuffers *node_buffers;
+    CommitState *commit_state;
+    InitParms my_config;
+    BatchTracker *batch_tracker;
+    ClientTracker *client_tracker;
+    Disseminator *client_hash_disseminator;
+    std::map<i32, MsgBuffer> future_msgs;
+    vector<std::pair<i32, i64>> max_epochs;  // insertion-ordered (source, max)
+    i64 max_correct_epoch = 0;
+    i64 ticks_out_of_correct_epoch = 0;
+
+    shared_ptr<EpochTarget> new_target(i64 number) {
+        return std::make_shared<EpochTarget>(
+            ctx, number, persisted, node_buffers, commit_state, client_tracker,
+            client_hash_disseminator, batch_tracker, my_config);
+    }
+
+    Actions reinitialize() {
+        for (i32 node : ctx->cfg.nodes) {
+            if (!future_msgs.count(node)) {
+                MsgBuffer mb;
+                mb.nb = node_buffers->node_buffer(node);
+                mb.wire = &ctx->wire;
+                future_msgs.emplace(node, std::move(mb));
+            }
+        }
+
+        Actions actions;
+        const PersistEntS *last_n = nullptr, *last_f = nullptr;
+        bool have_ec = false;
+        i64 last_ec_num = 0;
+        for (const auto &pr : persisted->entries) {
+            const PersistEntS &e = *pr.second;
+            if (e.t == PET::N) last_n = &e;
+            else if (e.t == PET::F) last_f = &e;
+            else if (e.t == PET::EC) { have_ec = true; last_ec_num = e.num; }
+        }
+        if (!last_n && !last_f)
+            throw EngineError("no active epoch and no last epoch in log");
+        if (last_n && last_f &&
+            last_n->epoch_config.number <= last_f->epoch_config.number)
+            throw EngineError("new epoch number must exceed last terminated epoch");
+
+        if (last_n && (!have_ec || last_ec_num <= last_n->epoch_config.number)) {
+            // Mid-epoch crash-resume: outside the engine envelope.
+            throw EngineError("fastengine: mid-epoch resume outside envelope");
+        }
+        if (last_f && (!have_ec || last_ec_num <= last_f->epoch_config.number)) {
+            last_ec_num = last_f->epoch_config.number + 1;
+            have_ec = true;
+            concat(actions, persisted->append(pe_ec(last_ec_num)));
+        }
+        if (!have_ec) throw EngineError("no epoch-change entry after recovery");
+        if (current_epoch && current_epoch->number == last_ec_num) {
+            concat(actions, current_epoch->advance_state());
+            return actions;
+        }
+        EpochChangeP epoch_change = persisted->construct_epoch_change(last_ec_num);
+        ParsedECP parsed = try_parse_epoch_change(epoch_change);
+        if (!parsed) throw EngineError("own epoch change failed to parse");
+        current_epoch = new_target(last_ec_num);
+        current_epoch->my_epoch_change = parsed;
+        current_epoch->my_leader_choice = ctx->cfg.nodes;  // all nodes lead
+        current_epoch->have_leader_choice = true;
+
+        for (i32 node : ctx->cfg.nodes) {
+            future_msgs.at(node).iterate(
+                [this](const MsgS &m) { return filter(m); },
+                [this, node, &actions](MsgP m) {
+                    concat(actions, apply_msg(node, m));
+                });
+        }
+        return actions;
+    }
+
+    Actions advance_state() {
+        if (current_epoch->state < ETS::DONE)
+            return current_epoch->advance_state();
+        if (commit_state->checkpoint_pending) return Actions();
+
+        i64 new_epoch_number = current_epoch->number + 1;
+        if (max_correct_epoch > new_epoch_number)
+            new_epoch_number = max_correct_epoch;
+        EpochChangeP epoch_change =
+            persisted->construct_epoch_change(new_epoch_number);
+        ParsedECP my_epoch_change = try_parse_epoch_change(epoch_change);
+        if (!my_epoch_change)
+            throw EngineError("own epoch change failed to parse");
+
+        current_epoch = new_target(new_epoch_number);
+        current_epoch->my_epoch_change = my_epoch_change;
+        current_epoch->my_leader_choice = {my_config.id};
+        current_epoch->have_leader_choice = true;
+
+        Actions actions = persisted->append(pe_ec(new_epoch_number));
+        auto ecm = std::make_shared<MsgS>();
+        ecm->t = MT::EpochChange;
+        ecm->ec = epoch_change;
+        actions.push_back(act_send(ctx->cfg.nodes, ecm));
+
+        for (i32 node : ctx->cfg.nodes) {
+            future_msgs.at(node).iterate(
+                [this](const MsgS &m) { return filter(m); },
+                [this, node, &actions](MsgP m) {
+                    concat(actions, apply_msg(node, m));
+                });
+        }
+        return actions;
+    }
+
+    Applyable filter(const MsgS &msg) {
+        i64 epoch_number = epoch_for_msg(msg);
+        if (epoch_number < current_epoch->number) return Applyable::PAST;
+        if (epoch_number > current_epoch->number) return Applyable::FUTURE;
+        return Applyable::CURRENT;
+    }
+
+    Actions step(i32 source, const MsgP &msg) {
+        i64 epoch_number = epoch_for_msg(*msg);
+        if (epoch_number < current_epoch->number) return Actions();
+        if (epoch_number > current_epoch->number) {
+            bool found = false;
+            for (auto &pr : max_epochs)
+                if (pr.first == source) {
+                    if (pr.second < epoch_number) pr.second = epoch_number;
+                    found = true;
+                    break;
+                }
+            if (!found) max_epochs.emplace_back(source, epoch_number);
+            future_msgs.at(source).store(msg);
+            return Actions();
+        }
+        return apply_msg(source, msg);
+    }
+
+    Actions apply_msg(i32 source, const MsgP &msg) {
+        EpochTarget *target = current_epoch.get();
+        switch (msg->t) {
+            case MT::Preprepare:
+            case MT::Prepare:
+            case MT::Commit:
+                return target->step(source, msg);
+            case MT::Suspect:
+                target->apply_suspect_msg(source);
+                return Actions();
+            case MT::EpochChange:
+                return target->apply_epoch_change_msg(source, msg);
+            case MT::EpochChangeAck:
+                return target->apply_epoch_change_ack_msg(
+                    source, msg->originator, msg->ec);
+            case MT::NewEpoch:
+                if (msg->necfg->config.number % (i64)ctx->cfg.nodes.size() !=
+                    source)
+                    return Actions();  // not from the epoch primary
+                return target->apply_new_epoch_msg(msg);
+            case MT::NewEpochEcho:
+                return target->apply_new_epoch_echo_msg(source, msg->necfg);
+            case MT::NewEpochReady:
+                return target->apply_new_epoch_ready_msg(source, msg->necfg);
+            default:
+                throw EngineError("unexpected epoch message type");
+        }
+    }
+
+    Actions apply_batch_hash_result(i64 epoch, i64 seq_no, i32 digest) {
+        if (epoch != current_epoch->number ||
+            current_epoch->state != ETS::IN_PROGRESS)
+            return Actions();
+        return current_epoch->active_epoch->apply_batch_hash_result(seq_no,
+                                                                    digest);
+    }
+
+    Actions apply_epoch_change_digest(const HashOriginS &origin, i32 digest) {
+        i64 target_number = origin.ec->new_epoch;
+        if (target_number < current_epoch->number) return Actions();
+        if (target_number > current_epoch->number)
+            throw EngineError("epoch change digest for future epoch");
+        return current_epoch->apply_epoch_change_digest(origin, digest);
+    }
+
+    Actions tick() {
+        for (const auto &pr : max_epochs) {
+            i64 max_epoch = pr.second;
+            if (max_epoch <= max_correct_epoch) continue;
+            i64 matches = 0;
+            for (const auto &pr2 : max_epochs)
+                if (pr2.second >= max_epoch) matches++;
+            if (matches < ctx->wq) continue;
+            max_correct_epoch = max_epoch;
+        }
+        if (max_correct_epoch > current_epoch->number) {
+            ticks_out_of_correct_epoch += 1;
+            if (ticks_out_of_correct_epoch > TICKS_OUT_OF_CORRECT_EPOCH_LIMIT)
+                current_epoch->state = ETS::DONE;
+        }
+        return current_epoch->tick();
+    }
+
+    Actions move_low_watermark(i64 seq_no) {
+        return current_epoch->move_low_watermark(seq_no);
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Root state machine (statemachine/machine.py).
+// ---------------------------------------------------------------------------
+
+struct MachineState_ {
+    enum V { UNINITIALIZED = 0, LOADING_PERSISTED = 1, INITIALIZED = 2 };
+};
+
+struct Machine {
+    const Ctx *ctx;
+    int state = MachineState_::UNINITIALIZED;
+    InitParms my_config{};
+    bool have_config = false;
+    std::unique_ptr<PersistedLog> persisted;
+    std::unique_ptr<NodeBuffers> node_buffers;
+    std::unique_ptr<CheckpointTracker> checkpoint_tracker;
+    std::unique_ptr<ClientTracker> client_tracker;
+    std::unique_ptr<CommitState> commit_state;
+    std::unique_ptr<Disseminator> client_hash_disseminator;
+    std::unique_ptr<BatchTracker> batch_tracker;
+    std::unique_ptr<EpochTracker> epoch_tracker;
+
+    void initialize(const InitParms &parameters) {
+        if (state != MachineState_::UNINITIALIZED)
+            throw EngineError("state machine has already been initialized");
+        my_config = parameters;
+        have_config = true;
+        state = MachineState_::LOADING_PERSISTED;
+        persisted = std::make_unique<PersistedLog>();
+        node_buffers = std::make_unique<NodeBuffers>();
+        node_buffers->buffer_size = parameters.buffer_size;
+        checkpoint_tracker = std::make_unique<CheckpointTracker>();
+        checkpoint_tracker->persisted = persisted.get();
+        checkpoint_tracker->node_buffers = node_buffers.get();
+        checkpoint_tracker->my_config = parameters;
+        checkpoint_tracker->ctx = ctx;
+        client_tracker = std::make_unique<ClientTracker>();
+        client_tracker->my_config = parameters;
+        commit_state = std::make_unique<CommitState>();
+        commit_state->ctx = ctx;
+        commit_state->persisted = persisted.get();
+        client_hash_disseminator = std::make_unique<Disseminator>();
+        client_hash_disseminator->ctx = ctx;
+        client_hash_disseminator->my_config = parameters;
+        client_hash_disseminator->node_buffers = node_buffers.get();
+        client_hash_disseminator->client_tracker = client_tracker.get();
+        batch_tracker = std::make_unique<BatchTracker>();
+        batch_tracker->persisted = persisted.get();
+        epoch_tracker = std::make_unique<EpochTracker>();
+        epoch_tracker->ctx = ctx;
+        epoch_tracker->persisted = persisted.get();
+        epoch_tracker->node_buffers = node_buffers.get();
+        epoch_tracker->commit_state = commit_state.get();
+        epoch_tracker->my_config = parameters;
+        epoch_tracker->batch_tracker = batch_tracker.get();
+        epoch_tracker->client_tracker = client_tracker.get();
+        epoch_tracker->client_hash_disseminator = client_hash_disseminator.get();
+    }
+
+    void apply_persisted(i64 index, PersistEntP entry) {
+        if (state != MachineState_::LOADING_PERSISTED)
+            throw EngineError("not in the loading-persisted phase");
+        persisted->append_initial_load(index, std::move(entry));
+    }
+
+    Actions complete_initialization() {
+        if (state != MachineState_::LOADING_PERSISTED)
+            throw EngineError("not in the loading-persisted phase");
+        state = MachineState_::INITIALIZED;
+        return reinitialize();
+    }
+
+    Actions reinitialize() {
+        Actions actions = complete_pending_reconfiguration();
+        concat(actions, recover_log());
+        concat(actions, commit_state->reinitialize());
+        client_tracker->reinitialize(*commit_state->active_state);
+        concat(actions,
+               client_hash_disseminator->reinitialize(
+                   commit_state->low_watermark, *commit_state->active_state));
+        checkpoint_tracker->reinitialize();
+        batch_tracker->reinitialize();
+        concat(actions, epoch_tracker->reinitialize());
+        return actions;
+    }
+
+    Actions complete_pending_reconfiguration() {
+        // Envelope: no reconfigurations ever appear in the log.
+        return Actions();
+    }
+
+    Actions recover_log() {
+        Actions actions;
+        const PersistEntS *last_c = nullptr;
+        // Collect truncation points first (Python iterates a snapshot).
+        vector<i64> truncate_seqs;
+        for (const auto &pr : persisted->entries) {
+            const PersistEntS &e = *pr.second;
+            if (e.t == PET::C) last_c = &e;
+            else if (e.t == PET::F) {
+                if (!last_c)
+                    throw EngineError("FEntry without corresponding CEntry");
+                truncate_seqs.push_back(last_c->seq);
+            }
+        }
+        if (!last_c) throw EngineError("found no checkpoints in the log");
+        for (i64 seq : truncate_seqs)
+            concat(actions, persisted->truncate(seq));
+        return actions;
+    }
+
+    Actions step(i32 source, const MsgP &msg);
+
+    Actions process_hash_result(i32 digest, const HashOriginS &origin) {
+        if (origin.t == OT::Batch) {
+            batch_tracker->add_batch(origin.seq, digest, origin.request_acks);
+            return epoch_tracker->apply_batch_hash_result(origin.epoch,
+                                                          origin.seq, digest);
+        }
+        if (origin.t == OT::EpochChange)
+            return epoch_tracker->apply_epoch_change_digest(origin, digest);
+        if (origin.t == OT::VerifyBatch) {
+            Actions actions;
+            batch_tracker->apply_verify_batch_hash_result(digest, origin);
+            if (!batch_tracker->has_fetch_in_flight() &&
+                epoch_tracker->current_epoch->state == ETS::FETCHING)
+                concat(actions,
+                       epoch_tracker->current_epoch->fetch_new_epoch_state());
+            return actions;
+        }
+        throw EngineError("no hash origin type set");
+    }
+
+    Actions process_checkpoint_result(const EventS &result) {
+        Actions actions;
+        if (result.seq < commit_state->low_watermark) return actions;
+        i64 expected = commit_state->low_watermark + ctx->cfg.ci;
+        if (expected != result.seq)
+            throw EngineError("checkpoint results must be one interval after the last");
+        i64 prev_stop = commit_state->stop_at_seq_no;
+        concat(actions, commit_state->apply_checkpoint_result(
+                            result.seq, result.value, result.netstate));
+        if (prev_stop < commit_state->stop_at_seq_no) {
+            client_tracker->allocate(*result.netstate);
+            concat(actions, client_hash_disseminator->allocate(
+                                result.seq, *result.netstate));
+        }
+        return actions;
+    }
+
+    Actions apply_event(const EventS &event) {
+        if (event.t == ET::InitialParameters)
+            throw EngineError("init params handled by caller");
+        if (event.t == ET::LoadPersistedEntry) {
+            apply_persisted(event.index, event.entry);
+            return Actions();
+        }
+        Actions actions;
+        if (event.t == ET::LoadCompleted) {
+            actions = complete_initialization();
+        } else if (event.t == ET::ActionsReceived) {
+            if (state == MachineState_::INITIALIZED)
+                return client_hash_disseminator->flush_acks();
+            return actions;
+        } else {
+            if (state != MachineState_::INITIALIZED)
+                throw EngineError("cannot apply events to an uninitialized machine");
+            if (event.t == ET::Step) {
+                concat(actions, step(event.source, event.msg));
+            } else if (event.t == ET::RequestPersisted) {
+                concat(actions,
+                       client_hash_disseminator->apply_new_request(event.ack));
+            } else if (event.t == ET::HashResult) {
+                concat(actions, process_hash_result(event.digest, *event.origin));
+            } else if (event.t == ET::CheckpointResult) {
+                concat(actions, process_checkpoint_result(event));
+            } else if (event.t == ET::TickElapsed) {
+                concat(actions, client_hash_disseminator->tick());
+                concat(actions, epoch_tracker->tick());
+            } else {
+                throw EngineError("unknown event type");
+            }
+        }
+
+        if (checkpoint_tracker->state == CheckpointState_::GARBAGE_COLLECTABLE) {
+            i64 new_low = checkpoint_tracker->garbage_collect();
+            concat(actions, persisted->truncate(new_low));
+            i64 ci = ctx->cfg.ci;
+            if (new_low > ci) batch_tracker->truncate(new_low - ci);
+            concat(actions, epoch_tracker->move_low_watermark(new_low));
+        }
+
+        u64 t0 = __rdtsc();
+        while (true) {
+            concat(actions, commit_state->drain());
+            Actions loop_actions = epoch_tracker->advance_state();
+            if (loop_actions.empty()) break;
+            concat(actions, std::move(loop_actions));
+        }
+        g_parts[2].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
+        return actions;
+    }
+};
+
+Actions Machine::step(i32 source, const MsgP &msg) {
+    MT t = msg->t;
+    if (t == MT::Prepare || t == MT::Commit) {
+        EpochTarget *target = epoch_tracker->current_epoch.get();
+        if (msg->epoch == target->number && target->state == ETS::IN_PROGRESS)
+            return target->active_epoch->step(source, msg);
+        return epoch_tracker->step(source, msg);
+    }
+    if (t == MT::AckBatch || t == MT::AckMsg || t == MT::FetchRequest)
+        return client_hash_disseminator->step(source, msg);
+    if (t == MT::MsgBatch) {
+        EpochTarget *target = epoch_tracker->current_epoch.get();
+        if (target->state == ETS::IN_PROGRESS) {
+            // Native-plane envelope path (voteplane.py split_votes): votes
+            // first (in order), then the rest (in order).
+            vector<MsgP> votes, rest;
+            for (const auto &im : msg->inner) {
+                if (im->t == MT::Prepare || im->t == MT::Commit)
+                    votes.push_back(im);
+                else
+                    rest.push_back(im);
+            }
+            if (!votes.empty()) {
+                u64 t0 = __rdtsc();
+                Actions actions = target->active_epoch->apply_envelope_votes(
+                    votes, source, [this](i32 src, const MsgP &m) {
+                        return step(src, m);
+                    });
+                g_parts[1].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
+                for (const auto &im : rest) concat(actions, step(source, im));
+                return actions;
+            }
+        }
+        Actions actions;
+        for (const auto &im : msg->inner) concat(actions, step(source, im));
+        return actions;
+    }
+    if (t == MT::Checkpoint) {
+        checkpoint_tracker->step(source, msg);
+        return Actions();
+    }
+    if (t == MT::FetchBatch)
+        return batch_tracker->reply_fetch_batch(source, msg->seq, msg->dig);
+    if (t == MT::ForwardBatch)
+        return batch_tracker->apply_forward_batch_msg(source, msg->seq,
+                                                      msg->dig, msg->acks,
+                                                      ctx->intern);
+    if (t == MT::Suspect || t == MT::EpochChange || t == MT::EpochChangeAck ||
+        t == MT::NewEpoch || t == MT::NewEpochEcho || t == MT::NewEpochReady ||
+        t == MT::Preprepare)
+        return epoch_tracker->step(source, msg);
+    throw EngineError("unexpected message type in machine step");
+}
+
+// ClientTracker::allocate (deferred: needs is_committed over CRNP/AckS).
+void ClientTracker::allocate(const NetStateS &state) {
+    std::map<i64, const ClientStateS *> state_map;
+    for (const auto &cs : state.clients) state_map.emplace(cs.id, &cs);
+    available_list->garbage_collect([&](const AckS &ack) {
+        auto it = state_map.find(ack.client);
+        if (it == state_map.end())
+            throw EngineError("available client req must have its client in config");
+        return is_committed(ack.reqno, *it->second);
+    });
+    ready_list->garbage_collect([&](const CRNP &crn) {
+        auto it = state_map.find(crn->client_id);
+        if (it == state_map.end())
+            throw EngineError("client removal not yet supported");
+        return is_committed(crn->req_no, *it->second);
+    });
+}
+
+// ---------------------------------------------------------------------------
+// Processor layer (processor/{serial,work,clients}.py) and simulated node
+// fakes (testengine/recorder.py).
+// ---------------------------------------------------------------------------
+
+struct WorkItems {
+    Actions wal_actions, net_actions, hash_actions, client_actions,
+        app_actions;
+    Events req_store_events, result_events;
+
+    void add_state_machine_results(Actions &&actions) {
+        for (auto &action : actions) {
+            switch (action.t) {
+                case AT::Send: {
+                    MT t = action.msg->t;
+                    if (t == MT::AckMsg || t == MT::AckBatch ||
+                        t == MT::Checkpoint || t == MT::FetchBatch ||
+                        t == MT::ForwardBatch)
+                        net_actions.push_back(std::move(action));
+                    else
+                        wal_actions.push_back(std::move(action));
+                    break;
+                }
+                case AT::Hash:
+                    hash_actions.push_back(std::move(action));
+                    break;
+                case AT::Persist:
+                case AT::Truncate:
+                    wal_actions.push_back(std::move(action));
+                    break;
+                case AT::Commit:
+                case AT::Checkpoint:
+                    app_actions.push_back(std::move(action));
+                    break;
+                case AT::AllocatedRequest:
+                case AT::CorrectRequest:
+                case AT::StateApplied:
+                    client_actions.push_back(std::move(action));
+                    break;
+                case AT::ForwardRequest:
+                    break;  // dropped (reference work.go:176)
+            }
+        }
+    }
+};
+
+// In-memory request store (testengine SimReqStore).
+struct SimReqStore {
+    std::unordered_set<AckS, AckHash> requests;
+    std::unordered_map<u64, i32> allocations;  // (client<<32|reqno) packed
+
+    static u64 key(i64 client, i64 reqno) {
+        return ((u64)client << 40) | (u64)reqno;
+    }
+    void put_request(const AckS &ack) { requests.insert(ack); }
+    bool has_request(const AckS &ack) const { return requests.count(ack) != 0; }
+    void put_allocation(i64 client, i64 reqno, i32 dig) {
+        allocations[key(client, reqno)] = dig;
+    }
+    i32 get_allocation(i64 client, i64 reqno) const {  // -1 = None
+        auto it = allocations.find(key(client, reqno));
+        return it == allocations.end() ? -1 : it->second;
+    }
+};
+
+// In-memory WAL (testengine SimWAL) — index bookkeeping only; entries are
+// retained for parity of the strict accounting, trimmed on truncate.
+struct SimWAL {
+    i64 low_index = 1;
+    deque<PersistEntP> entries;
+
+    void write(i64 index, PersistEntP entry) {
+        i64 expected = low_index + (i64)entries.size();
+        if (index != expected) throw EngineError("WAL out of order");
+        entries.push_back(std::move(entry));
+    }
+    void truncate(i64 index) {
+        if (index < low_index) throw EngineError("truncate below low index");
+        i64 to_remove = index - low_index;
+        if (to_remove >= (i64)entries.size())
+            throw EngineError("truncate beyond highest index");
+        entries.erase(entries.begin(), entries.begin() + to_remove);
+        low_index = index;
+    }
+};
+
+// The simulated replicated app (testengine NodeState).
+struct AppState {
+    const Ctx *ctx;
+    SimReqStore *req_store;
+    i64 last_seq_no = 0;
+    Sha256 active_hash;
+    i64 checkpoint_seq_no = 0;
+    string checkpoint_hash;
+    NetStateP checkpoint_state;
+    std::map<i64, i64> committed_reqs;
+
+    // snap() -> value interner id.
+    i32 snap(Interner &intern, const vector<ClientStateS> &client_states) {
+        checkpoint_seq_no = last_seq_no;
+        auto ns = std::make_shared<NetStateS>();
+        ns->clients = client_states;
+        checkpoint_state = ns;
+        checkpoint_hash = active_hash.digest();
+        active_hash.reset();
+        active_hash.update(checkpoint_hash);
+        string value = checkpoint_hash;
+        ctx->wire.net_state(value, ctx->cfg, *ns);
+        return intern.put(value);
+    }
+
+    void apply(const QEntryS &batch, const Interner &intern) {
+        last_seq_no += 1;
+        if (batch.seq != last_seq_no) throw EngineError("out-of-order commit");
+        for (const auto &request : batch.reqs) {
+            if (!req_store->has_request(request))
+                throw EngineError("reqstore must have a request we are committing");
+            active_hash.update(intern.get(request.dig));
+            i64 &prev = committed_reqs[request.client];
+            if (request.reqno + 1 > prev) prev = request.reqno + 1;
+        }
+    }
+};
+
+// Client-side request-store logic (processor/clients.py).
+struct ProcClientRequest {
+    i64 req_no;
+    i32 local_allocation_digest = -1;  // -1 = None
+    vector<i32> remote_correct_digests;
+};
+
+struct ProcClient {
+    i64 client_id;
+    SimReqStore *request_store;
+    i64 next_req_no = 0;
+    std::map<i64, ProcClientRequest> requests;
+
+    void state_applied(const ClientStateS &state) {
+        for (auto it = requests.begin(); it != requests.end();) {
+            if (it->first < state.lw) it = requests.erase(it);
+            else ++it;
+        }
+        if (next_req_no < state.lw) next_req_no = state.lw;
+    }
+
+    // allocate() -> local digest or -1.
+    i32 allocate(i64 req_no) {
+        auto it = requests.find(req_no);
+        if (it != requests.end()) return it->second.local_allocation_digest;
+        ProcClientRequest cr;
+        cr.req_no = req_no;
+        cr.local_allocation_digest =
+            request_store->get_allocation(client_id, req_no);
+        i32 out = cr.local_allocation_digest;
+        requests.emplace(req_no, std::move(cr));
+        return out;
+    }
+
+    bool empty() const { return requests.empty(); }
+
+    void add_correct_digest(i64 req_no, i32 digest) {
+        if (requests.empty())
+            throw EngineError("client-not-exist in add_correct_digest");
+        auto it = requests.find(req_no);
+        if (it == requests.end()) {
+            if (req_no < requests.begin()->first) return;  // already GC'd
+            throw EngineError("unallocated client request marked correct");
+        }
+        auto &rcd = it->second.remote_correct_digests;
+        for (i32 d : rcd)
+            if (d == digest) return;
+        rcd.push_back(digest);
+    }
+
+    i64 next_req_no_value() const {
+        if (requests.empty()) throw EngineError("ClientNotExist");
+        return next_req_no;
+    }
+
+    // propose() (clients.py:98-144); digest precomputed by the engine.
+    // Returns (has_event, ack) — the RequestPersisted event if emitted.
+    bool propose(i64 req_no, i32 digest, AckS *out) {
+        if (requests.empty()) throw EngineError("ClientNotExist");
+        if (req_no < next_req_no) return false;
+
+        if (req_no == next_req_no) {
+            while (true) {
+                next_req_no += 1;
+                auto it = requests.find(next_req_no);
+                if (it == requests.end() ||
+                    it->second.local_allocation_digest == -1)
+                    break;
+            }
+        }
+        auto it = requests.find(req_no);
+        bool previously_allocated = it != requests.end();
+        if (it == requests.end()) {
+            ProcClientRequest cr;
+            cr.req_no = req_no;
+            it = requests.emplace(req_no, std::move(cr)).first;
+        }
+        ProcClientRequest &cr = it->second;
+        if (cr.local_allocation_digest != -1) {
+            if (cr.local_allocation_digest == digest) return false;
+            throw EngineError("conflicting digest for req_no");
+        }
+        if (!cr.remote_correct_digests.empty()) {
+            bool ok = false;
+            for (i32 d : cr.remote_correct_digests)
+                if (d == digest) ok = true;
+            if (!ok)
+                throw EngineError("other known-correct digests exist for req_no");
+        }
+        AckS ack{client_id, req_no, digest};
+        request_store->put_request(ack);
+        request_store->put_allocation(client_id, req_no, digest);
+        cr.local_allocation_digest = digest;
+        if (previously_allocated) {
+            *out = ack;
+            return true;
+        }
+        return false;
+    }
+};
+
+struct ProcClients {
+    SimReqStore *request_store;
+    std::map<i64, ProcClient> clients;
+
+    ProcClient *client(i64 client_id) {
+        auto it = clients.find(client_id);
+        if (it == clients.end()) {
+            ProcClient c;
+            c.client_id = client_id;
+            c.request_store = request_store;
+            it = clients.emplace(client_id, std::move(c)).first;
+        }
+        return &it->second;
+    }
+
+    Events process_client_actions(const Actions &actions) {
+        Events events;
+        i64 last_id = -1;
+        ProcClient *cached = nullptr;
+        for (const auto &action : actions) {
+            if (action.t == AT::AllocatedRequest) {
+                if (action.client != last_id) {
+                    last_id = action.client;
+                    cached = client(last_id);
+                }
+                i32 digest = cached->allocate(action.reqno);
+                if (digest == -1) continue;
+                EventS ev;
+                ev.t = ET::RequestPersisted;
+                ev.ack = AckS{action.client, action.reqno, digest};
+                events.push_back(std::move(ev));
+            } else if (action.t == AT::CorrectRequest) {
+                client(action.ack.client)
+                    ->add_correct_digest(action.ack.reqno, action.ack.dig);
+            } else if (action.t == AT::StateApplied) {
+                for (const auto &cs : action.netstate->clients)
+                    client(cs.id)->state_applied(cs);
+            } else {
+                throw EngineError("unexpected client action type");
+            }
+        }
+        return events;
+    }
+};
+
+// _coalesce_sends (processor/serial.py:96-145).
+vector<ActionS> coalesce_sends(Actions &&actions) {
+    struct Group {
+        size_t index;
+        vector<MsgP> msgs;
+        vector<AckS> acks;
+    };
+    vector<std::pair<vector<i32>, Group>> groups;  // insertion-ordered by key
+    vector<std::optional<ActionS>> out;
+    for (auto &action : actions) {
+        if (action.t != AT::Send)
+            throw EngineError("unexpected Net action type");
+        Group *slot = nullptr;
+        for (auto &pr : groups)
+            if (pr.first == action.targets) { slot = &pr.second; break; }
+        if (!slot) {
+            groups.emplace_back(action.targets,
+                                Group{out.size(), {}, {}});
+            slot = &groups.back().second;
+            out.emplace_back(std::nullopt);
+        }
+        const MsgP &msg = action.msg;
+        if (msg->t == MT::AckMsg) slot->acks.push_back(msg->acks[0]);
+        else if (msg->t == MT::AckBatch)
+            for (const auto &a : msg->acks) slot->acks.push_back(a);
+        else slot->msgs.push_back(msg);
+    }
+    for (auto &pr : groups) {
+        Group &g = pr.second;
+        if (!g.acks.empty()) {
+            std::stable_sort(g.acks.begin(), g.acks.end(),
+                             [](const AckS &a, const AckS &b) {
+                                 if (a.client != b.client)
+                                     return a.client < b.client;
+                                 return a.reqno < b.reqno;
+                             });
+            if (g.acks.size() == 1) g.msgs.push_back(mk_ack_msg(g.acks[0]));
+            else g.msgs.push_back(mk_ack_batch(std::move(g.acks)));
+        }
+        MsgP final_msg;
+        if (g.msgs.size() == 1) {
+            final_msg = g.msgs[0];
+        } else {
+            auto mb = std::make_shared<MsgS>();
+            mb->t = MT::MsgBatch;
+            mb->inner = std::move(g.msgs);
+            final_msg = mb;
+        }
+        out[g.index] = act_send(pr.first, final_msg);
+    }
+    vector<ActionS> result;
+    for (auto &o : out)
+        if (o) result.push_back(std::move(*o));
+    return result;
+}
+
+// ---------------------------------------------------------------------------
+// The engine: nodes + scheduler (testengine/recorder.py Recording).
+// ---------------------------------------------------------------------------
+
+constexpr int PROPOSAL_CHUNK = 32;
+
+struct RuntimeParms {
+    i64 tick_interval = 500, link_latency = 100, wal_latency = 100,
+        net_latency = 15, hash_latency = 25, client_latency = 15,
+        app_latency = 30, req_store_latency = 150, events_latency = 10;
+};
+
+struct ClientSpec {
+    i64 id;
+    i64 total;
+    bool signed_mode = false;
+    bool corrupt = false;
+    std::set<i32> ignore_nodes;
+    vector<i32> payloads;        // interner id per req_no
+    vector<i32> payload_digests; // sha256 id per req_no
+    vector<u8> verdicts;         // auth verdict per req_no (signed mode)
+};
+
+struct EngineNode {
+    i32 id;
+    InitParms init_parms;
+    RuntimeParms runtime;
+    i64 start_delay = 0;
+    SimWAL wal;
+    SimReqStore req_store;
+    AppState state;
+    std::unique_ptr<WorkItems> work_items;
+    std::unique_ptr<ProcClients> clients;
+    std::unique_ptr<Machine> machine;
+    bool pending[7] = {false, false, false, false, false, false, false};
+    // category order: wal, net, client, hash, app, req_store, result
+    bool drain_ready = false;
+};
+
+struct Engine {
+    Ctx ctx;
+    EventQueue queue;
+    vector<std::unique_ptr<EngineNode>> nodes;
+    vector<ClientSpec> client_specs;  // in config order
+    i64 steps = 0;
+    i64 committed_ops = 0;
+    // Incremental drain bookkeeping: the drain predicate is a pure function
+    // of state that changes only in checkpoint snaps (client low watermarks)
+    // and commits (committed counts), so it is maintained there instead of
+    // rescanning nodes x clients every step.  drained() stays exactly the
+    // predicate of recorder.py:761-803, evaluated after every step.
+    std::unordered_map<i64, i64> drain_targets;  // client -> target (0 corrupt)
+    i64 nodes_not_ready = 0;   // nodes whose checkpoint lws miss targets
+    i64 clients_unsatisfied = 0;  // targets>0 not yet committed anywhere
+    std::unordered_map<i64, bool> client_satisfied;
+    u64 kind_cycles[11] = {0};
+    u64 kind_counts[11] = {0};
+    u64 ev_cycles[10] = {0};
+    u64 ev_counts[10] = {0};
+    u64 fix_cycles = 0;  // post-event GC+fixpoint share (inside apply_event)
+    u64 crypto_ns = 0;  // host CPU spent hashing (SHA-256) in-engine
+    // Wave mirror log: (joined message id, digest id) for wave-eligible
+    // content, deduped engine-wide (the cross-node plane dedups the same way).
+    std::unordered_set<string> wave_seen;
+    vector<std::pair<i32, i32>> wave_log;
+
+    ClientSpec *spec_of(i64 client_id) {
+        for (auto &cs : client_specs)
+            if (cs.id == client_id) return &cs;
+        return nullptr;
+    }
+
+    // Engine-wide hashing service (the hash plane): identical digests to
+    // hashlib; wave-eligible content (multi-part or >= 512 B single part —
+    // the complement of crypto.py::_host_fast) is mirrored for the device.
+    i32 hash_parts(const vector<string> &parts) {
+        auto t0 = std::chrono::steady_clock::now();
+        if (parts.size() == 1 && parts[0].size() < 512) {
+            i32 r = ctx.intern.put(sha256(parts[0]));
+            crypto_ns += (u64)std::chrono::duration_cast<std::chrono::nanoseconds>(
+                             std::chrono::steady_clock::now() - t0)
+                             .count();
+            return r;
+        }
+        string joined;
+        for (const auto &p : parts) joined.append(p);
+        string digest = sha256(joined);
+        i32 did = ctx.intern.put(digest);
+        crypto_ns += (u64)std::chrono::duration_cast<std::chrono::nanoseconds>(
+                         std::chrono::steady_clock::now() - t0)
+                         .count();
+        if (wave_seen.size() > (1u << 17)) wave_seen.clear();  // bounded dedup
+        if (wave_seen.insert(joined).second)
+            wave_log.emplace_back(ctx.intern.put(joined), did);
+        return did;
+    }
+
+    void init_node_world(i32 node_id, const vector<ClientStateS> &init_clients) {
+        EngineNode &node = *nodes[(size_t)node_id];
+        node.state.ctx = &ctx;
+        node.state.req_store = &node.req_store;
+        i32 checkpoint_value = node.state.snap(ctx.intern, init_clients);
+        auto ns = node.state.checkpoint_state;
+        node.wal.entries.clear();
+        node.wal.low_index = 1;
+        node.wal.entries.push_back(pe_c(0, checkpoint_value, ns));
+        EpochCfgS cfg0;
+        cfg0.number = 0;
+        cfg0.leaders = ctx.cfg.nodes;
+        cfg0.planned_expiration = 0;
+        node.wal.entries.push_back(pe_f(cfg0));
+    }
+
+    void initialize_node(EngineNode &node) {
+        node.work_items = std::make_unique<WorkItems>();
+        node.clients = std::make_unique<ProcClients>();
+        node.clients->request_store = &node.req_store;
+        node.machine = std::make_unique<Machine>();
+        node.machine->ctx = &ctx;
+        for (auto &p : node.pending) p = false;
+        // recover_wal_for_existing_node: init + load entries + complete.
+        Events &ev = node.work_items->result_events;
+        {
+            EventS e;
+            e.t = ET::InitialParameters;
+            ev.push_back(std::move(e));
+        }
+        for (size_t i = 0; i < node.wal.entries.size(); i++) {
+            EventS e;
+            e.t = ET::LoadPersistedEntry;
+            e.index = node.wal.low_index + (i64)i;
+            e.entry = node.wal.entries[i];
+            ev.push_back(std::move(e));
+        }
+        {
+            EventS e;
+            e.t = ET::LoadCompleted;
+            ev.push_back(std::move(e));
+        }
+    }
+
+    void schedule_proposal(i32 node_id, i64 client_id, i64 req_no,
+                           i64 delay) {
+        SimEv ev;
+        ev.time = queue.fake_time + delay;
+        ev.kind = SK::ClientProposal;
+        ev.target = node_id;
+        ev.client = client_id;
+        ev.reqno = req_no;
+        queue.insert(std::move(ev));
+    }
+
+    Actions process_wal_actions(EngineNode &node, Actions &&actions) {
+        Actions net_actions;
+        for (auto &action : actions) {
+            if (action.t == AT::Send) net_actions.push_back(std::move(action));
+            else if (action.t == AT::Persist)
+                node.wal.write(action.index, action.entry);
+            else if (action.t == AT::Truncate)
+                node.wal.truncate(action.index);
+            else
+                throw EngineError("unexpected WAL action type");
+        }
+        return net_actions;
+    }
+
+    Events process_net_actions(EngineNode &node, Actions &&actions) {
+        Events events;
+        u64 t0 = __rdtsc();
+        auto coalesced = coalesce_sends(std::move(actions));
+        g_parts[3].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
+        for (auto &action : coalesced) {
+            for (i32 replica : action.targets) {
+                if (replica == node.id) {
+                    EventS e;
+                    e.t = ET::Step;
+                    e.source = replica;
+                    e.msg = action.msg;
+                    events.push_back(std::move(e));
+                } else {
+                    SimEv ev;
+                    ev.time = queue.fake_time + node.runtime.link_latency;
+                    ev.kind = SK::MsgReceived;
+                    ev.target = replica;
+                    ev.src = node.id;
+                    ev.msg = action.msg;
+                    queue.insert(std::move(ev));
+                }
+            }
+        }
+        return events;
+    }
+
+    Events process_hash_actions(Actions &&actions) {
+        Events events;
+        for (auto &action : actions) {
+            if (action.t != AT::Hash)
+                throw EngineError("unexpected Hash action type");
+            i32 digest = hash_parts(action.hash->parts);
+            EventS e;
+            e.t = ET::HashResult;
+            e.digest = digest;
+            e.origin = shared_ptr<const HashOriginS>(action.hash,
+                                                     &action.hash->origin);
+            events.push_back(std::move(e));
+        }
+        return events;
+    }
+
+    Events process_app_actions(EngineNode &node, Actions &&actions) {
+        Events events;
+        for (auto &action : actions) {
+            if (action.t == AT::Commit) {
+                node.state.apply(*action.qentry, ctx.intern);
+                committed_ops += (i64)action.qentry->reqs.size();
+                note_commits(node, *action.qentry);
+            } else if (action.t == AT::Checkpoint) {
+                i32 value = node.state.snap(ctx.intern, *action.cstates);
+                refresh_node_ready(node);
+                EventS e;
+                e.t = ET::CheckpointResult;
+                e.seq = action.seq;
+                e.value = value;
+                e.netstate = node.state.checkpoint_state;
+                e.reconfigured = false;
+                events.push_back(std::move(e));
+            } else {
+                throw EngineError("unexpected App action type");
+            }
+        }
+        return events;
+    }
+
+    Actions process_state_machine_events(EngineNode &node, Events &&events) {
+        Actions actions;
+        for (const auto &event : events) {
+            if (event.t == ET::InitialParameters) {
+                node.machine->initialize(node.init_parms);
+                continue;
+            }
+            u64 t0 = __rdtsc();
+            concat(actions, node.machine->apply_event(event));
+            ev_cycles[(int)event.t] += __rdtsc() - t0;
+            ev_counts[(int)event.t] += 1;
+        }
+        EventS marker;
+        marker.t = ET::ActionsReceived;
+        concat(actions, node.machine->apply_event(marker));
+        return actions;
+    }
+
+    void step();
+    i64 run(i64 max_steps, i64 timeout, bool *done, bool *timed_out);
+    bool drained() const {
+        return nodes_not_ready == 0 && clients_unsatisfied == 0;
+    }
+    bool node_lws_ready(const EngineNode &node) const {
+        if (!node.state.checkpoint_state) return false;
+        for (const auto &cs : node.state.checkpoint_state->clients) {
+            auto it = drain_targets.find(cs.id);
+            if (it != drain_targets.end() && it->second != cs.lw)
+                return false;
+        }
+        return true;
+    }
+    void refresh_node_ready(EngineNode &node) {
+        bool ready = node_lws_ready(node);
+        if (ready != node.drain_ready) {
+            node.drain_ready = ready;
+            nodes_not_ready += ready ? -1 : 1;
+        }
+    }
+    void note_commits(const EngineNode &node, const QEntryS &batch) {
+        for (const auto &req : batch.reqs) {
+            auto sit = client_satisfied.find(req.client);
+            if (sit == client_satisfied.end() || sit->second) continue;
+            auto tit = drain_targets.find(req.client);
+            auto cit = node.state.committed_reqs.find(req.client);
+            if (cit != node.state.committed_reqs.end() &&
+                cit->second >= tit->second) {
+                sit->second = true;
+                clients_unsatisfied -= 1;
+            }
+        }
+    }
+};
+
+void Engine::step() {
+    u64 t_start = __rdtsc();
+    SimEv event = queue.consume();
+    EngineNode &node = *nodes[(size_t)event.target];
+    const RuntimeParms &parms = node.runtime;
+
+    switch (event.kind) {
+        case SK::Initialize: {
+            queue.remove_events_for(node.id);
+            initialize_node(node);
+            {
+                SimEv tick;
+                tick.time = queue.fake_time + parms.tick_interval;
+                tick.kind = SK::Tick;
+                tick.target = node.id;
+                queue.insert(std::move(tick));
+            }
+            std::map<i64, const ClientStateS *> state_clients;
+            for (const auto &cs : node.state.checkpoint_state->clients)
+                state_clients.emplace(cs.id, &cs);
+            for (const auto &client : client_specs) {
+                if (client.ignore_nodes.count(node.id)) continue;
+                auto it = state_clients.find(client.id);
+                i64 start_req = it != state_clients.end() ? it->second->lw : 0;
+                if (start_req < client.total)
+                    schedule_proposal(node.id, client.id, start_req,
+                                      parms.client_latency);
+            }
+            break;
+        }
+        case SK::MsgReceived: {
+            if (node.machine) {
+                EventS e;
+                e.t = ET::Step;
+                e.source = event.src;
+                e.msg = event.msg;
+                node.work_items->result_events.push_back(std::move(e));
+            }
+            break;
+        }
+        case SK::ClientProposal: {
+            ProcClient *client = node.clients->client(event.client);
+            ClientSpec *sim_client = spec_of(event.client);
+            if (!sim_client || sim_client->ignore_nodes.count(node.id))
+                throw EngineError("node should be skipped by client");
+            i64 req_no = event.reqno;
+            bool broke = false;
+            for (int k = 0; k < PROPOSAL_CHUNK; k++) {
+                if (client->empty()) {
+                    // ClientNotExistError: retry later.
+                    schedule_proposal(node.id, event.client, req_no,
+                                      parms.client_latency * 100);
+                    broke = true;
+                    break;
+                }
+                i64 next_req_no = client->next_req_no_value();
+                if (next_req_no != req_no) {
+                    if (next_req_no < sim_client->total)
+                        schedule_proposal(node.id, event.client, next_req_no,
+                                          parms.client_latency);
+                    broke = true;
+                    break;
+                }
+                if (sim_client->signed_mode &&
+                    !(req_no < (i64)sim_client->verdicts.size() &&
+                      sim_client->verdicts[(size_t)req_no])) {
+                    // Forged/corrupt proposal: reject (skips the
+                    // work-scheduling scan, like the Python `return`).
+                    return;
+                }
+                AckS persisted_ack{0, 0, 0};
+                if (client->propose(req_no,
+                                    sim_client->payload_digests[(size_t)req_no],
+                                    &persisted_ack)) {
+                    EventS e;
+                    e.t = ET::RequestPersisted;
+                    e.ack = persisted_ack;
+                    node.work_items->req_store_events.push_back(std::move(e));
+                }
+                req_no += 1;
+                if (req_no >= sim_client->total) {
+                    broke = true;
+                    break;  // no more requests from this client
+                }
+            }
+            if (!broke)
+                schedule_proposal(node.id, event.client, req_no,
+                                  parms.client_latency);
+            break;
+        }
+        case SK::Tick: {
+            EventS e;
+            e.t = ET::TickElapsed;
+            node.work_items->result_events.push_back(std::move(e));
+            SimEv tick;
+            tick.time = queue.fake_time + parms.tick_interval;
+            tick.kind = SK::Tick;
+            tick.target = node.id;
+            queue.insert(std::move(tick));
+            break;
+        }
+        case SK::ProcessReqStore: {
+            // req_store.sync() is a no-op; events pass through.
+            for (auto &e : *event.events)
+                node.work_items->result_events.push_back(std::move(e));
+            node.pending[5] = false;
+            break;
+        }
+        case SK::ProcessResult: {
+            Actions actions =
+                process_state_machine_events(node, std::move(*event.events));
+            node.work_items->add_state_machine_results(std::move(actions));
+            node.pending[6] = false;
+            break;
+        }
+        case SK::ProcessWal: {
+            Actions net =
+                process_wal_actions(node, std::move(*event.actions));
+            for (auto &a : net)
+                node.work_items->net_actions.push_back(std::move(a));
+            node.pending[0] = false;
+            break;
+        }
+        case SK::ProcessNet: {
+            Events events =
+                process_net_actions(node, std::move(*event.actions));
+            for (auto &e : events)
+                node.work_items->result_events.push_back(std::move(e));
+            node.pending[1] = false;
+            break;
+        }
+        case SK::ProcessHash: {
+            Events events = process_hash_actions(std::move(*event.actions));
+            for (auto &e : events)
+                node.work_items->result_events.push_back(std::move(e));
+            node.pending[3] = false;
+            break;
+        }
+        case SK::ProcessClient: {
+            Events events =
+                node.clients->process_client_actions(*event.actions);
+            for (auto &e : events)
+                node.work_items->req_store_events.push_back(std::move(e));
+            node.pending[2] = false;
+            break;
+        }
+        case SK::ProcessApp: {
+            Events events =
+                process_app_actions(node, std::move(*event.actions));
+            for (auto &e : events)
+                node.work_items->result_events.push_back(std::move(e));
+            node.pending[4] = false;
+            break;
+        }
+    }
+
+    kind_cycles[(int)event.kind] += __rdtsc() - t_start;
+    kind_counts[(int)event.kind] += 1;
+
+    if (!node.work_items) return;
+
+    // Schedule processing for non-empty categories with no batch in flight
+    // (same order as recorder.py:742-749).
+    WorkItems &work = *node.work_items;
+    struct Cat {
+        int idx;
+        bool is_events;
+        SK kind;
+        i64 latency;
+    };
+    const Cat cats[7] = {
+        {0, false, SK::ProcessWal, parms.wal_latency},
+        {1, false, SK::ProcessNet, parms.net_latency},
+        {2, false, SK::ProcessClient, parms.client_latency},
+        {3, false, SK::ProcessHash, parms.hash_latency},
+        {4, false, SK::ProcessApp, parms.app_latency},
+        {5, true, SK::ProcessReqStore, parms.req_store_latency},
+        {6, true, SK::ProcessResult, parms.events_latency},
+    };
+    Actions *action_batches[5] = {&work.wal_actions, &work.net_actions,
+                                  &work.client_actions, &work.hash_actions,
+                                  &work.app_actions};
+    Events *event_batches[2] = {&work.req_store_events, &work.result_events};
+    for (const Cat &cat : cats) {
+        if (node.pending[cat.idx]) continue;
+        if (!cat.is_events) {
+            Actions *batch = action_batches[cat.idx];
+            if (batch->empty()) continue;
+            node.pending[cat.idx] = true;
+            SimEv ev;
+            ev.time = queue.fake_time + cat.latency;
+            ev.kind = cat.kind;
+            ev.target = node.id;
+            ev.actions = std::make_shared<Actions>(std::move(*batch));
+            batch->clear();
+            queue.insert(std::move(ev));
+        } else {
+            Events *batch = event_batches[cat.idx - 5];
+            if (batch->empty()) continue;
+            node.pending[cat.idx] = true;
+            SimEv ev;
+            ev.time = queue.fake_time + cat.latency;
+            ev.kind = cat.kind;
+            ev.target = node.id;
+            ev.events = std::make_shared<Events>(std::move(*batch));
+            batch->clear();
+            queue.insert(std::move(ev));
+        }
+    }
+}
+
+i64 Engine::run(i64 max_steps, i64 timeout, bool *done, bool *timed_out) {
+    *done = false;
+    *timed_out = false;
+    i64 executed = 0;
+    while (executed < max_steps) {
+        steps += 1;
+        executed += 1;
+        step();
+        if (drained()) {
+            *done = true;
+            return executed;
+        }
+        if (steps > timeout) {
+            *timed_out = true;
+            return executed;
+        }
+    }
+    return executed;
+}
+
+// ---------------------------------------------------------------------------
+// Python bindings.
+// ---------------------------------------------------------------------------
+
+struct PyEngine {
+    PyObject_HEAD
+    Engine *engine;
+};
+
+i64 get_i64(PyObject *seq, Py_ssize_t i) {
+    PyObject *o = PySequence_GetItem(seq, i);
+    if (!o) throw EngineError("bad config item");
+    i64 v = PyLong_AsLongLong(o);
+    Py_DECREF(o);
+    if (v == -1 && PyErr_Occurred()) throw EngineError("bad config int");
+    return v;
+}
+
+void engine_dealloc(PyObject *self) {
+    delete ((PyEngine *)self)->engine;
+    Py_TYPE(self)->tp_free(self);
+}
+
+PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
+    PyObject *net_tuple, *client_states, *client_specs, *node_specs;
+    if (!PyArg_ParseTuple(args, "OOOO", &net_tuple, &client_states,
+                          &client_specs, &node_specs))
+        return nullptr;
+    auto *engine = new Engine();
+    try {
+        engine->ctx.cfg.ci = get_i64(net_tuple, 1);
+        engine->ctx.cfg.mel = get_i64(net_tuple, 2);
+        engine->ctx.cfg.nb = get_i64(net_tuple, 3);
+        engine->ctx.cfg.f = get_i64(net_tuple, 4);
+        i64 n_nodes = get_i64(net_tuple, 0);
+        if (n_nodes < 1 || n_nodes > 64)
+            throw EngineError("fastengine supports 1..64 nodes");
+        for (i64 i = 0; i < n_nodes; i++)
+            engine->ctx.cfg.nodes.push_back((i32)i);
+        engine->ctx.finish_init();
+
+        // Initial client states: (id, width).
+        Py_ssize_t n_cs = PySequence_Size(client_states);
+        for (Py_ssize_t i = 0; i < n_cs; i++) {
+            PyObject *cs = PySequence_GetItem(client_states, i);
+            ClientStateS c;
+            c.id = get_i64(cs, 0);
+            c.width = get_i64(cs, 1);
+            c.wclc = 0;
+            c.lw = 0;
+            Py_DECREF(cs);
+            engine->ctx.init_clients.push_back(std::move(c));
+        }
+
+        // Client specs.
+        Py_ssize_t n_clients = PySequence_Size(client_specs);
+        for (Py_ssize_t i = 0; i < n_clients; i++) {
+            PyObject *spec = PySequence_GetItem(client_specs, i);
+            if (!spec) throw EngineError("bad client spec");
+            ClientSpec c;
+            c.id = get_i64(spec, 0);
+            c.total = get_i64(spec, 1);
+            c.signed_mode = get_i64(spec, 2) != 0;
+            c.corrupt = get_i64(spec, 3) != 0;
+            PyObject *ignores = PySequence_GetItem(spec, 4);
+            Py_ssize_t n_ign = PySequence_Size(ignores);
+            for (Py_ssize_t k = 0; k < n_ign; k++)
+                c.ignore_nodes.insert((i32)get_i64(ignores, k));
+            Py_DECREF(ignores);
+            PyObject *payloads = PySequence_GetItem(spec, 5);
+            Py_ssize_t n_pl = PySequence_Size(payloads);
+            for (Py_ssize_t k = 0; k < n_pl; k++) {
+                PyObject *b = PySequence_GetItem(payloads, k);
+                char *buf;
+                Py_ssize_t blen;
+                if (PyBytes_AsStringAndSize(b, &buf, &blen) < 0) {
+                    Py_DECREF(b);
+                    Py_DECREF(payloads);
+                    Py_DECREF(spec);
+                    throw EngineError("payload must be bytes");
+                }
+                string payload(buf, (size_t)blen);
+                Py_DECREF(b);
+                c.payloads.push_back(engine->ctx.intern.put(payload));
+                c.payload_digests.push_back(
+                    engine->ctx.intern.put(sha256(payload)));
+            }
+            Py_DECREF(payloads);
+            PyObject *verdicts = PySequence_GetItem(spec, 6);
+            if (verdicts != Py_None) {
+                char *buf;
+                Py_ssize_t blen;
+                if (PyBytes_AsStringAndSize(verdicts, &buf, &blen) < 0) {
+                    Py_DECREF(verdicts);
+                    Py_DECREF(spec);
+                    throw EngineError("verdicts must be bytes or None");
+                }
+                for (Py_ssize_t k = 0; k < blen; k++)
+                    c.verdicts.push_back((u8)buf[k]);
+            }
+            Py_DECREF(verdicts);
+            Py_DECREF(spec);
+            engine->client_specs.push_back(std::move(c));
+        }
+
+        // Node specs.
+        Py_ssize_t n_ns = PySequence_Size(node_specs);
+        if (n_ns != (Py_ssize_t)n_nodes)
+            throw EngineError("node spec count mismatch");
+        for (Py_ssize_t i = 0; i < n_ns; i++) {
+            PyObject *spec = PySequence_GetItem(node_specs, i);
+            auto node = std::make_unique<EngineNode>();
+            node->id = (i32)i;
+            node->start_delay = get_i64(spec, 0);
+            node->runtime.tick_interval = get_i64(spec, 1);
+            node->runtime.link_latency = get_i64(spec, 2);
+            node->runtime.wal_latency = get_i64(spec, 3);
+            node->runtime.net_latency = get_i64(spec, 4);
+            node->runtime.hash_latency = get_i64(spec, 5);
+            node->runtime.client_latency = get_i64(spec, 6);
+            node->runtime.app_latency = get_i64(spec, 7);
+            node->runtime.req_store_latency = get_i64(spec, 8);
+            node->runtime.events_latency = get_i64(spec, 9);
+            node->init_parms.id = (i32)i;
+            node->init_parms.batch_size = get_i64(spec, 10);
+            node->init_parms.heartbeat_ticks = get_i64(spec, 11);
+            node->init_parms.suspect_ticks = get_i64(spec, 12);
+            node->init_parms.new_epoch_timeout_ticks = get_i64(spec, 13);
+            node->init_parms.buffer_size = get_i64(spec, 14);
+            Py_DECREF(spec);
+            engine->nodes.push_back(std::move(node));
+        }
+
+        // Seed node worlds + initialize events (Recorder.recording()).
+        for (i64 i = 0; i < n_nodes; i++) {
+            engine->init_node_world((i32)i, engine->ctx.init_clients);
+            SimEv ev;
+            ev.time = engine->queue.fake_time +
+                      engine->nodes[(size_t)i]->start_delay;
+            ev.kind = SK::Initialize;
+            ev.target = (i32)i;
+            engine->queue.insert(std::move(ev));
+        }
+
+        // Drain-predicate bookkeeping (recorder.py:761-803 invariants).
+        for (const auto &c : engine->client_specs) {
+            i64 target = c.corrupt ? 0 : c.total;
+            engine->drain_targets.emplace(c.id, target);
+            if (target > 0) {
+                engine->client_satisfied.emplace(c.id, false);
+                engine->clients_unsatisfied += 1;
+            }
+        }
+        for (auto &node : engine->nodes) {
+            node->drain_ready = engine->node_lws_ready(*node);
+            if (!node->drain_ready) engine->nodes_not_ready += 1;
+        }
+    } catch (const std::exception &e) {
+        delete engine;
+        if (!PyErr_Occurred()) PyErr_SetString(PyExc_RuntimeError, e.what());
+        return nullptr;
+    }
+    PyEngine *self = (PyEngine *)type->tp_alloc(type, 0);
+    if (!self) {
+        delete engine;
+        return nullptr;
+    }
+    self->engine = engine;
+    return (PyObject *)self;
+}
+
+// run(max_steps, timeout) -> (executed_steps, done, timed_out)
+PyObject *engine_run(PyObject *self, PyObject *args) {
+    long long max_steps, timeout;
+    if (!PyArg_ParseTuple(args, "LL", &max_steps, &timeout)) return nullptr;
+    Engine *e = ((PyEngine *)self)->engine;
+    bool done = false, timed_out = false;
+    i64 executed = 0;
+    string error;
+    {
+        PyThreadState *save = PyEval_SaveThread();
+        try {
+            executed = e->run(max_steps, timeout, &done, &timed_out);
+        } catch (const std::exception &ex) {
+            error = ex.what();
+            if (error.empty()) error = "fastengine error";
+        }
+        PyEval_RestoreThread(save);
+    }
+    if (!error.empty()) {
+        PyErr_SetString(PyExc_RuntimeError, error.c_str());
+        return nullptr;
+    }
+    return Py_BuildValue("Lii", (long long)executed, done ? 1 : 0,
+                         timed_out ? 1 : 0);
+}
+
+PyObject *engine_stats(PyObject *self, PyObject *) {
+    Engine *e = ((PyEngine *)self)->engine;
+    return Py_BuildValue("LLLd", (long long)e->steps,
+                         (long long)e->queue.fake_time,
+                         (long long)e->committed_ops,
+                         (double)e->crypto_ns / 1e9);
+}
+
+// node_summary(i) -> (checkpoint_seq_no, checkpoint_hash, epoch,
+//                     last_seq_no, active_hash, committed_reqs dict,
+//                     {client_id: low_watermark})
+PyObject *engine_node_summary(PyObject *self, PyObject *args) {
+    int i;
+    if (!PyArg_ParseTuple(args, "i", &i)) return nullptr;
+    Engine *e = ((PyEngine *)self)->engine;
+    if (i < 0 || (size_t)i >= e->nodes.size()) {
+        PyErr_SetString(PyExc_IndexError, "node index out of range");
+        return nullptr;
+    }
+    EngineNode &node = *e->nodes[(size_t)i];
+    i64 epoch = -1;
+    if (node.machine && node.machine->epoch_tracker &&
+        node.machine->epoch_tracker->current_epoch)
+        epoch = node.machine->epoch_tracker->current_epoch->number;
+    PyObject *committed = PyDict_New();
+    if (!committed) return nullptr;
+    for (const auto &pr : node.state.committed_reqs) {
+        PyObject *v = PyLong_FromLongLong(pr.second);
+        PyObject *k = PyLong_FromLongLong(pr.first);
+        if (!v || !k || PyDict_SetItem(committed, k, v) < 0) {
+            Py_XDECREF(v);
+            Py_XDECREF(k);
+            Py_DECREF(committed);
+            return nullptr;
+        }
+        Py_DECREF(v);
+        Py_DECREF(k);
+    }
+    PyObject *lws = PyDict_New();
+    if (!lws) {
+        Py_DECREF(committed);
+        return nullptr;
+    }
+    if (node.state.checkpoint_state) {
+        for (const auto &cs : node.state.checkpoint_state->clients) {
+            PyObject *v = PyLong_FromLongLong(cs.lw);
+            PyObject *k = PyLong_FromLongLong(cs.id);
+            if (!v || !k || PyDict_SetItem(lws, k, v) < 0) {
+                Py_XDECREF(v);
+                Py_XDECREF(k);
+                Py_DECREF(committed);
+                Py_DECREF(lws);
+                return nullptr;
+            }
+            Py_DECREF(v);
+            Py_DECREF(k);
+        }
+    }
+    string active = node.state.active_hash.digest();
+    return Py_BuildValue(
+        "Ly#LLy#NN", (long long)node.state.checkpoint_seq_no,
+        node.state.checkpoint_hash.data(),
+        (Py_ssize_t)node.state.checkpoint_hash.size(), (long long)epoch,
+        (long long)node.state.last_seq_no, active.data(),
+        (Py_ssize_t)active.size(), committed, lws);
+}
+
+// pop_hash_log() -> list[(message_bytes, digest_bytes)]
+PyObject *engine_pop_hash_log(PyObject *self, PyObject *) {
+    Engine *e = ((PyEngine *)self)->engine;
+    PyObject *out = PyList_New(0);
+    if (!out) return nullptr;
+    for (const auto &pr : e->wave_log) {
+        const string &m = e->ctx.intern.get(pr.first);
+        const string &d = e->ctx.intern.get(pr.second);
+        PyObject *item =
+            Py_BuildValue("y#y#", m.data(), (Py_ssize_t)m.size(), d.data(),
+                          (Py_ssize_t)d.size());
+        if (!item || PyList_Append(out, item) < 0) {
+            Py_XDECREF(item);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        Py_DECREF(item);
+    }
+    e->wave_log.clear();
+    return out;
+}
+
+int PyDictSetItemStringSteal(PyObject *d, const char *k, PyObject *v) {
+    int r = PyDict_SetItemString(d, k, v);
+    Py_DECREF(v);
+    return r;
+}
+
+PyObject *engine_profile(PyObject *self, PyObject *) {
+    Engine *e = ((PyEngine *)self)->engine;
+    static const char *names[11] = {
+        "initialize", "msg_received", "client_proposal", "tick",
+        "proc_wal", "proc_net", "proc_hash", "proc_client", "proc_app",
+        "proc_req_store", "proc_result"};
+    PyObject *out = PyDict_New();
+    if (!out) return nullptr;
+    for (int i = 0; i < 11; i++) {
+        PyObject *v = Py_BuildValue("KK", (unsigned long long)e->kind_cycles[i],
+                                    (unsigned long long)e->kind_counts[i]);
+        if (!v || PyDictSetItemStringSteal(out, names[i], v) < 0) return nullptr;
+    }
+    static const char *part_names[6] = {"p_ackbatch", "p_votes", "p_fixpoint",
+                                        "p_coalesce", "p_ackrun", "p_other"};
+    for (int i = 0; i < 6; i++) {
+        PyObject *v = Py_BuildValue(
+            "KK", (unsigned long long)g_parts[i].load(std::memory_order_relaxed),
+            (unsigned long long)0);
+        if (!v || PyDictSetItemStringSteal(out, part_names[i], v) < 0)
+            return nullptr;
+    }
+    static const char *ev_names[10] = {
+        "ev_init", "ev_load", "ev_load_done", "ev_hash_result",
+        "ev_checkpoint_result", "ev_request_persisted", "ev_step",
+        "ev_tick", "ev_actions_received", "ev_pad"};
+    for (int i = 0; i < 10; i++) {
+        PyObject *v = Py_BuildValue("KK", (unsigned long long)e->ev_cycles[i],
+                                    (unsigned long long)e->ev_counts[i]);
+        if (!v || PyDictSetItemStringSteal(out, ev_names[i], v) < 0) return nullptr;
+    }
+    return out;
+}
+
+PyMethodDef engine_methods[] = {
+    {"run", engine_run, METH_VARARGS, nullptr},
+    {"stats", engine_stats, METH_NOARGS, nullptr},
+    {"node_summary", engine_node_summary, METH_VARARGS, nullptr},
+    {"pop_hash_log", engine_pop_hash_log, METH_NOARGS, nullptr},
+    {"profile", engine_profile, METH_NOARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject EngineType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+PyModuleDef fast_moduledef = {
+    PyModuleDef_HEAD_INIT, "_fast",
+    "Native fast-path cluster engine (C++ twin of the Python testengine).",
+    -1, nullptr, nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__fast(void) {
+    EngineType.tp_name = "mirbft_tpu._native._fast.FastEngine";
+    EngineType.tp_basicsize = sizeof(PyEngine);
+    EngineType.tp_flags = Py_TPFLAGS_DEFAULT;
+    EngineType.tp_new = engine_new;
+    EngineType.tp_dealloc = engine_dealloc;
+    EngineType.tp_methods = engine_methods;
+    if (PyType_Ready(&EngineType) < 0) return nullptr;
+    PyObject *m = PyModule_Create(&fast_moduledef);
+    if (!m) return nullptr;
+    Py_INCREF(&EngineType);
+    if (PyModule_AddObject(m, "FastEngine", (PyObject *)&EngineType) < 0) {
+        Py_DECREF(&EngineType);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    return m;
+}

@@ -57,6 +57,20 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+def block_bucket_of(length: int, ladder=None, max_block_bucket: int = 64):
+    """Device block bucket for a message of ``length`` bytes, or None when it
+    exceeds the ladder (host-only).  Single source of the dispatch geometry —
+    shared by DeviceHashPlane and the fast engine's wave mirror, which must
+    hit the exact kernel shapes ``bench.warm_kernels`` compiles."""
+    if ladder is None:
+        ladder = DeviceHashPlane.BLOCK_LADDER
+    n_blocks = (length + 8) // 64 + 1
+    for b in ladder:
+        if n_blocks <= b and b <= max_block_bucket:
+            return b
+    return None
+
+
 def _host_fast(parts: Sequence[bytes]) -> bool:
     """Tiny single-part inputs (request-body digests on the propose path)
     always take the synchronous hashlib path: one C call beats any memo or
@@ -147,11 +161,10 @@ class DeviceHashPlane:
         pending, self._pending = self._pending, OrderedDict()
         groups: Dict[int, List[tuple]] = {}
         for key, (refs, message) in pending.items():
-            n_blocks = (len(message) + 8) // 64 + 1
-            bucket = next(
-                (b for b in self.BLOCK_LADDER if n_blocks <= b), None
+            bucket = block_bucket_of(
+                len(message), self.BLOCK_LADDER, self.max_block_bucket
             )
-            if bucket is None or bucket > self.max_block_bucket:
+            if bucket is None:
                 # Above the device ladder: host-hash immediately.
                 self._memo_put(key, refs, self._host_hash(message))
                 continue

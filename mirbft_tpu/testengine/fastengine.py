@@ -1,0 +1,335 @@
+"""Python wrapper for the native fast-path cluster engine (_native/fastengine.cpp).
+
+``FastRecording`` mirrors the subset of ``Recording``'s API the bench and
+tests consume (``drain_clients``, per-node final state), running the WHOLE
+simulation in C++.  It is a bit-identical twin of the Python engine on
+supported configs (see the equivalence contract in fastengine.cpp and
+tests/test_fastengine.py); configs outside the envelope (manglers,
+reconfiguration, state transfer, restarts, >64 nodes) raise
+``FastEngineUnsupported`` at construction so callers can fall back.
+
+Device crypto in fast runs:
+
+* **Hashing** — protocol digests are SHA-256 of the same bytes on host or
+  device, so the engine hashes inline and mirrors every wave-eligible
+  message into a wave log.  With ``device=True`` the wrapper drains that log
+  during stepping, dispatches the waves to the TPU hasher *asynchronously*
+  (the engine never blocks on the tunnel), and verifies at collect time that
+  every device digest is bit-identical to the digest the engine used.  The
+  device is a verifying coprocessor here rather than the serial producer —
+  on this rig a blocking per-wave collect would cost a ~100 ms tunnel RTT
+  against microseconds of simulation (docs/PERFORMANCE.md §1).
+* **Ed25519** — signed-request verdicts are computed before the run by the
+  device verifier in pipelined waves (``Ed25519BatchVerifier``), then fed to
+  the engine as a verdict bitmap: every verdict the engine consumes comes
+  from the device (host fallback only if the device path is unavailable).
+  Corrupt (byzantine) signers therefore stay rejected on the device path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+from .. import _native, metrics
+from .recorder import Spec, _u64
+
+
+class FastEngineUnsupported(RuntimeError):
+    """The config (or a mid-run condition) is outside the fast engine's
+    envelope; use the Python engine."""
+
+
+class _NodeFinal:
+    """Final-state view of one node (mirrors the attributes asserts use)."""
+
+    __slots__ = ("checkpoint_seq_no", "checkpoint_hash", "epoch",
+                 "last_seq_no", "active_hash_digest", "committed_reqs",
+                 "client_low_watermarks")
+
+    def __init__(self, summary):
+        (self.checkpoint_seq_no, self.checkpoint_hash, self.epoch,
+         self.last_seq_no, self.active_hash_digest, self.committed_reqs,
+         self.client_low_watermarks) = summary
+
+
+def _require(cond: bool, why: str) -> None:
+    if not cond:
+        raise FastEngineUnsupported(f"fast engine: {why}")
+
+
+class FastRecording:
+    """Drives one native-engine simulation built from a ``Spec``."""
+
+    def __init__(
+        self,
+        spec: Spec,
+        device: bool = False,
+        hash_wave: int = 64,
+        auth_wave: int = 1024,
+    ):
+        _require(_native.load_fast() is not None, "native engine unavailable")
+        _require(1 <= spec.node_count <= 64, ">64 nodes")
+        recorder = spec.recorder()
+        _require(recorder.mangler is None, "manglers")
+        _require(not recorder.reconfig_points, "reconfiguration")
+        _require(recorder.event_log_writer is None, "event log interception")
+        # defer_unready makes the Python engine's step counts wall-clock
+        # dependent (extra re-scheduled hash events); the fast engine hashes
+        # inline, so that mode cannot be twinned bit-identically.
+        _require(
+            spec.crypto is None or not spec.crypto.defer_unready,
+            "defer_unready crypto mode",
+        )
+        net = recorder.network_state.config
+        _require(
+            tuple(net.nodes) == tuple(range(spec.node_count)),
+            "non-dense node ids",
+        )
+
+        self.spec = spec
+        self.device = device
+        self.hash_wave = hash_wave
+        self._py_crypto_s = 0.0
+        self._hasher = None
+        self._inflight: List[tuple] = []
+        self._pending_msgs: List[bytes] = []
+        self._pending_digests: List[bytes] = []
+
+        client_states = [(c.id, c.width) for c in recorder.network_state.clients]
+
+        # Materialize payloads; signed envelopes verify in one pipelined
+        # device pass spanning ALL clients (one wave set, one collect) —
+        # per-client dispatch would serialize a tunnel RTT per client.
+        payloads_by_client: Dict[int, List[bytes]] = {}
+        signed_rows: List[Tuple[int, int]] = []  # (client_id, req_no)
+        sim_clients = {}
+        for cc in recorder.client_configs:
+            if cc.signed:
+                from .recorder import SimClient
+
+                sim_clients[cc.id] = SimClient(cc)
+                payloads_by_client[cc.id] = [
+                    sim_clients[cc.id].request_by_req_no(r)
+                    for r in range(cc.total)
+                ]
+                signed_rows.extend((cc.id, r) for r in range(cc.total))
+            else:
+                payloads_by_client[cc.id] = [
+                    _u64(cc.id) + b"-" + _u64(req_no)
+                    for req_no in range(cc.total)
+                ]
+        verdicts_by_client = self._device_verdicts(
+            signed_rows, sim_clients, payloads_by_client, auth_wave
+        )
+
+        client_specs = []
+        for cc in recorder.client_configs:
+            client_specs.append(
+                (cc.id, cc.total, int(cc.signed), int(cc.corrupt),
+                 tuple(cc.ignore_nodes), payloads_by_client[cc.id],
+                 verdicts_by_client.get(cc.id))
+            )
+
+        node_specs = []
+        for nc in recorder.node_configs:
+            rp = nc.runtime_parms
+            ip = nc.init_parms
+            node_specs.append(
+                (nc.start_delay, rp.tick_interval, rp.link_latency,
+                 rp.process_wal_latency, rp.process_net_latency,
+                 rp.process_hash_latency, rp.process_client_latency,
+                 rp.process_app_latency, rp.process_req_store_latency,
+                 rp.process_events_latency, ip.batch_size,
+                 ip.heartbeat_ticks, ip.suspect_ticks,
+                 ip.new_epoch_timeout_ticks, ip.buffer_size)
+            )
+
+        self._engine = _native.fast.FastEngine(
+            (spec.node_count, net.checkpoint_interval, net.max_epoch_length,
+             net.number_of_buckets, net.f),
+            client_states, client_specs, node_specs,
+        )
+        self.steps = 0
+        self.nodes: List[_NodeFinal] = []
+
+    # -- device planes -----------------------------------------------------
+
+    def _device_verdicts(
+        self, signed_rows, sim_clients, payloads_by_client, auth_wave
+    ) -> Dict[int, bytes]:
+        """Authenticate every signed envelope up front in ONE pipelined pass
+        over all clients: all waves dispatch before the first collect, so
+        the whole verdict set costs ~one tunnel round-trip.  Returns
+        {client_id: verdict byte per req_no}."""
+        if not signed_rows:
+            return {}
+        import time as _time
+
+        from ..processor.verify import signing_payload, unseal
+
+        crypto_start = _time.perf_counter()
+        pubs, msgs, sigs = [], [], []
+        for client_id, req_no in signed_rows:
+            envelope = payloads_by_client[client_id][req_no]
+            parts = unseal(envelope)
+            if parts is None:
+                pubs.append(b"\x00" * 32)
+                msgs.append(b"")
+                sigs.append(b"\x00" * 64)
+                continue
+            payload, signature = parts
+            pubs.append(sim_clients[client_id].public_key())
+            msgs.append(signing_payload(client_id, req_no, payload))
+            sigs.append(signature)
+
+        if self.device:
+            from ..ops.ed25519 import Ed25519BatchVerifier
+
+            verifier = Ed25519BatchVerifier(min_device_batch=1)
+            handles = []
+            for start in range(0, len(pubs), auth_wave):
+                handles.append(
+                    verifier.dispatch(
+                        pubs[start:start + auth_wave],
+                        msgs[start:start + auth_wave],
+                        sigs[start:start + auth_wave],
+                    )
+                )
+                metrics.counter("device_verify_dispatches").inc()
+                metrics.counter("device_verified_signatures").inc(
+                    len(pubs[start:start + auth_wave])
+                )
+            # Host crypto ends at dispatch; blocking on device results is
+            # device wait, not host CPU.
+            self._py_crypto_s += _time.perf_counter() - crypto_start
+            crypto_start = None
+            collect_start = _time.perf_counter()
+            verdicts = []
+            for handle in handles:
+                verdicts.extend(bool(v) for v in verifier.collect(handle))
+            metrics.counter("device_wait_seconds").inc(
+                _time.perf_counter() - collect_start
+            )
+        else:
+            from ..ops.ed25519 import verify_one
+
+            verdicts = [
+                bool(verify_one(pub, msg, sig))
+                for pub, msg, sig in zip(pubs, msgs, sigs)
+            ]
+
+        if crypto_start is not None:
+            self._py_crypto_s += _time.perf_counter() - crypto_start
+        out: Dict[int, bytearray] = {}
+        for (client_id, req_no), verdict in zip(signed_rows, verdicts):
+            arr = out.setdefault(
+                client_id,
+                bytearray(len(payloads_by_client[client_id])),
+            )
+            arr[req_no] = int(verdict)
+        return {cid: bytes(arr) for cid, arr in out.items()}
+
+    # Device dispatch geometry shared with DeviceHashPlane via
+    # crypto.block_bucket_of: the fast path must hit the exact kernel shapes
+    # the bench warms (anything else would trigger a fresh XLA compile
+    # mid-run).
+    _BATCH_BUCKET = 64
+
+    def _drain_hash_log(self) -> None:
+        """Mirror the engine's wave-eligible hash content to the device:
+        async dispatches during the run, digests checked at collect."""
+        from .crypto import block_bucket_of
+
+        log = self._engine.pop_hash_log()
+        if not log or not self.device:
+            return
+        if self._hasher is None:
+            from ..ops.sha256 import TpuHasher
+
+            self._hasher = TpuHasher(min_device_batch=1)
+        for message, digest in log:
+            bucket = block_bucket_of(len(message))
+            if bucket is None:
+                continue  # above the device ladder (host-only content)
+            self._pending_msgs.append((bucket, message))
+            self._pending_digests.append(digest)
+        while len(self._pending_msgs) >= self.hash_wave:
+            self._launch_waves()
+
+    def _launch_waves(self) -> None:
+        """One async dispatch per block bucket over the pending set, in
+        ladder-shape chunks (mirrors DeviceHashPlane._launch_wave)."""
+        pending = list(zip(self._pending_msgs, self._pending_digests))
+        self._pending_msgs = []
+        self._pending_digests = []
+        by_bucket: Dict[int, List[Tuple[bytes, bytes]]] = {}
+        for (bucket, message), digest in pending:
+            by_bucket.setdefault(bucket, []).append((message, digest))
+        for bucket in sorted(by_bucket):
+            entries = by_bucket[bucket]
+            for start in range(0, len(entries), self._BATCH_BUCKET):
+                chunk = entries[start:start + self._BATCH_BUCKET]
+                handle = self._hasher.dispatch(
+                    [m for m, _ in chunk],
+                    block_bucket=bucket,
+                    batch_bucket=self._BATCH_BUCKET,
+                )
+                self._inflight.append((handle, [d for _, d in chunk]))
+                metrics.counter("device_hash_dispatches").inc()
+                metrics.counter("device_hashed_messages").inc(len(chunk))
+
+    def _collect_inflight(self) -> None:
+        if self._pending_msgs:
+            self._launch_waves()
+        for handle, expected in self._inflight:
+            digests = self._hasher.collect(handle)
+            for device_digest, engine_digest in zip(digests, expected):
+                if bytes(device_digest) != engine_digest:
+                    raise AssertionError(
+                        "device digest diverged from engine digest"
+                    )
+        self._inflight = []
+
+    # -- drive -------------------------------------------------------------
+
+    def drain_clients(self, timeout: int, slice_steps: int = 200_000) -> int:
+        """Run until every client's requests commit on every node; returns
+        the step count (bit-identical to the Python engine's)."""
+        done = False
+        while not done:
+            try:
+                _, done, timed_out = self._engine.run(slice_steps, timeout)
+            except RuntimeError as exc:
+                raise FastEngineUnsupported(str(exc)) from exc
+            self._drain_hash_log()
+            if timed_out:
+                raise TimeoutError(
+                    f"fast engine timed out after {self.stats()[0]} steps"
+                )
+        self._collect_inflight()
+        self.steps = self._engine.stats()[0]
+        self.nodes = [
+            _NodeFinal(self._engine.node_summary(i))
+            for i in range(self.spec.node_count)
+        ]
+        return self.steps
+
+    def stats(self) -> Tuple[int, int, int]:
+        """(steps, fake_time, committed_ops)."""
+        steps, fake_time, ops, _ = self._engine.stats()
+        return steps, fake_time, ops
+
+    def host_crypto_seconds(self) -> float:
+        """Host CPU seconds spent in crypto: in-engine SHA-256 (chrono-timed)
+        plus the wrapper's Python-side verification work (metered into the
+        shared metrics registry at verdict time)."""
+        return self._engine.stats()[3] + self._py_crypto_s
+
+
+def run_fast(
+    spec: Spec, device: bool = False, timeout: int = 100_000_000
+) -> FastRecording:
+    rec = FastRecording(spec, device=device)
+    rec.drain_clients(timeout)
+    return rec

@@ -1,0 +1,175 @@
+"""Differential tests: the native fast engine is a bit-identical twin of the
+Python testengine on supported configs.
+
+The equivalence contract (mirbft_tpu/_native/fastengine.cpp header): same
+simulation step counts, same final fake-time, same per-node app hash chains,
+same checkpoint seq/values, same epoch numbers, same committed-request maps.
+The two implementations share no code — the Python engine runs the Python
+state machine (with the native ack/vote planes), the fast engine is an
+independent C++ transcription — so agreement on the full evolution of a
+cluster run pins both against each other.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from mirbft_tpu import _native
+from mirbft_tpu.testengine import For, Spec, matching
+from mirbft_tpu.testengine.fastengine import (
+    FastEngineUnsupported,
+    FastRecording,
+)
+
+pytestmark = pytest.mark.skipif(
+    _native.load_fast() is None, reason="native fast engine unavailable"
+)
+
+
+def _python_run(spec, timeout=10_000_000):
+    rec = spec.recorder().recording()
+    steps = rec.drain_clients(timeout=timeout)
+    state = [
+        (
+            n.state.checkpoint_seq_no,
+            n.state.checkpoint_hash,
+            n.state_machine.epoch_tracker.current_epoch.number,
+            n.state.last_seq_no,
+            n.state.active_hash.digest(),
+            dict(n.state.committed_reqs),
+        )
+        for n in rec.nodes
+    ]
+    return steps, rec.event_queue.fake_time, state
+
+
+def _fast_run(spec, timeout=10_000_000):
+    fr = FastRecording(spec)
+    steps = fr.drain_clients(timeout=timeout)
+    state = [
+        (
+            n.checkpoint_seq_no,
+            n.checkpoint_hash,
+            n.epoch,
+            n.last_seq_no,
+            n.active_hash_digest,
+            dict(n.committed_reqs),
+        )
+        for n in fr.nodes
+    ]
+    return steps, fr.stats()[1], state
+
+
+DIFFERENTIAL_SPECS = [
+    Spec(node_count=1, client_count=1, reqs_per_client=3, batch_size=1),
+    Spec(node_count=4, client_count=1, reqs_per_client=3, batch_size=1),
+    Spec(node_count=4, client_count=4, reqs_per_client=20, batch_size=5),
+    Spec(node_count=4, client_count=4, reqs_per_client=200, batch_size=1),
+    Spec(node_count=7, client_count=3, reqs_per_client=50, batch_size=10),
+    Spec(node_count=16, client_count=16, reqs_per_client=50, batch_size=100),
+    Spec(
+        node_count=16,
+        client_count=16,
+        reqs_per_client=10,
+        batch_size=100,
+        signed_requests=True,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "spec",
+    DIFFERENTIAL_SPECS,
+    ids=lambda s: f"n{s.node_count}c{s.client_count}r{s.reqs_per_client}"
+    f"b{s.batch_size}{'s' if s.signed_requests else ''}",
+)
+def test_bit_identical_to_python_engine(spec):
+    steps_py, time_py, state_py = _python_run(spec)
+    steps_fast, time_fast, state_fast = _fast_run(spec)
+    assert steps_fast == steps_py
+    assert time_fast == time_py
+    assert state_fast == state_py
+
+
+def test_64_replica_bit_identical():
+    """The headline config's shape at reduced request count (the full c3 run
+    is the bench's job; the scheduling/protocol paths are identical)."""
+    spec = Spec(node_count=64, client_count=64, reqs_per_client=5, batch_size=100)
+    steps_py, time_py, state_py = _python_run(spec, timeout=100_000_000)
+    steps_fast, time_fast, state_fast = _fast_run(spec, timeout=100_000_000)
+    assert (steps_fast, time_fast) == (steps_py, time_py)
+    assert state_fast == state_py
+
+
+def test_deterministic_across_runs():
+    spec = Spec(node_count=4, client_count=4, reqs_per_client=50, batch_size=10)
+    a = _fast_run(spec)
+    b = _fast_run(spec)
+    assert a == b
+
+
+def test_byzantine_signer_rejected():
+    """A corrupt signer's requests never commit (verdict bitmap path)."""
+    spec = Spec(
+        node_count=4,
+        client_count=2,
+        reqs_per_client=5,
+        batch_size=2,
+        signed_requests=True,
+    )
+
+    def run(engine):
+        tweaked = Spec(
+            node_count=4,
+            client_count=2,
+            reqs_per_client=5,
+            batch_size=2,
+            signed_requests=True,
+            tweak_recorder=lambda r: setattr(
+                r.client_configs[1], "corrupt", True
+            ),
+        )
+        if engine == "python":
+            rec = tweaked.recorder().recording()
+            steps = rec.drain_clients(timeout=10_000_000)
+            return steps, [dict(n.state.committed_reqs) for n in rec.nodes]
+        fr = FastRecording(tweaked)
+        steps = fr.drain_clients(timeout=10_000_000)
+        return steps, [dict(n.committed_reqs) for n in fr.nodes]
+
+    steps_py, committed_py = run("python")
+    steps_fast, committed_fast = run("fast")
+    assert steps_fast == steps_py
+    assert committed_fast == committed_py
+    for c in committed_fast:
+        assert c.get(1, 0) == 0  # byzantine client never commits
+
+
+def test_unsupported_configs_raise():
+    spec = Spec(node_count=65, client_count=1, reqs_per_client=1)
+    with pytest.raises(FastEngineUnsupported):
+        FastRecording(spec)
+
+    spec = Spec(node_count=4, client_count=1, reqs_per_client=1)
+
+    def add_mangler(recorder):
+        recorder.mangler = For(matching.msgs().from_node(0)).drop()
+
+    spec.tweak_recorder = add_mangler
+    with pytest.raises(FastEngineUnsupported):
+        FastRecording(spec)
+
+
+def test_out_of_envelope_escalates_cleanly():
+    """A config whose run needs state transfer (an ignored node can never
+    fetch the request bodies it lacks) raises instead of diverging."""
+    spec = Spec(
+        node_count=4,
+        client_count=2,
+        reqs_per_client=10,
+        batch_size=2,
+        clients_ignore=(2,),
+    )
+    fr = FastRecording(spec)
+    with pytest.raises(FastEngineUnsupported):
+        fr.drain_clients(timeout=10_000_000)
