@@ -5193,6 +5193,17 @@ struct PyEngine {
     Engine *engine;
 };
 
+// Owned-reference guard for the config-parsing paths: releases on every
+// exit, including EngineError throws from get_i64.
+struct PyRef {
+    PyObject *p;
+    explicit PyRef(PyObject *obj) : p(obj) {}
+    ~PyRef() { Py_XDECREF(p); }
+    PyRef(const PyRef &) = delete;
+    PyRef &operator=(const PyRef &) = delete;
+    explicit operator bool() const { return p != nullptr; }
+};
+
 i64 get_i64(PyObject *seq, Py_ssize_t i) {
     PyObject *o = PySequence_GetItem(seq, i);
     if (!o) throw EngineError("bad config item");
@@ -5228,64 +5239,61 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
         // Initial client states: (id, width).
         Py_ssize_t n_cs = PySequence_Size(client_states);
         for (Py_ssize_t i = 0; i < n_cs; i++) {
-            PyObject *cs = PySequence_GetItem(client_states, i);
+            PyRef cs(PySequence_GetItem(client_states, i));
+            if (!cs) throw EngineError("bad client state");
             ClientStateS c;
-            c.id = get_i64(cs, 0);
-            c.width = get_i64(cs, 1);
+            c.id = get_i64(cs.p, 0);
+            c.width = get_i64(cs.p, 1);
             c.wclc = 0;
             c.lw = 0;
-            Py_DECREF(cs);
             engine->ctx.init_clients.push_back(std::move(c));
         }
 
         // Client specs.
         Py_ssize_t n_clients = PySequence_Size(client_specs);
         for (Py_ssize_t i = 0; i < n_clients; i++) {
-            PyObject *spec = PySequence_GetItem(client_specs, i);
+            PyRef spec(PySequence_GetItem(client_specs, i));
             if (!spec) throw EngineError("bad client spec");
             ClientSpec c;
-            c.id = get_i64(spec, 0);
-            c.total = get_i64(spec, 1);
-            c.signed_mode = get_i64(spec, 2) != 0;
-            c.corrupt = get_i64(spec, 3) != 0;
-            PyObject *ignores = PySequence_GetItem(spec, 4);
-            Py_ssize_t n_ign = PySequence_Size(ignores);
-            for (Py_ssize_t k = 0; k < n_ign; k++)
-                c.ignore_nodes.insert((i32)get_i64(ignores, k));
-            Py_DECREF(ignores);
-            PyObject *payloads = PySequence_GetItem(spec, 5);
-            Py_ssize_t n_pl = PySequence_Size(payloads);
-            for (Py_ssize_t k = 0; k < n_pl; k++) {
-                PyObject *b = PySequence_GetItem(payloads, k);
-                char *buf;
-                Py_ssize_t blen;
-                if (PyBytes_AsStringAndSize(b, &buf, &blen) < 0) {
-                    Py_DECREF(b);
-                    Py_DECREF(payloads);
-                    Py_DECREF(spec);
-                    throw EngineError("payload must be bytes");
-                }
-                string payload(buf, (size_t)blen);
-                Py_DECREF(b);
-                c.payloads.push_back(engine->ctx.intern.put(payload));
-                c.payload_digests.push_back(
-                    engine->ctx.intern.put(sha256(payload)));
+            c.id = get_i64(spec.p, 0);
+            c.total = get_i64(spec.p, 1);
+            c.signed_mode = get_i64(spec.p, 2) != 0;
+            c.corrupt = get_i64(spec.p, 3) != 0;
+            {
+                PyRef ignores(PySequence_GetItem(spec.p, 4));
+                if (!ignores) throw EngineError("bad ignore list");
+                Py_ssize_t n_ign = PySequence_Size(ignores.p);
+                for (Py_ssize_t k = 0; k < n_ign; k++)
+                    c.ignore_nodes.insert((i32)get_i64(ignores.p, k));
             }
-            Py_DECREF(payloads);
-            PyObject *verdicts = PySequence_GetItem(spec, 6);
-            if (verdicts != Py_None) {
-                char *buf;
-                Py_ssize_t blen;
-                if (PyBytes_AsStringAndSize(verdicts, &buf, &blen) < 0) {
-                    Py_DECREF(verdicts);
-                    Py_DECREF(spec);
-                    throw EngineError("verdicts must be bytes or None");
+            {
+                PyRef payloads(PySequence_GetItem(spec.p, 5));
+                if (!payloads) throw EngineError("bad payload list");
+                Py_ssize_t n_pl = PySequence_Size(payloads.p);
+                for (Py_ssize_t k = 0; k < n_pl; k++) {
+                    PyRef b(PySequence_GetItem(payloads.p, k));
+                    char *buf;
+                    Py_ssize_t blen;
+                    if (!b || PyBytes_AsStringAndSize(b.p, &buf, &blen) < 0)
+                        throw EngineError("payload must be bytes");
+                    string payload(buf, (size_t)blen);
+                    c.payloads.push_back(engine->ctx.intern.put(payload));
+                    c.payload_digests.push_back(
+                        engine->ctx.intern.put(sha256(payload)));
                 }
-                for (Py_ssize_t k = 0; k < blen; k++)
-                    c.verdicts.push_back((u8)buf[k]);
             }
-            Py_DECREF(verdicts);
-            Py_DECREF(spec);
+            {
+                PyRef verdicts(PySequence_GetItem(spec.p, 6));
+                if (!verdicts) throw EngineError("bad verdicts");
+                if (verdicts.p != Py_None) {
+                    char *buf;
+                    Py_ssize_t blen;
+                    if (PyBytes_AsStringAndSize(verdicts.p, &buf, &blen) < 0)
+                        throw EngineError("verdicts must be bytes or None");
+                    for (Py_ssize_t k = 0; k < blen; k++)
+                        c.verdicts.push_back((u8)buf[k]);
+                }
+            }
             engine->client_specs.push_back(std::move(c));
         }
 
@@ -5294,26 +5302,26 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
         if (n_ns != (Py_ssize_t)n_nodes)
             throw EngineError("node spec count mismatch");
         for (Py_ssize_t i = 0; i < n_ns; i++) {
-            PyObject *spec = PySequence_GetItem(node_specs, i);
+            PyRef spec(PySequence_GetItem(node_specs, i));
+            if (!spec) throw EngineError("bad node spec");
             auto node = std::make_unique<EngineNode>();
             node->id = (i32)i;
-            node->start_delay = get_i64(spec, 0);
-            node->runtime.tick_interval = get_i64(spec, 1);
-            node->runtime.link_latency = get_i64(spec, 2);
-            node->runtime.wal_latency = get_i64(spec, 3);
-            node->runtime.net_latency = get_i64(spec, 4);
-            node->runtime.hash_latency = get_i64(spec, 5);
-            node->runtime.client_latency = get_i64(spec, 6);
-            node->runtime.app_latency = get_i64(spec, 7);
-            node->runtime.req_store_latency = get_i64(spec, 8);
-            node->runtime.events_latency = get_i64(spec, 9);
+            node->start_delay = get_i64(spec.p, 0);
+            node->runtime.tick_interval = get_i64(spec.p, 1);
+            node->runtime.link_latency = get_i64(spec.p, 2);
+            node->runtime.wal_latency = get_i64(spec.p, 3);
+            node->runtime.net_latency = get_i64(spec.p, 4);
+            node->runtime.hash_latency = get_i64(spec.p, 5);
+            node->runtime.client_latency = get_i64(spec.p, 6);
+            node->runtime.app_latency = get_i64(spec.p, 7);
+            node->runtime.req_store_latency = get_i64(spec.p, 8);
+            node->runtime.events_latency = get_i64(spec.p, 9);
             node->init_parms.id = (i32)i;
-            node->init_parms.batch_size = get_i64(spec, 10);
-            node->init_parms.heartbeat_ticks = get_i64(spec, 11);
-            node->init_parms.suspect_ticks = get_i64(spec, 12);
-            node->init_parms.new_epoch_timeout_ticks = get_i64(spec, 13);
-            node->init_parms.buffer_size = get_i64(spec, 14);
-            Py_DECREF(spec);
+            node->init_parms.batch_size = get_i64(spec.p, 10);
+            node->init_parms.heartbeat_ticks = get_i64(spec.p, 11);
+            node->init_parms.suspect_ticks = get_i64(spec.p, 12);
+            node->init_parms.new_epoch_timeout_ticks = get_i64(spec.p, 13);
+            node->init_parms.buffer_size = get_i64(spec.p, 14);
             engine->nodes.push_back(std::move(node));
         }
 

@@ -204,3 +204,58 @@ def test_key_cache_reuse():
     pub, msg, sig = bytes.fromhex(pk), bytes.fromhex(msg), bytes.fromhex(sig)
     assert verifier.verify_batch([pub] * 3, [msg] * 3, [sig] * 3).all()
     assert len(verifier._key_cache) == 1
+
+
+def test_mxu_vpu_field_multiply_equivalent():
+    """The bf16-MXU nibble formulation computes the exact same field product
+    as the int32-VPU formulation on random loose limbs (|l| <= 511)."""
+    import numpy as np
+
+    from mirbft_tpu.ops.ed25519 import P, _mul_mxu, _mul_vpu, limbs_to_int
+
+    rng = np.random.default_rng(7)
+    a = rng.integers(-511, 512, size=(64, 32)).astype(np.int32)
+    b = rng.integers(-511, 512, size=(64, 32)).astype(np.int32)
+    ref = np.asarray(_mul_vpu(a, b))
+    got = np.asarray(_mul_mxu(a, b))
+    for i in range(a.shape[0]):
+        assert (limbs_to_int(ref[i]) - limbs_to_int(got[i])) % P == 0
+
+
+def test_mxu_backend_verifies_and_rejects():
+    """Both kernel backends agree with the pure-Python reference on valid,
+    corrupted, and non-canonical signatures."""
+    import numpy as np
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier, verify_one
+
+    pubs, msgs, sigs = [], [], []
+    for i in range(24):
+        key = Ed25519PrivateKey.from_private_bytes(
+            (i + 1).to_bytes(4, "big") * 8
+        )
+        m = b"mxu-test-%d" % i
+        sig = key.sign(m)
+        if i % 4 == 1:
+            sig = sig[:5] + bytes([sig[5] ^ 1]) + sig[6:]  # corrupt R
+        elif i % 4 == 2:
+            m = m + b"-tampered"  # message mismatch
+        pubs.append(
+            key.public_key().public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            )
+        )
+        msgs.append(m)
+        sigs.append(sig)
+
+    expected = np.array(
+        [verify_one(p, m, s) for p, m, s in zip(pubs, msgs, sigs)], dtype=bool
+    )
+    for backend in ("vpu", "mxu"):
+        verifier = Ed25519BatchVerifier(min_device_batch=1, kernel=backend)
+        got = verifier.verify_batch(pubs, msgs, sigs)
+        assert (got == expected).all(), backend
