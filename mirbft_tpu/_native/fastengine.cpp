@@ -572,9 +572,11 @@ enum class AT : u8 {
     AllocatedRequest, CorrectRequest, ForwardRequest, StateApplied,
 };
 
+using Targets = shared_ptr<const vector<i32>>;
+
 struct ActionS {
     AT t;
-    vector<i32> targets;        // Send / ForwardRequest
+    Targets targets;            // Send / ForwardRequest
     MsgP msg;                   // Send
     HashReqP hash;              // Hash
     i64 index = 0;              // Persist / Truncate
@@ -749,18 +751,26 @@ struct Ctx {
     NetConfigS cfg;
     vector<ClientStateS> init_clients;
     i64 iq, wq;
+    // Shared broadcast target set: most sends address every node, and the
+    // per-send 64-int vector alloc+copy was a measurable share of the run.
+    Targets bcast;
 
     void finish_init() {
         wire.in = &intern;
         Quorums q{(i64)cfg.nodes.size(), cfg.f};
         iq = q.iq();
         wq = q.wq();
+        bcast = std::make_shared<vector<i32>>(cfg.nodes);
     }
 };
 
 // Action builder helpers (statemachine/actions.py fluent constructors).
-ActionS act_send(vector<i32> targets, MsgP msg) {
+ActionS act_send(Targets targets, MsgP msg) {
     ActionS a; a.t = AT::Send; a.targets = std::move(targets); a.msg = std::move(msg); return a;
+}
+ActionS act_send(vector<i32> targets, MsgP msg) {
+    return act_send(std::make_shared<const vector<i32>>(std::move(targets)),
+                    std::move(msg));
 }
 ActionS act_hash(vector<string> parts, HashOriginS origin) {
     ActionS a; a.t = AT::Hash;
@@ -788,7 +798,9 @@ ActionS act_correct(AckS ack) {
     ActionS a; a.t = AT::CorrectRequest; a.ack = ack; return a;
 }
 ActionS act_forward(vector<i32> targets, AckS ack) {
-    ActionS a; a.t = AT::ForwardRequest; a.targets = std::move(targets); a.ack = ack; return a;
+    ActionS a; a.t = AT::ForwardRequest;
+    a.targets = std::make_shared<const vector<i32>>(std::move(targets));
+    a.ack = ack; return a;
 }
 ActionS act_state_applied(i64 seq, NetStateP ns) {
     ActionS a; a.t = AT::StateApplied; a.seq = seq; a.netstate = std::move(ns); return a;
@@ -1519,7 +1531,7 @@ struct ClientReqNoD {
     }
 
     // attention_tick (disseminator.py:270-318); returns promoted.
-    bool attention_tick(Actions &actions, const vector<i32> &nodes,
+    bool attention_tick(Actions &actions, const Targets &nodes,
                         const Interner &intern) {
         bool promoted = false;
         if (!my_requests.contains(0) && weak_requests.size() > 1) {
@@ -1804,7 +1816,7 @@ struct ClientD {
         }
     }
 
-    Actions advance_acks(const vector<i32> &nodes) {
+    Actions advance_acks(const Targets &nodes) {
         Actions actions;
         vector<AckS> acks;
         for (i64 i = next_ack_mark; i <= high_watermark; i++) {
@@ -1847,7 +1859,7 @@ struct ClientD {
         if (crn) update_attention(*crn);
     }
 
-    void tick(Actions &actions, const vector<i32> &nodes) {
+    void tick(Actions &actions, const Targets &nodes) {
         tick_count += 1;
         if (!attention.empty()) {
             // Python iterates sorted(attention) over a snapshot.
@@ -1949,7 +1961,7 @@ struct Disseminator {
     Actions tick() {
         Actions actions;
         for (const auto &cs : client_states)
-            clients.at(cs.id)->tick(actions, ctx->cfg.nodes);
+            clients.at(cs.id)->tick(actions, ctx->bcast);
         return actions;
     }
 
@@ -2035,7 +2047,7 @@ struct Disseminator {
         Actions actions;
         for (i64 client_id : ack_dirty) {  // std::set: sorted like Python
             ClientD *c = client(client_id);
-            if (c) concat(actions, c->advance_acks(ctx->cfg.nodes));
+            if (c) concat(actions, c->advance_acks(ctx->bcast));
         }
         ack_dirty.clear();
         return actions;
@@ -2337,7 +2349,7 @@ struct CommitState {
 
         Actions actions = persisted->append(pe_c(seq_no, value, ns));
         actions.push_back(
-            act_send(ctx->cfg.nodes, mk_checkpoint_msg(seq_no, value)));
+            act_send(ctx->bcast, mk_checkpoint_msg(seq_no, value)));
         actions.push_back(act_state_applied(seq_no, ns));
         return actions;
     }
@@ -2543,10 +2555,10 @@ struct Sequence {
                     actions.push_back(act_forward(std::move(missing), cr->ack));
             }
             actions.push_back(
-                act_send(ctx->cfg.nodes, mk_preprepare(seq_no, epoch, batch)));
+                act_send(ctx->bcast, mk_preprepare(seq_no, epoch, batch)));
         } else {
             actions.push_back(act_send(
-                ctx->cfg.nodes, mk_prepare(seq_no, epoch, key_of(digest))));
+                ctx->bcast, mk_prepare(seq_no, epoch, key_of(digest))));
         }
         return actions;
     }
@@ -2583,7 +2595,7 @@ struct Sequence {
         state = SeqState::PREPARED;
         Actions actions = persisted->append(pe_p(seq_no, my_key));
         actions.push_back(
-            act_send(ctx->cfg.nodes, mk_commit(seq_no, epoch, my_key)));
+            act_send(ctx->bcast, mk_commit(seq_no, epoch, my_key)));
         return actions;
     }
 
@@ -3134,7 +3146,7 @@ struct ActiveEpoch {
         Actions actions;
 
         if (ticks_since_progress > my_config.suspect_ticks) {
-            actions.push_back(act_send(ctx->cfg.nodes,
+            actions.push_back(act_send(ctx->bcast,
                                        mk_suspect(epoch_config.number)));
             concat(actions, persisted->append(pe_suspect(epoch_config.number)));
         }
@@ -3537,7 +3549,7 @@ struct EpochTarget {
         auto echo = std::make_shared<MsgS>();
         echo->t = MT::NewEpochEcho;
         echo->necfg = leader_new_epoch->necfg;
-        actions.push_back(act_send(ctx->cfg.nodes, echo));
+        actions.push_back(act_send(ctx->bcast, echo));
         return actions;
     }
 
@@ -3546,7 +3558,7 @@ struct EpochTarget {
         m->t = MT::EpochChange;
         m->ec = my_epoch_change->underlying;
         Actions a;
-        a.push_back(act_send(ctx->cfg.nodes, m));
+        a.push_back(act_send(ctx->bcast, m));
         return a;
     }
 
@@ -3559,7 +3571,7 @@ struct EpochTarget {
         }
         if (is_primary) {
             Actions a;
-            a.push_back(act_send(ctx->cfg.nodes, my_new_epoch));
+            a.push_back(act_send(ctx->bcast, my_new_epoch));
             return a;
         }
         return Actions();
@@ -3571,14 +3583,14 @@ struct EpochTarget {
         if (is_primary) {
             if (pending_ticks % 2 == 0) {
                 Actions a;
-                a.push_back(act_send(ctx->cfg.nodes, my_new_epoch));
+                a.push_back(act_send(ctx->bcast, my_new_epoch));
                 return a;
             }
         } else {
             if (pending_ticks == 0) {
                 Actions a;
                 a.push_back(act_send(
-                    ctx->cfg.nodes,
+                    ctx->bcast,
                     mk_suspect(my_new_epoch->necfg->config.number)));
                 concat(a, persisted->append(
                               pe_suspect(my_new_epoch->necfg->config.number)));
@@ -3604,7 +3616,7 @@ struct EpochTarget {
             ack->t = MT::EpochChangeAck;
             ack->originator = source;
             ack->ec = msg->ec;
-            actions.push_back(act_send(ctx->cfg.nodes, ack));
+            actions.push_back(act_send(ctx->bcast, ack));
         }
         concat(actions, apply_epoch_change_ack_msg(source, source, msg->ec));
         return actions;
@@ -3668,7 +3680,7 @@ struct EpochTarget {
         state = ETS::PENDING;
         if (is_primary) {
             Actions a;
-            a.push_back(act_send(ctx->cfg.nodes, my_new_epoch));
+            a.push_back(act_send(ctx->bcast, my_new_epoch));
             return a;
         }
         return Actions();
@@ -3707,7 +3719,7 @@ struct EpochTarget {
             auto ready = std::make_shared<MsgS>();
             ready->t = MT::NewEpochReady;
             ready->necfg = pr.first;
-            actions.push_back(act_send(ctx->cfg.nodes, ready));
+            actions.push_back(act_send(ctx->bcast, ready));
             return actions;
         }
         return actions;
@@ -3725,7 +3737,7 @@ struct EpochTarget {
             ready->t = MT::NewEpochReady;
             ready->necfg = config;
             Actions a;
-            a.push_back(act_send(ctx->cfg.nodes, ready));
+            a.push_back(act_send(ctx->bcast, ready));
             return a;
         }
         return advance_state();
@@ -3959,7 +3971,7 @@ struct EpochTracker {
         auto ecm = std::make_shared<MsgS>();
         ecm->t = MT::EpochChange;
         ecm->ec = epoch_change;
-        actions.push_back(act_send(ctx->cfg.nodes, ecm));
+        actions.push_back(act_send(ctx->bcast, ecm));
 
         for (i32 node : ctx->cfg.nodes) {
             future_msgs.at(node).iterate(
@@ -4617,14 +4629,18 @@ vector<ActionS> coalesce_sends(Actions &&actions) {
         vector<MsgP> msgs;
         vector<AckS> acks;
     };
-    vector<std::pair<vector<i32>, Group>> groups;  // insertion-ordered by key
+    vector<std::pair<Targets, Group>> groups;  // insertion-ordered by key
     vector<std::optional<ActionS>> out;
     for (auto &action : actions) {
         if (action.t != AT::Send)
             throw EngineError("unexpected Net action type");
         Group *slot = nullptr;
         for (auto &pr : groups)
-            if (pr.first == action.targets) { slot = &pr.second; break; }
+            if (pr.first == action.targets ||
+                *pr.first == *action.targets) {
+                slot = &pr.second;
+                break;
+            }
         if (!slot) {
             groups.emplace_back(action.targets,
                                 Group{out.size(), {}, {}});
@@ -4838,7 +4854,7 @@ struct Engine {
         auto coalesced = coalesce_sends(std::move(actions));
         g_parts[3].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
         for (auto &action : coalesced) {
-            for (i32 replica : action.targets) {
+            for (i32 replica : *action.targets) {
                 if (replica == node.id) {
                     EventS e;
                     e.t = ET::Step;

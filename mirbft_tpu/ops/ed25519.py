@@ -171,8 +171,11 @@ def _mul_vpu(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 #  * matrix entries {1, 38, 16, 16*38=608} are exact in bf16 (38 = 5
 #    significant bits; 608 = 19 * 2^5).
 #  * fp32 accumulation: each dot output is bounded by
-#    (64 direct + 63 folded * 38) * 64 * 16 ~ 2^21.3 * 16 < 2^24, inside
-#    fp32's exact-integer range, so the matmul result is the exact integer.
+#    64 * 64 * 608 ~ 2^21.3 < 2^24, inside fp32's exact-integer range, so
+#    each matmul result is the exact integer.  The COMBINED value
+#    d_e + 16*d_o can exceed 2^24, so each dot is cast to int32 BEFORE the
+#    scaled add — combining in fp32 would round at the loose-limb bound
+#    (an adversarially steerable wrong field product).
 #
 # The nibble fold matrix maps coefficient position k (radix-16) of the
 # 64x64 product to 8-bit limb k//2 with weight 16^(k%2); positions k >= 64
@@ -219,8 +222,10 @@ def _mul_mxu(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     t_lo = (t & 15).astype(jnp.bfloat16).reshape(*t.shape[:-2], 64 * 64)
     t_hi = (t >> 4).astype(jnp.bfloat16).reshape(*t.shape[:-2], 64 * 64)
     u = jnp.concatenate([t_lo, t_hi], axis=-1)  # (..., 8192)
-    c = _dot_bf16(u, _NIB_ME_STACK) + 16.0 * _dot_bf16(u, _NIB_MO_STACK)
-    return _carry(c.astype(jnp.int32), 4)
+    c = _dot_bf16(u, _NIB_ME_STACK).astype(jnp.int32) + 16 * _dot_bf16(
+        u, _NIB_MO_STACK
+    ).astype(jnp.int32)
+    return _carry(c, 4)
 
 
 # Default multiply implementation; the verification kernel threads its
@@ -395,6 +400,8 @@ def _verify_kernel_body(
 @functools.lru_cache(maxsize=None)
 def _kernel_for(backend: str):
     """One jitted kernel per field-multiply backend (threaded explicitly)."""
+    if backend not in ("mxu", "vpu"):
+        raise ValueError(f"unknown ed25519 kernel backend {backend!r}")
     mul = _mul_mxu if backend == "mxu" else _mul_vpu
 
     def kernel(ax, ay, r_bytes, s_bits, h_bits):
@@ -539,17 +546,20 @@ class Ed25519BatchVerifier:
             )
         return self.collect(self.dispatch(pubs, msgs, sigs))
 
-    def dispatch(
+    def pack_inputs(
         self,
         pubs: Sequence[bytes],
         msgs: Sequence[bytes],
         sigs: Sequence[bytes],
-    ) -> "VerifyDispatch":
-        """Asynchronously verify a batch: packs the inputs, enqueues ONE
-        kernel call, and returns without blocking on the device.  Use
-        ``collect`` to materialize the verdicts."""
+        batch: Optional[int] = None,
+    ):
+        """Host-side packing: decompress keys (cached), hash challenges,
+        convert to the kernel's limb/bit arrays.  Returns
+        (ax, ay, r_bytes, s_bits, h_bits, valid) padded to ``batch`` rows
+        (default: next power of two)."""
         n = len(pubs)
-        batch = _next_pow2(n)
+        if batch is None:
+            batch = _next_pow2(n)
         ax = np.zeros((batch, NUM_LIMBS), dtype=np.int32)
         ay = np.zeros((batch, NUM_LIMBS), dtype=np.int32)
         r_bytes = np.zeros((batch, NUM_LIMBS), dtype=np.int32)
@@ -572,7 +582,21 @@ class Ed25519BatchVerifier:
             r_bytes[i] = np.frombuffer(sig[:32], dtype=np.uint8).astype(np.int32)
             s_bits[i] = _bits_le(s)
             h_bits[i] = _bits_le(_challenge(sig[:32], bytes(pub), bytes(msg)))
+        return ax, ay, r_bytes, s_bits, h_bits, valid
 
+    def dispatch(
+        self,
+        pubs: Sequence[bytes],
+        msgs: Sequence[bytes],
+        sigs: Sequence[bytes],
+    ) -> "VerifyDispatch":
+        """Asynchronously verify a batch: packs the inputs, enqueues ONE
+        kernel call, and returns without blocking on the device.  Use
+        ``collect`` to materialize the verdicts."""
+        n = len(pubs)
+        ax, ay, r_bytes, s_bits, h_bits, valid = self.pack_inputs(
+            pubs, msgs, sigs
+        )
         ok = ed25519_verify_kernel(
             ax, ay, r_bytes, s_bits, h_bits, backend=self.kernel
         )

@@ -10,8 +10,14 @@ over ICI.
 
 from .mesh import (
     distributed_verify_step,
+    sharded_ed25519_verify,
     make_mesh,
     sharded_sha256,
 )
 
-__all__ = ["distributed_verify_step", "make_mesh", "sharded_sha256"]
+__all__ = [
+    "distributed_verify_step",
+    "make_mesh",
+    "sharded_ed25519_verify",
+    "sharded_sha256",
+]

@@ -259,3 +259,25 @@ def test_mxu_backend_verifies_and_rejects():
         verifier = Ed25519BatchVerifier(min_device_batch=1, kernel=backend)
         got = verifier.verify_batch(pubs, msgs, sigs)
         assert (got == expected).all(), backend
+
+
+def test_mxu_multiply_exact_at_loose_limb_bound():
+    """Regression: the combined bf16-dot sum exceeds fp32's exact range at
+    the loose-limb bound, so the dots must be combined in int32 — all-511
+    limbs are the adversarial worst case that rounds if combined in fp32."""
+    import numpy as np
+
+    from mirbft_tpu.ops.ed25519 import P, _mul_mxu, _mul_vpu, limbs_to_int
+
+    extremes = [
+        np.full((1, 32), 511, dtype=np.int32),
+        np.full((1, 32), -511, dtype=np.int32),
+        np.tile(
+            np.array([[511, -511] * 16], dtype=np.int32), (1, 1)
+        ),
+    ]
+    for a in extremes:
+        for b in extremes:
+            ref = np.asarray(_mul_vpu(a, b))
+            got = np.asarray(_mul_mxu(a, b))
+            assert (limbs_to_int(ref[0]) - limbs_to_int(got[0])) % P == 0
