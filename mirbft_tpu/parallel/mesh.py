@@ -86,7 +86,7 @@ def distributed_verify_step(mesh: Mesh):
     return jax.jit(mapped)
 
 
-def sharded_ed25519_verify(mesh: Mesh):
+def sharded_ed25519_verify(mesh: Mesh, kernel: str = "mxu"):
     """Batched Ed25519 verification with the batch dimension sharded over
     the mesh, plus the byzantine-signer collective: every shard verifies its
     rows locally and a ``psum`` over ICI gives every chip the global count
@@ -98,12 +98,17 @@ def sharded_ed25519_verify(mesh: Mesh):
     rows that carry actual signatures (padding rows are False and are
     excluded from the count; a real row whose signature is structurally
     invalid — ``valid`` False — counts as invalid).  The mesh size must
-    divide the batch.
+    divide the batch.  ``kernel`` picks the field-multiply backend
+    ("mxu" default, as for ``Ed25519BatchVerifier``).
     """
-    from ..ops.ed25519 import _mul_vpu, _verify_kernel_body
+    from ..ops.ed25519 import _mul_mxu, _mul_vpu, _verify_kernel_body
+
+    if kernel not in ("mxu", "vpu"):
+        raise ValueError(f"unknown ed25519 kernel backend {kernel!r}")
+    mul = _mul_mxu if kernel == "mxu" else _mul_vpu
 
     def step(ax, ay, r_bytes, s_bits, h_bits, valid, real):
-        ok = _verify_kernel_body(ax, ay, r_bytes, s_bits, h_bits, _mul_vpu)
+        ok = _verify_kernel_body(ax, ay, r_bytes, s_bits, h_bits, mul)
         ok = jnp.logical_and(ok, valid)
         invalid = jax.lax.psum(
             jnp.sum(

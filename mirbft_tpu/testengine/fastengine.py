@@ -169,6 +169,9 @@ class FastRecording:
         from ..processor.verify import signing_payload, unseal
 
         crypto_start = _time.perf_counter()
+        pub_by_client = {
+            cid: client.public_key() for cid, client in sim_clients.items()
+        }
         pubs, msgs, sigs = [], [], []
         for client_id, req_no in signed_rows:
             envelope = payloads_by_client[client_id][req_no]
@@ -179,7 +182,7 @@ class FastRecording:
                 sigs.append(b"\x00" * 64)
                 continue
             payload, signature = parts
-            pubs.append(sim_clients[client_id].public_key())
+            pubs.append(pub_by_client[client_id])
             msgs.append(signing_payload(client_id, req_no, payload))
             sigs.append(signature)
 

@@ -199,11 +199,17 @@ def test_invalid_pubkey_rejected():
 
 
 def test_key_cache_reuse():
+    # The key caches are process-wide (pure functions of the key bytes), so
+    # measure the delta this verifier's batch contributes.
     verifier = e.Ed25519BatchVerifier(min_device_batch=1)
     _, pk, msg, sig = RFC_VECTORS[0]
     pub, msg, sig = bytes.fromhex(pk), bytes.fromhex(msg), bytes.fromhex(sig)
+    verifier._key_cache.pop(pub, None)
+    verifier._limb_cache.pop(pub, None)
+    before = len(verifier._key_cache)
     assert verifier.verify_batch([pub] * 3, [msg] * 3, [sig] * 3).all()
-    assert len(verifier._key_cache) == 1
+    assert len(verifier._key_cache) == before + 1
+    assert pub in verifier._key_cache
 
 
 def test_mxu_vpu_field_multiply_equivalent():
