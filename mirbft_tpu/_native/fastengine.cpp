@@ -574,19 +574,37 @@ enum class AT : u8 {
 
 using Targets = shared_ptr<const vector<i32>>;
 
+// One type-erased payload pointer per action (exactly one payload kind is
+// ever set per action type), keeping the struct small enough that the
+// pervasive batch moves/concats are cheap.
 struct ActionS {
     AT t;
-    Targets targets;            // Send / ForwardRequest
-    MsgP msg;                   // Send
-    HashReqP hash;              // Hash
-    i64 index = 0;              // Persist / Truncate
-    PersistEntP entry;          // Persist
-    QEntryP qentry;             // Commit
-    i64 seq = 0;                // Checkpoint / StateApplied
-    shared_ptr<const vector<ClientStateS>> cstates;  // Checkpoint
-    i64 client = 0, reqno = 0;  // AllocatedRequest
     AckS ack{0, 0, 0};          // CorrectRequest / ForwardRequest
-    NetStateP netstate;         // StateApplied
+    i64 a = 0;                  // Persist/Truncate index; Checkpoint/StateApplied seq; AllocatedRequest client
+    i64 b = 0;                  // AllocatedRequest reqno
+    Targets targets;            // Send / ForwardRequest
+    shared_ptr<const void> payload;  // per-kind (see accessors)
+
+    // kind-checked accessors (type safety rests on the AT tag)
+    MsgP msg() const { return std::static_pointer_cast<const MsgS>(payload); }
+    const MsgS *msg_raw() const {
+        return static_cast<const MsgS *>(payload.get());
+    }
+    HashReqP hash() const {
+        return std::static_pointer_cast<const HashReqS>(payload);
+    }
+    PersistEntP entry() const {
+        return std::static_pointer_cast<const PersistEntS>(payload);
+    }
+    QEntryP qentry() const {
+        return std::static_pointer_cast<const QEntryS>(payload);
+    }
+    shared_ptr<const vector<ClientStateS>> cstates() const {
+        return std::static_pointer_cast<const vector<ClientStateS>>(payload);
+    }
+    NetStateP netstate() const {
+        return std::static_pointer_cast<const NetStateS>(payload);
+    }
 };
 
 using Actions = vector<ActionS>;
@@ -597,19 +615,24 @@ enum class ET : u8 {
     Step, TickElapsed, ActionsReceived,
 };
 
+// Same slimming as ActionS: one type-erased payload per event.
 struct EventS {
     ET t;
-    i64 index = 0;              // LoadPersistedEntry
-    PersistEntP entry;          // LoadPersistedEntry
-    i32 digest = 0;             // HashResult
-    shared_ptr<const HashOriginS> origin;  // HashResult
-    i64 seq = 0;                // CheckpointResult
-    i32 value = 0;              // CheckpointResult
-    NetStateP netstate;         // CheckpointResult
-    bool reconfigured = false;  // CheckpointResult
-    AckS ack{0, 0, 0};          // RequestPersisted
-    i32 source = 0;             // Step
-    MsgP msg;                   // Step
+    i32 digest = 0;    // HashResult digest; CheckpointResult value; Step source
+    i64 a = 0;         // LoadPersistedEntry index; CheckpointResult seq
+    AckS ack{0, 0, 0};  // RequestPersisted
+    shared_ptr<const void> payload;  // entry / origin / netstate / msg
+
+    PersistEntP entry() const {
+        return std::static_pointer_cast<const PersistEntS>(payload);
+    }
+    shared_ptr<const HashOriginS> origin() const {
+        return std::static_pointer_cast<const HashOriginS>(payload);
+    }
+    NetStateP netstate() const {
+        return std::static_pointer_cast<const NetStateS>(payload);
+    }
+    MsgP msg() const { return std::static_pointer_cast<const MsgS>(payload); }
 };
 
 using Events = vector<EventS>;
@@ -766,7 +789,8 @@ struct Ctx {
 
 // Action builder helpers (statemachine/actions.py fluent constructors).
 ActionS act_send(Targets targets, MsgP msg) {
-    ActionS a; a.t = AT::Send; a.targets = std::move(targets); a.msg = std::move(msg); return a;
+    ActionS a; a.t = AT::Send; a.targets = std::move(targets);
+    a.payload = std::move(msg); return a;
 }
 ActionS act_send(vector<i32> targets, MsgP msg) {
     return act_send(std::make_shared<const vector<i32>>(std::move(targets)),
@@ -777,22 +801,22 @@ ActionS act_hash(vector<string> parts, HashOriginS origin) {
     auto hr = std::make_shared<HashReqS>();
     hr->parts = std::move(parts);
     hr->origin = std::move(origin);
-    a.hash = hr; return a;
+    a.payload = std::move(hr); return a;
 }
 ActionS act_persist(i64 index, PersistEntP entry) {
-    ActionS a; a.t = AT::Persist; a.index = index; a.entry = std::move(entry); return a;
+    ActionS a; a.t = AT::Persist; a.a = index; a.payload = std::move(entry); return a;
 }
 ActionS act_truncate(i64 index) {
-    ActionS a; a.t = AT::Truncate; a.index = index; return a;
+    ActionS a; a.t = AT::Truncate; a.a = index; return a;
 }
 ActionS act_commit(QEntryP q) {
-    ActionS a; a.t = AT::Commit; a.qentry = std::move(q); return a;
+    ActionS a; a.t = AT::Commit; a.payload = std::move(q); return a;
 }
 ActionS act_checkpoint(i64 seq, shared_ptr<const vector<ClientStateS>> cs) {
-    ActionS a; a.t = AT::Checkpoint; a.seq = seq; a.cstates = std::move(cs); return a;
+    ActionS a; a.t = AT::Checkpoint; a.a = seq; a.payload = std::move(cs); return a;
 }
 ActionS act_allocate(i64 client, i64 reqno) {
-    ActionS a; a.t = AT::AllocatedRequest; a.client = client; a.reqno = reqno; return a;
+    ActionS a; a.t = AT::AllocatedRequest; a.a = client; a.b = reqno; return a;
 }
 ActionS act_correct(AckS ack) {
     ActionS a; a.t = AT::CorrectRequest; a.ack = ack; return a;
@@ -803,7 +827,7 @@ ActionS act_forward(vector<i32> targets, AckS ack) {
     a.ack = ack; return a;
 }
 ActionS act_state_applied(i64 seq, NetStateP ns) {
-    ActionS a; a.t = AT::StateApplied; a.seq = seq; a.netstate = std::move(ns); return a;
+    ActionS a; a.t = AT::StateApplied; a.a = seq; a.payload = std::move(ns); return a;
 }
 
 void concat(Actions &into, Actions &&from) {
@@ -2941,6 +2965,7 @@ struct ActiveEpoch {
         vector<Rec> records;
         for (size_t k = 0; k < votes.size(); k++) {
             const MsgS &m = *votes[k];
+            if (m.t != MT::Prepare && m.t != MT::Commit) continue;  // rest
             int kind = m.t == MT::Prepare ? 0 : 1;
             if (m.epoch != epoch_config.number) {
                 records.push_back({true, k, 0, 0});
@@ -4211,17 +4236,17 @@ struct Machine {
 
     Actions process_checkpoint_result(const EventS &result) {
         Actions actions;
-        if (result.seq < commit_state->low_watermark) return actions;
+        NetStateP ns = result.netstate();
+        if (result.a < commit_state->low_watermark) return actions;
         i64 expected = commit_state->low_watermark + ctx->cfg.ci;
-        if (expected != result.seq)
+        if (expected != result.a)
             throw EngineError("checkpoint results must be one interval after the last");
         i64 prev_stop = commit_state->stop_at_seq_no;
         concat(actions, commit_state->apply_checkpoint_result(
-                            result.seq, result.value, result.netstate));
+                            result.a, result.digest, ns));
         if (prev_stop < commit_state->stop_at_seq_no) {
-            client_tracker->allocate(*result.netstate);
-            concat(actions, client_hash_disseminator->allocate(
-                                result.seq, *result.netstate));
+            client_tracker->allocate(*ns);
+            concat(actions, client_hash_disseminator->allocate(result.a, *ns));
         }
         return actions;
     }
@@ -4230,7 +4255,7 @@ struct Machine {
         if (event.t == ET::InitialParameters)
             throw EngineError("init params handled by caller");
         if (event.t == ET::LoadPersistedEntry) {
-            apply_persisted(event.index, event.entry);
+            apply_persisted(event.a, event.entry());
             return Actions();
         }
         Actions actions;
@@ -4244,12 +4269,13 @@ struct Machine {
             if (state != MachineState_::INITIALIZED)
                 throw EngineError("cannot apply events to an uninitialized machine");
             if (event.t == ET::Step) {
-                concat(actions, step(event.source, event.msg));
+                concat(actions, step(event.digest, event.msg()));
             } else if (event.t == ET::RequestPersisted) {
                 concat(actions,
                        client_hash_disseminator->apply_new_request(event.ack));
             } else if (event.t == ET::HashResult) {
-                concat(actions, process_hash_result(event.digest, *event.origin));
+                concat(actions,
+                       process_hash_result(event.digest, *event.origin()));
             } else if (event.t == ET::CheckpointResult) {
                 concat(actions, process_checkpoint_result(event));
             } else if (event.t == ET::TickElapsed) {
@@ -4294,22 +4320,24 @@ Actions Machine::step(i32 source, const MsgP &msg) {
         EpochTarget *target = epoch_tracker->current_epoch.get();
         if (target->state == ETS::IN_PROGRESS) {
             // Native-plane envelope path (voteplane.py split_votes): votes
-            // first (in order), then the rest (in order).
-            vector<MsgP> votes, rest;
-            for (const auto &im : msg->inner) {
-                if (im->t == MT::Prepare || im->t == MT::Commit)
-                    votes.push_back(im);
-                else
-                    rest.push_back(im);
-            }
-            if (!votes.empty()) {
+            // first (in order), then the rest (in order) — classified
+            // inline; apply_envelope_votes skips the non-votes.
+            bool any_vote = false;
+            for (const auto &im : msg->inner)
+                if (im->t == MT::Prepare || im->t == MT::Commit) {
+                    any_vote = true;
+                    break;
+                }
+            if (any_vote) {
                 u64 t0 = __rdtsc();
                 Actions actions = target->active_epoch->apply_envelope_votes(
-                    votes, source, [this](i32 src, const MsgP &m) {
+                    msg->inner, source, [this](i32 src, const MsgP &m) {
                         return step(src, m);
                     });
                 g_parts[1].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
-                for (const auto &im : rest) concat(actions, step(source, im));
+                for (const auto &im : msg->inner)
+                    if (im->t != MT::Prepare && im->t != MT::Commit)
+                        concat(actions, step(source, im));
                 return actions;
             }
         }
@@ -4366,7 +4394,7 @@ struct WorkItems {
         for (auto &action : actions) {
             switch (action.t) {
                 case AT::Send: {
-                    MT t = action.msg->t;
+                    MT t = action.msg_raw()->t;
                     if (t == MT::AckMsg || t == MT::AckBatch ||
                         t == MT::Checkpoint || t == MT::FetchBatch ||
                         t == MT::ForwardBatch)
@@ -4478,7 +4506,8 @@ struct AppState {
 
 // Client-side request-store logic (processor/clients.py).
 struct ProcClientRequest {
-    i64 req_no;
+    bool present = false;
+    i64 req_no = 0;
     i32 local_allocation_digest = -1;  // -1 = None
     vector<i32> remote_correct_digests;
 };
@@ -4487,73 +4516,106 @@ struct ProcClient {
     i64 client_id;
     SimReqStore *request_store;
     i64 next_req_no = 0;
-    std::map<i64, ProcClientRequest> requests;
+    // Dense window over [base, base + win.size()): client request slots are
+    // created/consumed in ascending runs, so the Python dict (insertion-
+    // ordered, pruned from the bottom at state_applied) maps onto a deque.
+    // Slots may be holes (present == false) until allocated/proposed.
+    i64 base = 0;
+    bool base_set = false;
+    deque<ProcClientRequest> win;
+    i64 live = 0;  // count of present slots
+
+    ProcClientRequest *slot(i64 req_no) {
+        if (!base_set) return nullptr;
+        i64 off = req_no - base;
+        if (off < 0 || off >= (i64)win.size()) return nullptr;
+        ProcClientRequest &cr = win[(size_t)off];
+        return cr.present ? &cr : nullptr;
+    }
+
+    ProcClientRequest *ensure_slot(i64 req_no) {
+        if (!base_set) {
+            base = req_no;
+            base_set = true;
+        }
+        while (req_no < base) {
+            // The Python dict re-creates entries below a pruned low
+            // watermark (clients.py Client.allocate); extend downward.
+            win.emplace_front();
+            base -= 1;
+        }
+        while ((i64)win.size() <= req_no - base) win.emplace_back();
+        ProcClientRequest &cr = win[(size_t)(req_no - base)];
+        if (!cr.present) {
+            cr.present = true;
+            cr.req_no = req_no;
+            live += 1;
+        }
+        return &cr;
+    }
 
     void state_applied(const ClientStateS &state) {
-        for (auto it = requests.begin(); it != requests.end();) {
-            if (it->first < state.lw) it = requests.erase(it);
-            else ++it;
+        while (base_set && !win.empty() && base < state.lw) {
+            if (win.front().present) live -= 1;
+            win.pop_front();
+            base += 1;
         }
         if (next_req_no < state.lw) next_req_no = state.lw;
     }
 
     // allocate() -> local digest or -1.
     i32 allocate(i64 req_no) {
-        auto it = requests.find(req_no);
-        if (it != requests.end()) return it->second.local_allocation_digest;
-        ProcClientRequest cr;
-        cr.req_no = req_no;
-        cr.local_allocation_digest =
+        ProcClientRequest *existing = slot(req_no);
+        if (existing) return existing->local_allocation_digest;
+        ProcClientRequest *cr = ensure_slot(req_no);
+        cr->local_allocation_digest =
             request_store->get_allocation(client_id, req_no);
-        i32 out = cr.local_allocation_digest;
-        requests.emplace(req_no, std::move(cr));
-        return out;
+        return cr->local_allocation_digest;
     }
 
-    bool empty() const { return requests.empty(); }
+    bool empty() const { return live == 0; }
+
+    i64 first_req_no() const {
+        for (size_t i = 0; i < win.size(); i++)
+            if (win[i].present) return base + (i64)i;
+        throw EngineError("empty proc client window");
+    }
 
     void add_correct_digest(i64 req_no, i32 digest) {
-        if (requests.empty())
+        if (empty())
             throw EngineError("client-not-exist in add_correct_digest");
-        auto it = requests.find(req_no);
-        if (it == requests.end()) {
-            if (req_no < requests.begin()->first) return;  // already GC'd
+        ProcClientRequest *cr = slot(req_no);
+        if (!cr) {
+            if (req_no < first_req_no()) return;  // already GC'd
             throw EngineError("unallocated client request marked correct");
         }
-        auto &rcd = it->second.remote_correct_digests;
+        auto &rcd = cr->remote_correct_digests;
         for (i32 d : rcd)
             if (d == digest) return;
         rcd.push_back(digest);
     }
 
     i64 next_req_no_value() const {
-        if (requests.empty()) throw EngineError("ClientNotExist");
+        if (empty()) throw EngineError("ClientNotExist");
         return next_req_no;
     }
 
     // propose() (clients.py:98-144); digest precomputed by the engine.
     // Returns (has_event, ack) — the RequestPersisted event if emitted.
     bool propose(i64 req_no, i32 digest, AckS *out) {
-        if (requests.empty()) throw EngineError("ClientNotExist");
+        if (empty()) throw EngineError("ClientNotExist");
         if (req_no < next_req_no) return false;
 
         if (req_no == next_req_no) {
             while (true) {
                 next_req_no += 1;
-                auto it = requests.find(next_req_no);
-                if (it == requests.end() ||
-                    it->second.local_allocation_digest == -1)
-                    break;
+                ProcClientRequest *nxt = slot(next_req_no);
+                if (!nxt || nxt->local_allocation_digest == -1) break;
             }
         }
-        auto it = requests.find(req_no);
-        bool previously_allocated = it != requests.end();
-        if (it == requests.end()) {
-            ProcClientRequest cr;
-            cr.req_no = req_no;
-            it = requests.emplace(req_no, std::move(cr)).first;
-        }
-        ProcClientRequest &cr = it->second;
+        ProcClientRequest *existing = slot(req_no);
+        bool previously_allocated = existing != nullptr;
+        ProcClientRequest &cr = *(existing ? existing : ensure_slot(req_no));
         if (cr.local_allocation_digest != -1) {
             if (cr.local_allocation_digest == digest) return false;
             throw EngineError("conflicting digest for req_no");
@@ -4598,21 +4660,21 @@ struct ProcClients {
         ProcClient *cached = nullptr;
         for (const auto &action : actions) {
             if (action.t == AT::AllocatedRequest) {
-                if (action.client != last_id) {
-                    last_id = action.client;
+                if (action.a != last_id) {
+                    last_id = action.a;
                     cached = client(last_id);
                 }
-                i32 digest = cached->allocate(action.reqno);
+                i32 digest = cached->allocate(action.b);
                 if (digest == -1) continue;
                 EventS ev;
                 ev.t = ET::RequestPersisted;
-                ev.ack = AckS{action.client, action.reqno, digest};
+                ev.ack = AckS{action.a, action.b, digest};
                 events.push_back(std::move(ev));
             } else if (action.t == AT::CorrectRequest) {
                 client(action.ack.client)
                     ->add_correct_digest(action.ack.reqno, action.ack.dig);
             } else if (action.t == AT::StateApplied) {
-                for (const auto &cs : action.netstate->clients)
+                for (const auto &cs : action.netstate()->clients)
                     client(cs.id)->state_applied(cs);
             } else {
                 throw EngineError("unexpected client action type");
@@ -4647,11 +4709,11 @@ vector<ActionS> coalesce_sends(Actions &&actions) {
             slot = &groups.back().second;
             out.emplace_back(std::nullopt);
         }
-        const MsgP &msg = action.msg;
+        const MsgS *msg = action.msg_raw();
         if (msg->t == MT::AckMsg) slot->acks.push_back(msg->acks[0]);
         else if (msg->t == MT::AckBatch)
             for (const auto &a : msg->acks) slot->acks.push_back(a);
-        else slot->msgs.push_back(msg);
+        else slot->msgs.push_back(action.msg());
     }
     for (auto &pr : groups) {
         Group &g = pr.second;
@@ -4812,8 +4874,8 @@ struct Engine {
         for (size_t i = 0; i < node.wal.entries.size(); i++) {
             EventS e;
             e.t = ET::LoadPersistedEntry;
-            e.index = node.wal.low_index + (i64)i;
-            e.entry = node.wal.entries[i];
+            e.a = node.wal.low_index + (i64)i;
+            e.payload = node.wal.entries[i];
             ev.push_back(std::move(e));
         }
         {
@@ -4839,9 +4901,9 @@ struct Engine {
         for (auto &action : actions) {
             if (action.t == AT::Send) net_actions.push_back(std::move(action));
             else if (action.t == AT::Persist)
-                node.wal.write(action.index, action.entry);
+                node.wal.write(action.a, action.entry());
             else if (action.t == AT::Truncate)
-                node.wal.truncate(action.index);
+                node.wal.truncate(action.a);
             else
                 throw EngineError("unexpected WAL action type");
         }
@@ -4854,12 +4916,13 @@ struct Engine {
         auto coalesced = coalesce_sends(std::move(actions));
         g_parts[3].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
         for (auto &action : coalesced) {
+            MsgP m = action.msg();
             for (i32 replica : *action.targets) {
                 if (replica == node.id) {
                     EventS e;
                     e.t = ET::Step;
-                    e.source = replica;
-                    e.msg = action.msg;
+                    e.digest = replica;
+                    e.payload = m;
                     events.push_back(std::move(e));
                 } else {
                     SimEv ev;
@@ -4867,7 +4930,7 @@ struct Engine {
                     ev.kind = SK::MsgReceived;
                     ev.target = replica;
                     ev.src = node.id;
-                    ev.msg = action.msg;
+                    ev.msg = m;
                     queue.insert(std::move(ev));
                 }
             }
@@ -4880,12 +4943,12 @@ struct Engine {
         for (auto &action : actions) {
             if (action.t != AT::Hash)
                 throw EngineError("unexpected Hash action type");
-            i32 digest = hash_parts(action.hash->parts);
+            HashReqP hr = action.hash();
+            i32 digest = hash_parts(hr->parts);
             EventS e;
             e.t = ET::HashResult;
             e.digest = digest;
-            e.origin = shared_ptr<const HashOriginS>(action.hash,
-                                                     &action.hash->origin);
+            e.payload = shared_ptr<const HashOriginS>(hr, &hr->origin);
             events.push_back(std::move(e));
         }
         return events;
@@ -4895,18 +4958,18 @@ struct Engine {
         Events events;
         for (auto &action : actions) {
             if (action.t == AT::Commit) {
-                node.state.apply(*action.qentry, ctx.intern);
-                committed_ops += (i64)action.qentry->reqs.size();
-                note_commits(node, *action.qentry);
+                QEntryP q = action.qentry();
+                node.state.apply(*q, ctx.intern);
+                committed_ops += (i64)q->reqs.size();
+                note_commits(node, *q);
             } else if (action.t == AT::Checkpoint) {
-                i32 value = node.state.snap(ctx.intern, *action.cstates);
+                i32 value = node.state.snap(ctx.intern, *action.cstates());
                 refresh_node_ready(node);
                 EventS e;
                 e.t = ET::CheckpointResult;
-                e.seq = action.seq;
-                e.value = value;
-                e.netstate = node.state.checkpoint_state;
-                e.reconfigured = false;
+                e.a = action.a;
+                e.digest = value;
+                e.payload = node.state.checkpoint_state;
                 events.push_back(std::move(e));
             } else {
                 throw EngineError("unexpected App action type");
@@ -5003,8 +5066,8 @@ void Engine::step() {
             if (node.machine) {
                 EventS e;
                 e.t = ET::Step;
-                e.source = event.src;
-                e.msg = event.msg;
+                e.digest = event.src;
+                e.payload = std::move(event.msg);
                 node.work_items->result_events.push_back(std::move(e));
             }
             break;

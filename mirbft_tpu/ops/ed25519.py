@@ -485,9 +485,13 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
-# Process-wide key caches (see Ed25519BatchVerifier.__init__).
+# Process-wide key caches (see Ed25519BatchVerifier.__init__).  The
+# eviction cap is module-level: the caches are shared, so a single verifier
+# constructed with a small per-instance size must not wipe them for
+# everyone.
 _SHARED_KEY_CACHE: Dict[bytes, Optional[Tuple[int, int]]] = {}
 _SHARED_LIMB_CACHE: Dict[bytes, Tuple[np.ndarray, np.ndarray]] = {}
+_SHARED_KEY_CACHE_CAP = 65536
 
 
 class Ed25519BatchVerifier:
@@ -529,7 +533,7 @@ class Ed25519BatchVerifier:
             x = _recover_x(y, pub[31] >> 7)
             if x is not None:
                 result = (x, y)
-        if len(self._key_cache) >= self.key_cache_size:
+        if len(self._key_cache) >= _SHARED_KEY_CACHE_CAP:
             self._key_cache.clear()
             self._limb_cache.clear()
         self._key_cache[pub] = result
