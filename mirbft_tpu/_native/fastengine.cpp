@@ -4507,7 +4507,6 @@ struct AppState {
 // Client-side request-store logic (processor/clients.py).
 struct ProcClientRequest {
     bool present = false;
-    i64 req_no = 0;
     i32 local_allocation_digest = -1;  // -1 = None
     vector<i32> remote_correct_digests;
 };
@@ -4548,7 +4547,6 @@ struct ProcClient {
         ProcClientRequest &cr = win[(size_t)(req_no - base)];
         if (!cr.present) {
             cr.present = true;
-            cr.req_no = req_no;
             live += 1;
         }
         return &cr;
@@ -4560,6 +4558,9 @@ struct ProcClient {
             win.pop_front();
             base += 1;
         }
+        // A fully drained window must rebase, or the next ensure_slot would
+        // materialize every hole between the stale base and the new slot.
+        if (base_set && win.empty() && base < state.lw) base = state.lw;
         if (next_req_no < state.lw) next_req_no = state.lw;
     }
 

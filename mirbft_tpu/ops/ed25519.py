@@ -410,11 +410,13 @@ def _kernel_for(backend: str):
     return jax.jit(kernel)
 
 
-def ed25519_verify_kernel(ax, ay, r_bytes, s_bits, h_bits, backend: str = "mxu"):
+def ed25519_verify_kernel(ax, ay, r_bytes, s_bits, h_bits, backend: str = "vpu"):
     """Batched verification: compress([S]B + [h](-A)) == R (see module
-    docstring).  ``backend`` picks the field-multiply formulation:
-    "mxu" (bf16 nibble matmuls on the matrix unit — the measured-faster
-    default) or "vpu" (int32, the original formulation)."""
+    docstring).  ``backend`` picks the field-multiply formulation: "vpu"
+    (int32 — the measured-faster default) or "mxu" (bf16 nibble matmuls on
+    the matrix unit; kept as a correct, selectable formulation — careful
+    interleaved device-barrier measurement puts it ~1.5x slower, see
+    docs/PERFORMANCE.md §7)."""
     return _kernel_for(backend)(ax, ay, r_bytes, s_bits, h_bits)
 
 
@@ -510,10 +512,13 @@ class Ed25519BatchVerifier:
         self,
         min_device_batch: int = 16,
         key_cache_size: int = 65536,
-        kernel: str = "mxu",
+        kernel: str = "vpu",
     ):
         self.min_device_batch = min_device_batch
-        self.key_cache_size = key_cache_size
+        # Honored as a floor raise on the shared cap: the caches are
+        # process-wide, so a small per-instance size must not shrink them
+        # for everyone (values above the cap raise it).
+        self.key_cache_size = max(key_cache_size, _SHARED_KEY_CACHE_CAP)
         self.kernel = kernel
         # Decompression and limb conversion are pure functions of the key
         # bytes, so the caches are process-wide: clients reuse keys across
@@ -533,7 +538,7 @@ class Ed25519BatchVerifier:
             x = _recover_x(y, pub[31] >> 7)
             if x is not None:
                 result = (x, y)
-        if len(self._key_cache) >= _SHARED_KEY_CACHE_CAP:
+        if len(self._key_cache) >= self.key_cache_size:
             self._key_cache.clear()
             self._limb_cache.clear()
         self._key_cache[pub] = result

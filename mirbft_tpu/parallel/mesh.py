@@ -86,7 +86,7 @@ def distributed_verify_step(mesh: Mesh):
     return jax.jit(mapped)
 
 
-def sharded_ed25519_verify(mesh: Mesh, kernel: str = "mxu"):
+def sharded_ed25519_verify(mesh: Mesh, kernel: str = "vpu"):
     """Batched Ed25519 verification with the batch dimension sharded over
     the mesh, plus the byzantine-signer collective: every shard verifies its
     rows locally and a ``psum`` over ICI gives every chip the global count
@@ -99,7 +99,7 @@ def sharded_ed25519_verify(mesh: Mesh, kernel: str = "mxu"):
     excluded from the count; a real row whose signature is structurally
     invalid — ``valid`` False — counts as invalid).  The mesh size must
     divide the batch.  ``kernel`` picks the field-multiply backend
-    ("mxu" default, as for ``Ed25519BatchVerifier``).
+    ("vpu" default, as for ``Ed25519BatchVerifier``).
     """
     from ..ops.ed25519 import _mul_mxu, _mul_vpu, _verify_kernel_body
 

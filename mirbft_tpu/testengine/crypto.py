@@ -402,12 +402,19 @@ class DeviceAuthPlane:
                         list(packed[1]) + [b""] * pad,
                         list(packed[2]) + [b"\x00" * 64] * pad,
                     )
-                handle = self.verifier.dispatch(*packed)
                 # Packing (per-signature SHA-512 challenge, key decompression,
-                # limb conversion) is host crypto work; the device runs async
-                # after the enqueue, so everything up to here is host-side.
+                # limb conversion) is host crypto work.  The dispatch call is
+                # metered separately: its steady-state host cost is trivial,
+                # but a cold shape pays XLA compilation there, which must not
+                # masquerade as crypto time (warm_kernels precompiles the
+                # bench shapes).
                 metrics.counter("host_crypto_seconds").inc(
                     time.perf_counter() - pack_start
+                )
+                dispatch_start = time.perf_counter()
+                handle = self.verifier.dispatch(*packed)
+                metrics.counter("device_dispatch_seconds").inc(
+                    time.perf_counter() - dispatch_start
                 )
                 self._inflight.append((keys, items, handle))
                 for key, item in zip(keys, items):
