@@ -515,10 +515,11 @@ class Ed25519BatchVerifier:
         kernel: str = "vpu",
     ):
         self.min_device_batch = min_device_batch
-        # Honored as a floor raise on the shared cap: the caches are
-        # process-wide, so a small per-instance size must not shrink them
-        # for everyone (values above the cap raise it).
-        self.key_cache_size = max(key_cache_size, _SHARED_KEY_CACHE_CAP)
+        # The key caches are process-wide, so the eviction cap is too: a
+        # small per-instance size must not shrink them for everyone, and a
+        # larger request raises the shared cap for everyone.
+        global _SHARED_KEY_CACHE_CAP
+        _SHARED_KEY_CACHE_CAP = max(key_cache_size, _SHARED_KEY_CACHE_CAP)
         self.kernel = kernel
         # Decompression and limb conversion are pure functions of the key
         # bytes, so the caches are process-wide: clients reuse keys across
@@ -538,7 +539,7 @@ class Ed25519BatchVerifier:
             x = _recover_x(y, pub[31] >> 7)
             if x is not None:
                 result = (x, y)
-        if len(self._key_cache) >= self.key_cache_size:
+        if len(self._key_cache) >= _SHARED_KEY_CACHE_CAP:
             self._key_cache.clear()
             self._limb_cache.clear()
         self._key_cache[pub] = result

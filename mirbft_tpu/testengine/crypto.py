@@ -140,18 +140,24 @@ class DeviceHashPlane:
             return
         memo = self._memo
         pending = self._pending
-        start = time.perf_counter()
+        join_time = 0.0
         for parts in batches:
             if _host_fast(parts):
                 continue
             key = tuple(map(id, parts))
             if key in memo or key in pending or key in self._issued:
                 continue
+            # Only the join is crypto-pipeline work; the memo probes above
+            # are scheduler bookkeeping and must not inflate the
+            # host-crypto share (they run for every scheduled batch, joined
+            # or not).
+            start = time.perf_counter()
             pending[key] = (tuple(parts), b"".join(parts))
+            join_time += time.perf_counter() - start
         if len(pending) >= self.wave_size:
             self._launch_wave()
-        # Joining/packing is host-side crypto-pipeline work: count it.
-        metrics.counter("host_crypto_seconds").inc(time.perf_counter() - start)
+        if join_time:
+            metrics.counter("host_crypto_seconds").inc(join_time)
 
     def _launch_wave(self) -> None:
         """One async kernel dispatch per block-bucket over the pending set.

@@ -100,13 +100,19 @@ class CountingApp:
         return wire.decode(snap[32:])
 
 
-@pytest.mark.parametrize("node_count,reqs", [(1, 30), (4, 30)])
-def test_stressy(tmp_path, node_count, reqs):
+
+
+def _run_stress_cluster(
+    tmp_path, node_count, reqs, envelope_factory, authenticator_factory=None
+):
+    """Shared tier-4 stress scaffolding: build a real-thread cluster on
+    durable stores, propose ``reqs`` envelopes from client 0 to every node,
+    wait until each commits exactly once per node, and return the live
+    (nodes, apps, transport) for extra assertions.  Caller must stop the
+    nodes/transport (use the returned ``stop`` callable)."""
     network_state = standard_initial_network_state(node_count, 0)
     transport = FakeTransport(node_count)
-    nodes = []
-    apps = []
-
+    nodes, apps = [], []
     for i in range(node_count):
         app = CountingApp()
         apps.append(app)
@@ -119,25 +125,24 @@ def test_stressy(tmp_path, node_count, reqs):
                 app=app,
                 wal=WAL(str(tmp_path / f"wal-{i}")),
                 request_store=Store(str(tmp_path / f"reqs-{i}.db")),
+                authenticator=(
+                    authenticator_factory() if authenticator_factory else None
+                ),
             ),
         )
         nodes.append(node)
 
     transport.start(nodes)
     for node in nodes:
-        node.process_as_new_node(
-            network_state, b"initial", tick_interval=0.02
-        )
+        node.process_as_new_node(network_state, b"initial", tick_interval=0.02)
 
-    # propose to every node (all replicas see every request, like the
-    # reference's stress client)
     def propose_all():
         for req_no in range(reqs):
-            payload = b"stress-%d" % req_no
+            envelope = envelope_factory(req_no)
             for node in nodes:
                 for _ in range(100):
                     try:
-                        node.client(0).propose(req_no, payload)
+                        node.client(0).propose(req_no, envelope)
                         break
                     except KeyError:
                         time.sleep(0.02)  # client window not allocated yet
@@ -145,14 +150,19 @@ def test_stressy(tmp_path, node_count, reqs):
     proposer = threading.Thread(target=propose_all, daemon=True)
     proposer.start()
 
+    def stop():
+        proposer.join(timeout=5)
+        for node in nodes:
+            node.stop()
+        transport.stop()
+
     deadline = time.time() + 60
     try:
         while time.time() < deadline:
-            done = all(
+            if all(
                 all(app.commits.get((0, r), 0) >= 1 for r in range(reqs))
                 for app in apps
-            )
-            if done:
+            ):
                 break
             for node in nodes:
                 err = node.notifier.err()
@@ -172,11 +182,18 @@ def test_stressy(tmp_path, node_count, reqs):
                 assert app.commits.get((0, r)) == 1, (
                     f"req {r} committed {app.commits.get((0, r))} times"
                 )
-    finally:
-        proposer.join(timeout=5)
-        for node in nodes:
-            node.stop()
-        transport.stop()
+    except BaseException:
+        stop()
+        raise
+    return nodes, apps, stop
+
+
+@pytest.mark.parametrize("node_count,reqs", [(1, 30), (4, 30)])
+def test_stressy(tmp_path, node_count, reqs):
+    _, _, stop = _run_stress_cluster(
+        tmp_path, node_count, reqs, lambda r: b"stress-%d" % r
+    )
+    stop()
 
 
 def test_node_restart_from_durable_wal(tmp_path):
@@ -237,3 +254,50 @@ def test_node_restart_from_durable_wal(tmp_path):
     wait_commits(app2, range(5, 10))
     node2.stop()
     transport.stop()
+
+
+def test_stressy_signed_requests(tmp_path):
+    """Tier-4 stress with the Ed25519 ingress gate on the REAL runtime:
+    valid signed envelopes commit on every node; a forged envelope is
+    rejected at propose and never enters dissemination."""
+    import hashlib
+
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from mirbft_tpu.node import AuthenticationError
+    from mirbft_tpu.processor.verify import (
+        RequestAuthenticator,
+        seal,
+        signing_payload,
+    )
+
+    reqs = 10
+    key = Ed25519PrivateKey.from_private_bytes(
+        hashlib.sha256(b"stressy-signed-client-0").digest()
+    )
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+
+    def envelope(req_no):
+        payload = b"signed-req-%d" % req_no
+        return seal(payload, key.sign(signing_payload(0, req_no, payload)))
+
+    def authenticator():
+        auth = RequestAuthenticator()
+        auth.register(0, pub)
+        return auth
+
+    nodes, _, stop = _run_stress_cluster(
+        tmp_path, 4, reqs, envelope, authenticator_factory=authenticator
+    )
+    try:
+        # A forged envelope must be rejected at the gate.
+        forged = seal(b"forged", b"\x11" * 64)
+        with pytest.raises(AuthenticationError):
+            nodes[0].client(0).propose(reqs, forged)
+    finally:
+        stop()
