@@ -5558,9 +5558,16 @@ PyObject *engine_pop_hash_log(PyObject *self, PyObject *) {
     return out;
 }
 
+// Steals v; on failure (or null v) releases BOTH v and the dict so error
+// paths in the profile builders cannot leak the partially built dict.
 int PyDictSetItemStringSteal(PyObject *d, const char *k, PyObject *v) {
+    if (!v) {
+        Py_DECREF(d);
+        return -1;
+    }
     int r = PyDict_SetItemString(d, k, v);
     Py_DECREF(v);
+    if (r < 0) Py_DECREF(d);
     return r;
 }
 
@@ -5575,7 +5582,7 @@ PyObject *engine_profile(PyObject *self, PyObject *) {
     for (int i = 0; i < 11; i++) {
         PyObject *v = Py_BuildValue("KK", (unsigned long long)e->kind_cycles[i],
                                     (unsigned long long)e->kind_counts[i]);
-        if (!v || PyDictSetItemStringSteal(out, names[i], v) < 0) return nullptr;
+        if (PyDictSetItemStringSteal(out, names[i], v) < 0) return nullptr;
     }
     static const char *part_names[6] = {"p_ackbatch", "p_votes", "p_fixpoint",
                                         "p_coalesce", "p_ackrun", "p_other"};
@@ -5583,7 +5590,7 @@ PyObject *engine_profile(PyObject *self, PyObject *) {
         PyObject *v = Py_BuildValue(
             "KK", (unsigned long long)g_parts[i].load(std::memory_order_relaxed),
             (unsigned long long)0);
-        if (!v || PyDictSetItemStringSteal(out, part_names[i], v) < 0)
+        if (PyDictSetItemStringSteal(out, part_names[i], v) < 0)
             return nullptr;
     }
     static const char *ev_names[10] = {
@@ -5593,7 +5600,7 @@ PyObject *engine_profile(PyObject *self, PyObject *) {
     for (int i = 0; i < 10; i++) {
         PyObject *v = Py_BuildValue("KK", (unsigned long long)e->ev_cycles[i],
                                     (unsigned long long)e->ev_counts[i]);
-        if (!v || PyDictSetItemStringSteal(out, ev_names[i], v) < 0) return nullptr;
+        if (PyDictSetItemStringSteal(out, ev_names[i], v) < 0) return nullptr;
     }
     return out;
 }
@@ -5611,10 +5618,31 @@ PyTypeObject EngineType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
 };
 
+// profile_globals() -> dict of the process-wide profiling counters
+// (cumulative across engines; callers diff snapshots to attribute a run).
+PyObject *mod_profile_globals(PyObject *, PyObject *) {
+    static const char *part_names[6] = {"p_ackbatch", "p_votes", "p_fixpoint",
+                                        "p_coalesce", "p_ackrun", "p_other"};
+    PyObject *out = PyDict_New();
+    if (!out) return nullptr;
+    for (int i = 0; i < 6; i++) {
+        PyObject *v = PyLong_FromUnsignedLongLong(
+            g_parts[i].load(std::memory_order_relaxed));
+        if (PyDictSetItemStringSteal(out, part_names[i], v) < 0)
+            return nullptr;
+    }
+    return out;
+}
+
+PyMethodDef fast_module_methods[] = {
+    {"profile_globals", mod_profile_globals, METH_NOARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr},
+};
+
 PyModuleDef fast_moduledef = {
     PyModuleDef_HEAD_INIT, "_fast",
     "Native fast-path cluster engine (C++ twin of the Python testengine).",
-    -1, nullptr, nullptr, nullptr, nullptr, nullptr,
+    -1, fast_module_methods, nullptr, nullptr, nullptr, nullptr,
 };
 
 }  // namespace

@@ -59,6 +59,8 @@ class RequestAuthenticator:
     percentile the benchmark reports.
     """
 
+    _MEMO_CAP = 1 << 16
+
     def __init__(self, verifier=None):
         if verifier is None:
             from ..ops.ed25519 import Ed25519BatchVerifier
@@ -68,6 +70,11 @@ class RequestAuthenticator:
         self.keys: Dict[int, bytes] = {}
         self.dispatch_seconds: List[float] = []
         self.verified_count = 0
+        # Verdict memo keyed by (client, req_no, envelope identity), entry
+        # pins the envelope so the id stays stable.  A proposal retried at
+        # the ingress gate (window not yet allocated) must not pay a fresh
+        # verification per retry.
+        self._memo: Dict[Tuple[int, int, int], Tuple[bytes, bool]] = {}
 
     def register(self, client_id: int, public_key: bytes) -> None:
         if len(public_key) != 32:
@@ -76,6 +83,8 @@ class RequestAuthenticator:
 
     def remove(self, client_id: int) -> None:
         self.keys.pop(client_id, None)
+        for key in [k for k in self._memo if k[0] == client_id]:
+            del self._memo[key]
 
     def authenticate_batch(
         self, items: Sequence[Tuple[int, int, bytes]]
@@ -108,7 +117,17 @@ class RequestAuthenticator:
         return ok
 
     def authenticate(self, client_id: int, req_no: int, envelope: bytes) -> bool:
-        return bool(self.authenticate_batch([(client_id, req_no, envelope)])[0])
+        key = (client_id, req_no, id(envelope))
+        entry = self._memo.get(key)
+        if entry is not None and entry[0] is envelope:
+            return entry[1]
+        verdict = bool(
+            self.authenticate_batch([(client_id, req_no, envelope)])[0]
+        )
+        if len(self._memo) >= self._MEMO_CAP:
+            self._memo.clear()
+        self._memo[key] = (envelope, verdict)
+        return verdict
 
     def p99_dispatch_seconds(self) -> float:
         if not self.dispatch_seconds:

@@ -72,6 +72,7 @@ class CountingApp:
         self.commits = {}
         self.lock = threading.Lock()
         self.last_checkpoint = (0, b"")
+        self.state_transfers = []
 
     def apply(self, entry: QEntry) -> None:
         with self.lock:
@@ -97,6 +98,8 @@ class CountingApp:
     def transfer_to(self, seq_no, snap):
         from mirbft_tpu import wire
 
+        with self.lock:
+            self.state_transfers.append(seq_no)
         return wire.decode(snap[32:])
 
 
@@ -140,7 +143,10 @@ def _run_stress_cluster(
         for req_no in range(reqs):
             envelope = envelope_factory(req_no)
             for node in nodes:
-                for _ in range(100):
+                # Retry long enough to cover a slow node's window allocation
+                # (a node whose allocation lags loses the request body
+                # forever if the proposer gives up — forwarding is pull-only).
+                for _ in range(600):
                     try:
                         node.client(0).propose(req_no, envelope)
                         break
@@ -156,12 +162,20 @@ def _run_stress_cluster(
             node.stop()
         transport.stop()
 
-    deadline = time.time() + 60
+    # Completion per app: every request applied, OR the node state-
+    # transferred (a transferred replica legitimately skips the individual
+    # requests it jumped over — the reference's integration assertions
+    # carry the same "state transfer yes/no/maybe" caveat).
+    def app_done(app):
+        if app.state_transfers:
+            return True
+        return all(app.commits.get((0, r), 0) >= 1 for r in range(reqs))
+
+    deadline = time.time() + 120
     try:
         while time.time() < deadline:
-            if all(
-                all(app.commits.get((0, r), 0) >= 1 for r in range(reqs))
-                for app in apps
+            if all(app_done(app) for app in apps) and any(
+                not app.state_transfers for app in apps
             ):
                 break
             for node in nodes:
@@ -171,13 +185,25 @@ def _run_stress_cluster(
             time.sleep(0.1)
         else:
             status = [
-                {r: app.commits.get((0, r), 0) for r in range(reqs)}
+                {
+                    "commits": {
+                        r: app.commits.get((0, r), 0) for r in range(reqs)
+                    },
+                    "transfers": list(app.state_transfers),
+                }
                 for app in apps
             ]
-            pytest.fail(f"timed out; commit counts: {status}")
+            pytest.fail(f"timed out; per-node state: {status}")
 
-        # every request committed exactly once per node
+        # Every request committed exactly once per NON-transferred node;
+        # at most f nodes may have transferred in a healthy run.
+        transferred = sum(1 for app in apps if app.state_transfers)
+        assert transferred <= max(0, (len(nodes) - 1) // 3), (
+            f"{transferred} nodes state-transferred"
+        )
         for app in apps:
+            if app.state_transfers:
+                continue
             for r in range(reqs):
                 assert app.commits.get((0, r)) == 1, (
                     f"req {r} committed {app.commits.get((0, r))} times"
