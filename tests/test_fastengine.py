@@ -173,3 +173,26 @@ def test_out_of_envelope_escalates_cleanly():
     fr = FastRecording(spec)
     with pytest.raises(FastEngineUnsupported):
         fr.drain_clients(timeout=10_000_000)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5, 6])
+def test_randomized_differential(seed):
+    """Seeded random in-envelope configs: node count, client count, request
+    counts, batch size, client width, and signed mode are drawn at random
+    and the full evolution must stay bit-identical across engines — the
+    fuzz net behind the hand-picked matrix above."""
+    import random
+
+    rng = random.Random(seed * 7919)
+    spec = Spec(
+        node_count=rng.randint(1, 12),
+        client_count=rng.randint(1, 6),
+        reqs_per_client=rng.randint(1, 25),
+        batch_size=rng.choice([1, 2, 3, 7, 20]),
+        client_width=rng.choice([20, 50, 100]),
+        signed_requests=rng.random() < 0.3,
+    )
+    steps_py, time_py, state_py = _python_run(spec)
+    steps_fast, time_fast, state_fast = _fast_run(spec)
+    assert (steps_fast, time_fast) == (steps_py, time_py), spec
+    assert state_fast == state_py, spec
