@@ -79,10 +79,18 @@ class RequestAuthenticator:
     def register(self, client_id: int, public_key: bytes) -> None:
         if len(public_key) != 32:
             raise ValueError("ed25519 public keys are 32 bytes")
+        # Key rotation invalidates every cached verdict for the client:
+        # a verdict memoized under the old key (either way) must not be
+        # served once the key changes.
+        if self.keys.get(client_id) != public_key:
+            self._purge_memo(client_id)
         self.keys[client_id] = public_key
 
     def remove(self, client_id: int) -> None:
         self.keys.pop(client_id, None)
+        self._purge_memo(client_id)
+
+    def _purge_memo(self, client_id: int) -> None:
         for key in [k for k in self._memo if k[0] == client_id]:
             del self._memo[key]
 

@@ -200,6 +200,9 @@ class CommitState:
         "upper_half_commits",
         "checkpoint_pending",
         "transferring",
+        "transfer_retry_in",
+        "transfer_retry_backoff",
+        "transfer_retry_target",
     )
 
     def __init__(self, persisted: PersistedLog, logger=None):
@@ -215,6 +218,15 @@ class CommitState:
         self.upper_half_commits: List[Optional[QEntry]] = []
         self.checkpoint_pending = False
         self.transferring = False
+        # Failed-transfer retry machinery (closes the reference's open edge,
+        # state_machine.go:210-212 ``panic("XXX handle state transfer
+        # failure")``; docs/Divergences.md #8): a failed attempt re-issues
+        # the ActionStateTransfer after a deterministic tick backoff
+        # (1, 2, 4, 8, 8, ... ticks), giving the app time to select an
+        # alternate snapshot source between attempts.
+        self.transfer_retry_in = 0
+        self.transfer_retry_backoff = 0
+        self.transfer_retry_target: Optional[TEntry] = None
 
     # --- (re)initialization from the log (reference commitstate.go:52-112) ---
 
@@ -257,6 +269,10 @@ class CommitState:
             for cs in self.active_state.clients
         }
 
+        self.transfer_retry_in = 0
+        self.transfer_retry_backoff = 0
+        self.transfer_retry_target = None
+
         if last_t is None or last_c.seq_no >= last_t.seq_no:
             self.transferring = False
             return actions
@@ -276,6 +292,51 @@ class CommitState:
         return self.persisted.add_t_entry(
             TEntry(seq_no=seq_no, value=value)
         ).state_transfer(seq_no, value)
+
+    # --- failed-transfer retry (no reference counterpart; the reference
+    # panics here, state_machine.go:210-212) ---
+
+    def apply_transfer_failed(self, seq_no: int, value: bytes) -> Actions:
+        """Schedule a retry of a failed state transfer.
+
+        The TEntry for the attempt is already persisted (transfer_to), so a
+        crash between failure and retry recovers through the normal
+        crashed-mid-transfer path.  Retry waits ``transfer_retry_backoff``
+        ticks (doubling per consecutive failure, capped at 8) before
+        re-emitting the ActionStateTransfer.
+        """
+        if not self.transferring:
+            # Stale failure from before a reinitialization (e.g. a crash
+            # recovered the transfer and it already completed) — ignore.
+            return EMPTY_ACTIONS
+        self.transfer_retry_backoff = (
+            1 if self.transfer_retry_backoff == 0
+            else min(self.transfer_retry_backoff * 2, 8)
+        )
+        self.transfer_retry_in = self.transfer_retry_backoff
+        self.transfer_retry_target = TEntry(seq_no=seq_no, value=value)
+        if self.logger is not None:
+            self.logger.warn(
+                "state transfer failed; retrying",
+                seq_no=seq_no,
+                backoff_ticks=self.transfer_retry_backoff,
+            )
+        return EMPTY_ACTIONS
+
+    def tick(self) -> Actions:
+        """Count down a pending transfer retry; re-issue when it expires."""
+        if self.transfer_retry_target is None:
+            return EMPTY_ACTIONS
+        self.transfer_retry_in -= 1
+        if self.transfer_retry_in > 0:
+            return EMPTY_ACTIONS
+        target = self.transfer_retry_target
+        self.transfer_retry_target = None
+        if self.logger is not None:
+            self.logger.info(
+                "re-issuing failed state transfer", seq_no=target.seq_no
+            )
+        return Actions().state_transfer(target.seq_no, target.value)
 
     # --- checkpoint results (reference commitstate.go:125-165) ---
 

@@ -255,10 +255,19 @@ class StateMachine:
             elif cls is st.EventTickElapsed:
                 actions.concat(self.client_hash_disseminator.tick())
                 actions.concat(self.epoch_tracker.tick())
+                actions.concat(self.commit_state.tick())
             elif cls is st.EventStateTransferFailed:
-                # Mirrors the reference's unresolved edge
-                # (state_machine.go:210-212).
-                raise NotImplementedError("state transfer failure handling")
+                # The reference leaves this edge unresolved
+                # (state_machine.go:210-212 ``panic("XXX handle state
+                # transfer failure")``); we complete it: the transfer is
+                # re-issued after a deterministic tick backoff so the app
+                # can retry (against an alternate snapshot source if it has
+                # one).  docs/Divergences.md #8.
+                actions.concat(
+                    self.commit_state.apply_transfer_failed(
+                        event.seq_no, event.checkpoint_value
+                    )
+                )
             elif cls is st.EventStateTransferComplete:
                 if not self.commit_state.transferring:
                     raise AssertionError(

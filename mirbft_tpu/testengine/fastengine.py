@@ -307,6 +307,11 @@ class FastRecording:
                 raise FastEngineUnsupported(str(exc)) from exc
             self._drain_hash_log()
             if timed_out:
+                # Collect in-flight device dispatches before raising so the
+                # device-as-verifying-coprocessor check covers everything
+                # dispatched up to the timeout (a divergence surfaces as the
+                # AssertionError, which outranks the timeout).
+                self._collect_inflight()
                 raise TimeoutError(
                     f"fast engine timed out after {self.stats()[0]} steps"
                 )

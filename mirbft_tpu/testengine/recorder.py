@@ -153,6 +153,16 @@ class NodeState:
         self.checkpoint_hash = b""
         self.checkpoint_state: Optional[NetworkState] = None
         self.state_transfers: List[int] = []  # for test assertions
+        # App-level fault injection: the next N transfer_to calls raise
+        # (e.g. the chosen snapshot source is unavailable), exercising the
+        # machine's failed-transfer retry path.  Complements the network
+        # manglers, which cannot fail the app boundary.
+        self.fail_transfers = 0
+        self.transfer_failures: List[int] = []  # seq_nos of failed attempts
+        # Optional sim-clock tap (tests wire it to the event queue) so
+        # retry spacing — the backoff — is assertable, not just retry count.
+        self.time_source: Optional[Callable[[], int]] = None
+        self.transfer_attempt_times: List[int] = []
         # Highest applied req_no + 1 per client — survives the client's
         # removal by reconfiguration, unlike the checkpoint state.
         self.committed_reqs: Dict[int, int] = {}
@@ -177,6 +187,12 @@ class NodeState:
         return value, pending
 
     def transfer_to(self, seq_no: int, snap: bytes) -> NetworkState:
+        if self.time_source is not None:
+            self.transfer_attempt_times.append(self.time_source())
+        if self.fail_transfers > 0:
+            self.fail_transfers -= 1
+            self.transfer_failures.append(seq_no)
+            raise RuntimeError("injected state-transfer failure")
         self.state_transfers.append(seq_no)
         network_state = wire.decode(snap[32:])
         if not isinstance(network_state, NetworkState):

@@ -50,6 +50,44 @@ def test_authenticator_accepts_valid_and_rejects_forged():
     assert auth.verified_count > 0
 
 
+def test_key_rotation_invalidates_verdict_memo():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    def keypair(seed):
+        key = Ed25519PrivateKey.from_private_bytes(bytes([seed]) * 32)
+        pub = key.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        return key, pub
+
+    old_key, old_pub = keypair(1)
+    new_key, new_pub = keypair(2)
+    auth = RequestAuthenticator()
+    auth.register(5, old_pub)
+
+    payload = b"rotate-me"
+    old_env = seal(payload, old_key.sign(signing_payload(5, 0, payload)))
+    new_env = seal(payload, new_key.sign(signing_payload(5, 0, payload)))
+    # Memoize a positive verdict under the old key and a negative one for
+    # the new key's envelope.
+    assert auth.authenticate(5, 0, old_env)
+    assert not auth.authenticate(5, 0, new_env)
+
+    # Rotation must drop both cached verdicts.
+    auth.register(5, new_pub)
+    assert not auth.authenticate(5, 0, old_env)
+    assert auth.authenticate(5, 0, new_env)
+
+    # Re-registering the SAME key keeps the memo warm (no behavior change).
+    before = auth.verified_count
+    auth.register(5, new_pub)
+    assert auth.authenticate(5, 0, new_env)
+    assert auth.verified_count == before
+
+
 def test_authenticator_batch_path_matches_device():
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (

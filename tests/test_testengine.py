@@ -218,6 +218,33 @@ def test_late_start_node_forces_state_transfer():
     assert recording.nodes[3].state.state_transfers, "node 3 should transfer"
 
 
+def test_state_transfer_failure_retries_with_backoff():
+    # The first three transfer attempts fail at the app boundary (e.g. the
+    # snapshot source is unavailable); the machine must re-issue the transfer
+    # after a doubling tick backoff instead of panicking.  The reference
+    # leaves this edge open (state_machine.go:210-212); docs/Divergences.md #8.
+    spec = Spec(node_count=4, client_count=4, reqs_per_client=20)
+    recorder = spec.recorder()
+    recorder.node_configs[3].start_delay = 50000
+    recording = recorder.recording()
+    state = recording.nodes[3].state
+    state.fail_transfers = 3
+    state.time_source = lambda: recording.event_queue.fake_time
+    recording.drain_clients(timeout=600000)
+    assert_all_nodes_agree(recording)
+    assert len(state.transfer_failures) == 3, "all injected failures fired"
+    assert state.state_transfers, "transfer eventually succeeded"
+    # The retry target is the persisted TEntry: same seq_no on every attempt
+    # unless a newer transfer superseded it.
+    assert state.state_transfers[0] >= state.transfer_failures[0]
+    # The backoff itself: consecutive retry gaps double (1, 2, 4 ticks), so
+    # each inter-attempt gap on the sim clock must strictly grow.
+    times = state.transfer_attempt_times
+    assert len(times) == 4, "three failures + the success"
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    assert gaps[0] < gaps[1] < gaps[2], gaps
+
+
 # ---------------------------------------------------------------------------
 # Reconfiguration at checkpoint boundaries.  The reference's reconfiguration
 # is unfinished (README.md:35, epoch_target.go:333); ours completes the
