@@ -4467,15 +4467,55 @@ struct SimWAL {
 };
 
 // The simulated replicated app (testengine NodeState).
+//
+// Cluster-shared hash-chain memoization: all N replicas apply the SAME
+// ordered QEntry stream to the same app semantics, so the expensive parts
+// of the evolution — the SHA-256 chain state and the per-client
+// committed-reqs map — are functions of the chain position, not of the
+// replica.  The engine keeps one content-addressed chain DAG (AppChain);
+// each replica holds only a cursor (chain_id).  The first replica to reach
+// a position pays for it; the other N-1 follow pointers.  Divergent
+// streams (impossible in the green envelope, but the memo does not assume
+// it) simply grow separate branches keyed by (seq, batch digest).
+// Per-replica semantic assertions (commit ordering, reqstore presence)
+// still run per replica — only the symmetric computation is shared.
+struct AppChainNode {
+    Sha256 hash_state;
+    // Committed-reqs CHANGES at this position vs the predecessor, as
+    // absolute assignments (client -> new value).  Replicas replay deltas
+    // into their own maps as their cursors advance, so the chain retains
+    // O(batch) per position, not O(clients).
+    vector<std::pair<i64, i64>> delta;
+    std::unordered_map<u64, i32> next;       // (seq<<32|digest) -> node
+    std::unordered_map<i32, i32> snap_next;  // checkpoint value id -> node
+    string digest;  // memoized hash_state.digest()
+    bool digest_done = false;
+};
+
+struct AppChain {
+    vector<AppChainNode> nodes;
+    AppChain() { nodes.emplace_back(); }
+};
+
 struct AppState {
     const Ctx *ctx;
     SimReqStore *req_store;
+    AppChain *chain = nullptr;
+    i32 chain_id = 0;
     i64 last_seq_no = 0;
-    Sha256 active_hash;
     i64 checkpoint_seq_no = 0;
     string checkpoint_hash;
     NetStateP checkpoint_state;
     std::map<i64, i64> committed_reqs;
+
+    const string &active_hash_digest() {
+        AppChainNode &cur = chain->nodes[(size_t)chain_id];
+        if (!cur.digest_done) {
+            cur.digest = cur.hash_state.digest();
+            cur.digest_done = true;
+        }
+        return cur.digest;
+    }
 
     // snap() -> value interner id.
     i32 snap(Interner &intern, const vector<ClientStateS> &client_states) {
@@ -4483,24 +4523,79 @@ struct AppState {
         auto ns = std::make_shared<NetStateS>();
         ns->clients = client_states;
         checkpoint_state = ns;
-        checkpoint_hash = active_hash.digest();
-        active_hash.reset();
-        active_hash.update(checkpoint_hash);
+        checkpoint_hash = active_hash_digest();
+        // The value embeds the (per-replica-encoded) network state, so the
+        // snap transition is keyed by the value id: replicas snapping the
+        // same state at the same position converge on one chain node.
         string value = checkpoint_hash;
         ctx->wire.net_state(value, ctx->cfg, *ns);
-        return intern.put(value);
+        i32 vid = intern.put(value);
+        AppChainNode &cur = chain->nodes[(size_t)chain_id];
+        auto it = cur.snap_next.find(vid);
+        if (it != cur.snap_next.end()) {
+            chain_id = it->second;
+            return vid;
+        }
+        AppChainNode nxt;
+        nxt.hash_state.update(checkpoint_hash);
+        i32 nid = (i32)chain->nodes.size();
+        chain->nodes.push_back(std::move(nxt));
+        chain->nodes[(size_t)chain_id].snap_next.emplace(vid, nid);
+        chain_id = nid;
+        return vid;
     }
 
     void apply(const QEntryS &batch, const Interner &intern) {
         last_seq_no += 1;
         if (batch.seq != last_seq_no) throw EngineError("out-of-order commit");
-        for (const auto &request : batch.reqs) {
+        for (const auto &request : batch.reqs)
             if (!req_store->has_request(request))
                 throw EngineError("reqstore must have a request we are committing");
-            active_hash.update(intern.get(request.dig));
-            i64 &prev = committed_reqs[request.client];
-            if (request.reqno + 1 > prev) prev = request.reqno + 1;
+        u64 key = ((u64)(u32)batch.seq << 32) | (u32)batch.dig;
+        i32 nid;
+        {
+            AppChainNode &cur = chain->nodes[(size_t)chain_id];
+            auto it = cur.next.find(key);
+            if (it != cur.next.end()) {
+                nid = it->second;
+            } else {
+                it = cur.next.end();
+                nid = -1;
+            }
         }
+        if (nid < 0) {
+            // First replica at this position: compute the transition.  Our
+            // own committed_reqs IS the canonical map here (we followed the
+            // chain to this point), so the delta derives from it.
+            AppChainNode nxt;
+            nxt.hash_state = chain->nodes[(size_t)chain_id].hash_state;
+            for (const auto &request : batch.reqs) {
+                nxt.hash_state.update(intern.get(request.dig));
+                auto cit = committed_reqs.find(request.client);
+                i64 prev = cit == committed_reqs.end() ? 0 : cit->second;
+                if (request.reqno + 1 > prev) {
+                    // Within-batch later requests overwrite: keep absolute
+                    // assignments, one per client (last wins).
+                    bool found = false;
+                    for (auto &pr : nxt.delta)
+                        if (pr.first == request.client) {
+                            if (request.reqno + 1 > pr.second)
+                                pr.second = request.reqno + 1;
+                            found = true;
+                            break;
+                        }
+                    if (!found)
+                        nxt.delta.emplace_back(request.client,
+                                               request.reqno + 1);
+                }
+            }
+            nid = (i32)chain->nodes.size();
+            chain->nodes.push_back(std::move(nxt));
+            chain->nodes[(size_t)chain_id].next.emplace(key, nid);
+        }
+        for (const auto &pr : chain->nodes[(size_t)nid].delta)
+            committed_reqs[pr.first] = pr.second;
+        chain_id = nid;
     }
 };
 
@@ -4807,9 +4902,19 @@ struct Engine {
     u64 fix_cycles = 0;  // post-event GC+fixpoint share (inside apply_event)
     u64 crypto_ns = 0;  // host CPU spent hashing (SHA-256) in-engine
     // Wave mirror log: (joined message id, digest id) for wave-eligible
-    // content, deduped engine-wide (the cross-node plane dedups the same way).
-    std::unordered_set<string> wave_seen;
+    // content; first sight of a content logs it for the device plane.
     vector<std::pair<i32, i32>> wave_log;
+    // Cluster-symmetric hash memos: all N replicas hash identical protocol
+    // content (batch digests, epoch-change data), so each unique content is
+    // hashed once and the other N-1 requests are lookups.  Two maps keep
+    // the domains separate (a host-fast hit must never shadow the same
+    // bytes arriving as wave-eligible content, which must reach wave_log);
+    // wave_memo doubles as the device-mirror dedup set.  Metering stays
+    // honest: crypto_ns accrues only when SHA-256 actually runs.
+    std::unordered_map<string, i32> host_memo;
+    std::unordered_map<string, i32> wave_memo;
+    // Cluster-shared app hash-chain DAG (see AppChain above).
+    AppChain app_chain;
 
     ClientSpec *spec_of(i64 client_id) {
         for (auto &cs : client_specs)
@@ -4821,24 +4926,36 @@ struct Engine {
     // hashlib; wave-eligible content (multi-part or >= 512 B single part —
     // the complement of crypto.py::_host_fast) is mirrored for the device.
     i32 hash_parts(const vector<string> &parts) {
-        auto t0 = std::chrono::steady_clock::now();
         if (parts.size() == 1 && parts[0].size() < 512) {
+            // Below the wave floor (host-only content).  Memo lookup keys
+            // on the part itself — no copy on the hit path.
+            auto hit = host_memo.find(parts[0]);
+            if (hit != host_memo.end()) return hit->second;
+            auto t0 = std::chrono::steady_clock::now();
             i32 r = ctx.intern.put(sha256(parts[0]));
             crypto_ns += (u64)std::chrono::duration_cast<std::chrono::nanoseconds>(
                              std::chrono::steady_clock::now() - t0)
                              .count();
+            if (host_memo.size() > (1u << 17)) host_memo.clear();  // bounded
+            host_memo.emplace(parts[0], r);
             return r;
         }
         string joined;
         for (const auto &p : parts) joined.append(p);
+        auto hit = wave_memo.find(joined);
+        if (hit != wave_memo.end()) return hit->second;
+        auto t0 = std::chrono::steady_clock::now();
         string digest = sha256(joined);
         i32 did = ctx.intern.put(digest);
         crypto_ns += (u64)std::chrono::duration_cast<std::chrono::nanoseconds>(
                          std::chrono::steady_clock::now() - t0)
                          .count();
-        if (wave_seen.size() > (1u << 17)) wave_seen.clear();  // bounded dedup
-        if (wave_seen.insert(joined).second)
-            wave_log.emplace_back(ctx.intern.put(joined), did);
+        // First sight of this wave content: mirror it for the device.  A
+        // bounded-clear re-sight re-logs, which the Python side verifies
+        // again harmlessly.
+        wave_log.emplace_back(ctx.intern.put(joined), did);
+        if (wave_memo.size() > (1u << 17)) wave_memo.clear();  // bounded
+        wave_memo.emplace(std::move(joined), did);
         return did;
     }
 
@@ -4846,6 +4963,7 @@ struct Engine {
         EngineNode &node = *nodes[(size_t)node_id];
         node.state.ctx = &ctx;
         node.state.req_store = &node.req_store;
+        node.state.chain = &app_chain;
         i32 checkpoint_value = node.state.snap(ctx.intern, init_clients);
         auto ns = node.state.checkpoint_state;
         node.wal.entries.clear();
@@ -5527,7 +5645,7 @@ PyObject *engine_node_summary(PyObject *self, PyObject *args) {
             Py_DECREF(k);
         }
     }
-    string active = node.state.active_hash.digest();
+    const string &active = node.state.active_hash_digest();
     return Py_BuildValue(
         "Ly#LLy#NN", (long long)node.state.checkpoint_seq_no,
         node.state.checkpoint_hash.data(),
