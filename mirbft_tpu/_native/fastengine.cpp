@@ -322,6 +322,9 @@ struct MsgS {
     vector<std::pair<i32, i32>> remote_changes;  // NewEpoch (node_id, digest)
     vector<MsgP> inner;       // MsgBatch
     mutable i64 wire_size_cache = -1;
+    // Ack-wave ledger registration id (AckBatch/AckMsg broadcast sends
+    // only; -1 = unregistered, consumed via the classic per-ack path).
+    mutable i64 wave_id = -1;
 };
 
 // QEntry / PEntry and the persisted-entry union (messages.py Persistent).
@@ -766,7 +769,12 @@ struct InitParms {
     i32 id;
     i64 batch_size, heartbeat_ticks, suspect_ticks, new_epoch_timeout_ticks,
         buffer_size;
+    // This node consumes the ack ledger's canonical streams only if it was
+    // live from the start (a late-started node misses stream prefixes).
+    bool led_classic = false;
 };
+
+struct AckLedger;  // defined below (cluster-shared ack-wave canon)
 
 struct Ctx {
     Interner intern;
@@ -777,6 +785,7 @@ struct Ctx {
     // Shared broadcast target set: most sends address every node, and the
     // per-send 64-int vector alloc+copy was a measurable share of the run.
     Targets bcast;
+    AckLedger *ack_ledger = nullptr;  // null = ledger disabled
 
     void finish_init() {
         wire.in = &intern;
@@ -1439,6 +1448,290 @@ struct BatchTracker {
 };
 
 // ---------------------------------------------------------------------------
+// Cluster-shared ack-wave ledger.
+//
+// The O(N²) collapse (round-3 headline work): every AckBatch broadcast is
+// applied by all N receivers to near-identical per-(client, req_no) vote
+// state.  Instead of replaying the per-ack mask arithmetic N times, the
+// engine applies each broadcast ONCE to a canonical per-client record set
+// at SEND time (send order == arrival order under the engine envelope's
+// uniform link latency — the queue breaks time ties by insertion sequence,
+// so every receiver consumes broadcasts in registration order).  Receivers
+// then consume each wave segment as a cursor bump plus a replay of the
+// precomputed quorum-crossing candidates; all non-crossing acks cost the
+// receiver nothing.
+//
+// Receiver-side asymmetries are handled exactly:
+//   * own-ack early application (a node applies its own acks via the
+//     self-send short-circuit before the wave's canonical position):
+//     per-(client, receiver) `own_early` position sets shift crossing
+//     counts by +1 for pending own bits (the `adj` term);
+//   * window skew (PAST acks skip, FUTURE acks buffer classically and the
+//     record goes copy-on-divergence for that receiver until it retires
+//     from the window — divergence is per-record and self-healing);
+//   * every non-green entry point (buffered replays, force-acks during
+//     epoch fetch, attention/fetch ticks, fetch-request replies)
+//     materializes the receiver's private record from the canonical logs
+//     first and proceeds on the classic path.
+// Per-receiver maps that downstream components read (weak/strong/my
+// request maps, committed flags, attention, resend state) are maintained
+// classically at the receiver's own instants, so the Proposer/ClientTracker
+// interfaces are unchanged and exact.
+//
+// Reference semantics preserved: client_hash_disseminator.go:806-876 (the
+// ack accumulation rules this plane replays canonically).
+// ---------------------------------------------------------------------------
+
+struct WaveTouch {
+    i64 req_no;
+    i32 dig;      // digest interner id
+    u32 post;     // canonical agreement count after this touch (NEW/DUP)
+    u8 kind;      // 0=NEW bit, 1=DUP (same-digest revote), 2=REJECT/no-op
+    bool candidate;  // post (or post+1 for adj receivers) can cross a quorum
+};
+
+struct WaveSeg {
+    i64 client;
+    void *canon = nullptr;  // CanonClient* (set at registration; map nodes
+                            // have stable addresses)
+    u8 src;
+    i64 min_reqno, max_reqno;
+    u32 ack_start, ack_end;  // slice of the registered msg's acks vector
+    vector<WaveTouch> touches;      // in batch order
+    vector<u32> candidates;         // indexes into touches
+};
+
+struct WaveReg {
+    MsgP msg;                 // keeps the acks alive for classic fallback
+    u32 pos;                  // global stream position (the wave id)
+    i64 min_any, max_any;     // req_no bounds across all segments
+    vector<WaveSeg> segs;     // in batch (client-ascending) order
+    vector<u32> candidate_segs;  // seg indexes with a non-empty candidate set
+    // Lazily-built per-ack singleton msgs shared by every receiver that
+    // buffers the ack as FUTURE (saves an alloc + wire-size computation
+    // per receiver per ack).
+    mutable vector<MsgP> single_msgs;
+
+    const MsgP &single(size_t k) const {
+        if (single_msgs.empty()) single_msgs.resize(msg->acks.size());
+        if (!single_msgs[k]) single_msgs[k] = mk_ack_msg(msg->acks[k]);
+        return single_msgs[k];
+    }
+};
+
+// Per-receiver cursor over the global ack-wave stream.  Every broadcast
+// wave is consumed by every live receiver in registration order; own waves
+// (self-send short-circuit) are consumed early and absorbed when the
+// cursor reaches their position.
+struct LedView {
+    u32 version = 0;
+    vector<u32> own_early;
+
+    bool consumed(u32 pos) const {
+        if (pos < version) return true;
+        for (u32 p : own_early)
+            if (p == pos) return true;
+        return false;
+    }
+    void absorb() {
+        bool moved = true;
+        while (moved && !own_early.empty()) {
+            moved = false;
+            for (size_t i = 0; i < own_early.size(); i++)
+                if (own_early[i] == version) {
+                    own_early.erase(own_early.begin() + (std::ptrdiff_t)i);
+                    version += 1;
+                    moved = true;
+                    break;
+                }
+        }
+    }
+};
+
+struct CanonDig {
+    i32 dig;
+    u64 mask = 0;
+    // (stream position, source) per added bit, in canonical order.
+    vector<std::pair<u32, u8>> add_log;
+
+    i32 pos_of(u8 src) const {  // -1 if src never added its bit
+        for (const auto &pr : add_log)
+            if (pr.second == src) return (i32)pr.first;
+        return -1;
+    }
+};
+
+struct CanonRec {
+    i64 req_no;
+    u64 non_null = 0;
+    vector<std::pair<u32, u8>> nn_log;  // (position, source) per non-null bit
+    vector<CanonDig> digs;              // canonical first-sight order
+    u64 diverged = 0;                   // receivers on private record state
+
+    CanonDig *find(i32 dig) {
+        for (auto &d : digs)
+            if (d.dig == dig) return &d;
+        return nullptr;
+    }
+    CanonDig &find_or_create(i32 dig) {
+        CanonDig *d = find(dig);
+        if (d) return *d;
+        digs.push_back(CanonDig{dig});
+        return digs.back();
+    }
+};
+
+struct CanonClient {
+    i64 base = -1;            // lowest req_no with a record (set on first touch)
+    deque<CanonRec> recs;
+
+    CanonRec *rec(i64 req_no) {
+        if (base < 0 || req_no < base) return nullptr;
+        i64 off = req_no - base;
+        if (off >= (i64)recs.size()) return nullptr;
+        return &recs[(size_t)off];
+    }
+    CanonRec &rec_or_create(i64 req_no) {
+        if (base < 0) {
+            base = req_no;
+            recs.emplace_back();
+            recs.back().req_no = req_no;
+            return recs.back();
+        }
+        while (req_no < base) {  // extend downward (defensive; base is the
+            recs.emplace_front();  // first-touched req_no, usually 0)
+            base -= 1;
+            recs.front().req_no = base;
+        }
+        while ((i64)recs.size() <= req_no - base) {
+            recs.emplace_back();
+            recs.back().req_no = base + (i64)recs.size() - 1;
+        }
+        return recs[(size_t)(req_no - base)];
+    }
+};
+
+struct AckLedger {
+    i64 wq, sq;
+    deque<WaveReg> waves;  // window [wave_base, wave_base + size)
+    u32 wave_base = 0;
+    std::map<i64, CanonClient> clients;
+
+    CanonClient &client(i64 id) { return clients[id]; }
+
+    const WaveReg &wave(i64 wave_id) const {
+        return waves[(size_t)((u32)wave_id - wave_base)];
+    }
+
+    // Bound ledger memory: waves every live receiver's cursor has passed
+    // will never be consumed again (buffered replays use fresh singleton
+    // msgs), and canonical records below every receiver's low watermark
+    // have retired.  Called periodically by the engine.
+    void prune(u32 min_version, const std::map<i64, i64> &min_lw) {
+        while (wave_base < min_version && !waves.empty()) {
+            waves.pop_front();
+            wave_base += 1;
+        }
+        for (auto &pr : clients) {
+            auto it = min_lw.find(pr.first);
+            if (it == min_lw.end()) continue;
+            CanonClient &cc = pr.second;
+            while (cc.base >= 0 && cc.base < it->second && !cc.recs.empty() &&
+                   cc.recs.front().diverged == 0) {
+                cc.recs.pop_front();
+                cc.base += 1;
+            }
+        }
+    }
+
+    bool is_candidate_count(i64 post) const {
+        return post == wq - 1 || post == wq || post == sq - 1 || post == sq;
+    }
+
+    // Register one broadcast ack msg: apply it to the canonical state
+    // (mirroring ClientD::ack_run's accept/dup/reject rules exactly) and
+    // record per-touch outcomes for receiver-side replay.
+    void register_msg(const MsgP &m, i32 source) {
+        if (m->wave_id >= 0) return;
+        WaveReg reg;
+        reg.msg = m;
+        reg.pos = wave_base + (u32)waves.size();
+        reg.min_any = INT64_MAX;
+        reg.max_any = INT64_MIN;
+        const vector<AckS> &acks = m->acks;
+        u64 bit = 1ull << source;
+        size_t i = 0;
+        while (i < acks.size()) {
+            i64 client_id = acks[i].client;
+            CanonClient &cc = client(client_id);
+            WaveSeg seg;
+            seg.client = client_id;
+            seg.canon = &cc;
+            seg.src = (u8)source;
+            seg.ack_start = (u32)i;
+            seg.min_reqno = acks[i].reqno;
+            seg.max_reqno = acks[i].reqno;
+            while (i < acks.size() && acks[i].client == client_id) {
+                const AckS &a = acks[i];
+                if (a.reqno < seg.min_reqno) seg.min_reqno = a.reqno;
+                if (a.reqno > seg.max_reqno) seg.max_reqno = a.reqno;
+                CanonRec &R = cc.rec_or_create(a.reqno);
+                WaveTouch t;
+                t.req_no = a.reqno;
+                t.dig = a.dig;
+                t.post = 0;
+                t.candidate = false;
+                if (a.dig != 0 && (R.non_null & bit)) {
+                    // Source already voted non-null: only a same-digest
+                    // revote proceeds (as a DUP); otherwise the vote is
+                    // rejected (at most creating an empty candidate entry).
+                    CanonDig *ex = R.find(a.dig);
+                    if (!ex || !(ex->mask & bit)) {
+                        if (!ex) R.digs.push_back(CanonDig{a.dig});
+                        t.kind = 2;  // REJECT: no receiver-visible effect
+                    } else {
+                        t.kind = 1;  // DUP
+                        t.post = (u32)__builtin_popcountll(ex->mask);
+                        t.candidate = is_candidate_count((i64)t.post);
+                    }
+                } else {
+                    if (a.dig != 0) {
+                        if (!(R.non_null & bit)) {
+                            R.non_null |= bit;
+                            R.nn_log.emplace_back(reg.pos, (u8)source);
+                        }
+                    }
+                    CanonDig &D = R.find_or_create(a.dig);
+                    if (D.mask & bit) {
+                        t.kind = 1;  // DUP (null revote or same-digest)
+                        t.post = (u32)__builtin_popcountll(D.mask);
+                        t.candidate = is_candidate_count((i64)t.post);
+                    } else {
+                        D.mask |= bit;
+                        D.add_log.emplace_back(reg.pos, (u8)source);
+                        t.kind = 0;  // NEW
+                        t.post = (u32)__builtin_popcountll(D.mask);
+                        t.candidate = is_candidate_count((i64)t.post);
+                    }
+                }
+                if (t.candidate)
+                    seg.candidates.push_back((u32)seg.touches.size());
+                seg.touches.push_back(t);
+                i++;
+            }
+            seg.ack_end = (u32)i;
+            if (seg.min_reqno < reg.min_any) reg.min_any = seg.min_reqno;
+            if (seg.max_reqno > reg.max_any) reg.max_any = seg.max_reqno;
+            if (!seg.candidates.empty())
+                reg.candidate_segs.push_back((u32)reg.segs.size());
+            reg.segs.push_back(std::move(seg));
+        }
+        m->wave_id = (i64)reg.pos;
+        waves.push_back(std::move(reg));
+    }
+};
+
+// ---------------------------------------------------------------------------
 // Client request dissemination (statemachine/disseminator.py).
 // Vote masks are single u64 words (engine envelope: <= 64 nodes).
 // ---------------------------------------------------------------------------
@@ -1503,6 +1796,9 @@ struct ClientReqNoD {
     i64 acks_sent = 0;
     i32 acked_digest = -1;  // -1 = None
     i64 resend_nonce = 0;
+    // Digests this receiver has self-applied its own ack for (ledger `adj`
+    // bookkeeping; 1 entry normally, 2 after a null promotion).
+    vector<i32> self_acked;
 
     CRP client_req(const AckS &ack) {
         CRP *existing = requests.get(ack.dig);
@@ -1620,6 +1916,14 @@ struct ClientD {
     std::map<i64, vector<std::pair<i64, i64>>> resend_schedule;
     i64 resend_seq = 0;
     i64 weak_quorum = 0, strong_quorum = 0;
+    // Ack-ledger consumption state (see AckLedger): the receiver's global
+    // stream cursor lives on the Disseminator (LedView); this client holds
+    // only its classic flag and shared-counter hooks.
+    const LedView *led_view = nullptr;
+    i64 *led_diverged_total = nullptr;
+    i64 *led_classic_count = nullptr;
+    bool led_classic = false;
+    i64 led_diverged = 0;
 
     CRNP win_get(i64 req_no) const {
         i64 off = req_no - win_base;
@@ -1642,9 +1946,17 @@ struct ClientD {
         Actions actions;
         weak_quorum = ctx->wq;
         strong_quorum = ctx->iq;
+        led_classic = led_classic || my_config.led_classic;
         deque<CRNP> old_win = std::move(win);
         i64 old_base = win_base;
         win.clear();
+        // Records dropped below the new low watermark retire their
+        // divergence marks (self-healing: fresh records start fast).
+        if (!old_win.empty())
+            for (i64 rn = old_base; rn < state.lw &&
+                                    rn < old_base + (i64)old_win.size();
+                 rn++)
+                led_release(rn);
 
         i64 intermediate_high = state.lw + state.width - state.wclc - 1;
         client_state = state;
@@ -1702,6 +2014,7 @@ struct ClientD {
 
         // Drop window prefix below the new low watermark.
         while (!win.empty() && win_base != state.lw) {
+            led_release(win_base);
             win.pop_front();
             win_base += 1;
         }
@@ -1725,11 +2038,258 @@ struct ClientD {
         return actions;
     }
 
+    // --- ack-ledger consumption (see AckLedger above) -------------------
+
+    bool led_enabled() const {
+        return ctx->ack_ledger != nullptr && !led_classic;
+    }
+
+    // Reconstruct this receiver's private per-record vote state from the
+    // canonical logs (consumed prefix + own-early positions), then mark
+    // the record diverged so every later touch goes the classic path.
+    void led_ensure_private(ClientReqNoD &crn) {
+        if (!led_enabled()) return;
+        u64 mybit = 1ull << my_config.id;
+        CanonClient &cc = ctx->ack_ledger->client(client_state.id);
+        CanonRec &R = cc.rec_or_create(crn.req_no);
+        if (R.diverged & mybit) return;
+        u64 nn = 0;
+        for (const auto &pr : R.nn_log)
+            if (led_view->consumed(pr.first)) nn |= 1ull << pr.second;
+        crn.non_null_voters = nn;
+        for (const auto &D : R.digs) {
+            CRP cr = crn.client_req(AckS{crn.client_id, crn.req_no, D.dig});
+            u64 m = 0;
+            for (const auto &pr : D.add_log)
+                if (led_view->consumed(pr.first)) m |= 1ull << pr.second;
+            cr->agreements = m;
+        }
+        R.diverged |= mybit;
+        led_diverged += 1;
+        if (led_diverged_total) *led_diverged_total += 1;
+    }
+
+    void led_release(i64 req_no) {
+        if (!led_enabled()) return;
+        u64 mybit = 1ull << my_config.id;
+        CanonClient &cc = ctx->ack_ledger->client(client_state.id);
+        CanonRec *R = cc.rec(req_no);
+        if (R && (R->diverged & mybit)) {
+            R->diverged &= ~mybit;
+            led_diverged -= 1;
+            if (led_diverged_total) *led_diverged_total -= 1;
+        }
+    }
+
+    // After a window roll replayed this receiver's buffered FUTURE acks,
+    // a diverged record whose masks exactly match the canonical view is
+    // aligned again — clear the mark so it rides the fast path.  Records
+    // diverged for other reasons (force-acks, missing buffered acks) fail
+    // the comparison and stay private.  Private fetch/tick state on the
+    // CRPs is orthogonal to alignment (the fast path never touches it).
+    void led_try_realign() {
+        if (!led_enabled() || led_diverged == 0) return;
+        u64 mybit = 1ull << my_config.id;
+        CanonClient &cc = ctx->ack_ledger->client(client_state.id);
+        for (const auto &crnp : win) {
+            ClientReqNoD &crn = *crnp;
+            CanonRec *R = cc.rec(crn.req_no);
+            if (!R || !(R->diverged & mybit)) continue;
+            u64 nn = 0;
+            for (const auto &pr : R->nn_log)
+                if (led_view->consumed(pr.first)) nn |= 1ull << pr.second;
+            if (crn.non_null_voters != nn) continue;
+            bool equal = true;
+            for (const auto &D : R->digs) {
+                u64 m = 0;
+                for (const auto &pr : D.add_log)
+                    if (led_view->consumed(pr.first)) m |= 1ull << pr.second;
+                CRP *cr = crn.requests.get(D.dig);
+                u64 actual = cr ? (*cr)->agreements : 0;
+                if (actual != m) { equal = false; break; }
+            }
+            if (!equal) continue;
+            R->diverged &= ~mybit;
+            led_diverged -= 1;
+            if (led_diverged_total) *led_diverged_total -= 1;
+            if (led_diverged == 0) break;
+        }
+    }
+
+    // Materialize every in-window record and consume classically forever
+    // (safety valve for conditions the fast path does not model).
+    void led_fallback_all_classic() {
+        if (led_enabled())
+            for (const auto &crnp : win) led_ensure_private(*crnp);
+        if (!led_classic && led_classic_count) *led_classic_count += 1;
+        led_classic = true;
+    }
+
+    // Quorum-crossing replay for one candidate touch consumed as an
+    // arrival (seg.src != me).  Mirrors ack_run's per-ack body for counts
+    // at the quorum edges; all other counts have no receiver-visible
+    // effect.  `adj` shifts the canonical count when our own bit for this
+    // digest was self-applied early and its canonical position is still
+    // ahead of this touch.
+    void led_candidate(CanonRec &R, const WaveTouch &t, u32 seg_pos,
+                       const AckS &a, Actions &actions) {
+        if (t.kind == 2) return;  // canonically rejected: no effect
+        CRNP crnp = win_get(t.req_no);
+        if (!crnp) throw EngineError("ledger candidate outside window");
+        ClientReqNoD &crn = *crnp;
+        i64 adj = 0;
+        if (!crn.self_acked.empty()) {
+            for (i32 d : crn.self_acked)
+                if (d == t.dig) {
+                    CanonDig *D = R.find(t.dig);
+                    i32 p = D ? D->pos_of((u8)my_config.id) : -1;
+                    if (p < 0 || (u32)p > seg_pos) adj = 1;
+                    break;
+                }
+        }
+        i64 c_r = (i64)t.post + adj;
+        if (c_r == weak_quorum) {
+            CRP cr = crn.client_req(a);
+            crn.weak_requests.put(t.dig, cr);
+            if (!cr->stored) actions.push_back(act_correct(a));
+            update_attention(crn);
+            if (cr->stored) client_tracker->add_available(a);
+        }
+        if (c_r == strong_quorum) {
+            CRP cr = crn.client_req(a);
+            crn.strong_requests.put(t.dig, cr);
+            advance_ready();
+        }
+    }
+
+    // Own-segment touch (self-send short-circuit): applied early, before
+    // the touch's canonical position is reached by arrivals.  The count on
+    // our view derives from the add log restricted to our consumed set
+    // plus this touch itself.
+    void led_own_touch(CanonClient &cc, u32 wave_pos, const WaveTouch &t,
+                       const AckS &a, Actions &actions) {
+        u64 mybit = 1ull << my_config.id;
+        if (client_state.lw > t.req_no) return;  // PAST
+        if (high_watermark < t.req_no)
+            throw EngineError("own ack beyond own high watermark");
+        CanonRec &R = cc.rec_or_create(t.req_no);
+        if (R.diverged & mybit) {
+            ack_into(actions, my_config.id, a, false);
+            return;
+        }
+        CRNP crnp = win_get(t.req_no);
+        if (!crnp) throw EngineError("own ack outside window");
+        ClientReqNoD &crn = *crnp;
+        if (t.kind == 2) {
+            // Conflicting own revote, canonically rejected — classic would
+            // reject identically (our non-null bit was self-applied).
+            crn.client_req(a);
+            return;
+        }
+        if (t.kind == 0) {
+            bool known = false;
+            for (i32 d : crn.self_acked)
+                if (d == t.dig) known = true;
+            if (!known) crn.self_acked.push_back(t.dig);
+        }
+        CanonDig *D = R.find(t.dig);
+        i64 cnt = 0;
+        if (D) {
+            for (const auto &pr : D->add_log) {
+                bool cons = led_view->consumed(pr.first);
+                if (!cons && pr.first == wave_pos &&
+                    pr.second == (u8)my_config.id)
+                    cons = true;  // the bit this touch applies
+                if (cons) cnt += 1;
+            }
+        }
+        i64 c_r = cnt;
+        if (c_r < weak_quorum) return;
+        bool newly = c_r == weak_quorum;
+        CRP cr = crn.client_req(a);
+        if (newly) {
+            crn.weak_requests.put(t.dig, cr);
+            if (!cr->stored) actions.push_back(act_correct(a));
+            update_attention(crn);
+        }
+        if (cr->stored) client_tracker->add_available(a);  // source == me
+        if (c_r == strong_quorum) {
+            crn.strong_requests.put(t.dig, cr);
+            advance_ready();
+        }
+    }
+
+    // Exact per-touch walk of one segment (used when the wave-level fast
+    // preconditions fail: window straddling or diverged records).
+    template <typename BufferStore>
+    void led_seg_slow(const WaveSeg &seg, u32 wave_pos,
+                      const vector<AckS> &acks, Actions &actions,
+                      BufferStore &&buffer_store) {
+        u64 mybit = 1ull << my_config.id;
+        CanonClient &cc = *(CanonClient *)seg.canon;
+        if (led_diverged == 0) {
+            // No private records: only candidates and the FUTURE suffix
+            // matter (touches are reqno-ascending within a segment —
+            // coalesce_sends sorts batches by (client, reqno)).
+            for (u32 ci : seg.candidates) {
+                const WaveTouch &t = seg.touches[ci];
+                if (t.req_no < client_state.lw || t.req_no > high_watermark)
+                    continue;
+                CanonRec *R = cc.rec(t.req_no);
+                led_candidate(*R, t, wave_pos, acks[seg.ack_start + ci],
+                              actions);
+            }
+            if (seg.max_reqno > high_watermark) {
+                size_t k = seg.touches.size();
+                while (k > 0 && seg.touches[k - 1].req_no > high_watermark)
+                    k--;
+                for (; k < seg.touches.size(); k++) {
+                    const WaveTouch &t = seg.touches[k];
+                    if (t.req_no <= high_watermark) continue;  // unsorted guard
+                    buffer_store(seg.ack_start + k);
+                    CanonRec &R = cc.rec_or_create(t.req_no);
+                    if (!(R.diverged & mybit)) {
+                        R.diverged |= mybit;
+                        led_diverged += 1;
+                        if (led_diverged_total) *led_diverged_total += 1;
+                    }
+                }
+            }
+            return;
+        }
+        for (size_t k = 0; k < seg.touches.size(); k++) {
+            const WaveTouch &t = seg.touches[k];
+            const AckS &a = acks[seg.ack_start + k];
+            if (client_state.lw > t.req_no) continue;  // PAST: no effect
+            if (high_watermark < t.req_no) {
+                // FUTURE: buffer classically; the record rides private
+                // state for us from here (it has never been in our
+                // window, so fresh classic state is exact).
+                buffer_store(seg.ack_start + k);
+                CanonRec &R = cc.rec_or_create(t.req_no);
+                if (!(R.diverged & mybit)) {
+                    R.diverged |= mybit;
+                    led_diverged += 1;
+                    if (led_diverged_total) *led_diverged_total += 1;
+                }
+                continue;
+            }
+            CanonRec *R = cc.rec(t.req_no);
+            if (R && (R->diverged & mybit)) {
+                ack_into(actions, (i32)seg.src, a, false);
+                continue;
+            }
+            if (t.candidate && R)
+                led_candidate(*R, t, wave_pos, a, actions);
+        }
+    }
+
     // ack_into (disseminator.py:488-539) — the per-ack hot path.
     CRP ack_into(Actions &actions, i32 source, const AckS &ack,
                  bool force = false) {
         CRNP crnp = win_get(ack.reqno);
         if (!crnp) throw EngineError("ack outside watermarks");
+        led_ensure_private(*crnp);
         ClientReqNoD &crn = *crnp;
 
         u64 bit = 1ull << source;
@@ -1743,6 +2303,12 @@ struct ClientD {
         if (ack.dig != 0) crn.non_null_voters |= bit;
 
         CRP cr = crn.client_req(ack);
+        if (source == my_config.id && !(cr->agreements & bit)) {
+            bool known = false;
+            for (i32 d : crn.self_acked)
+                if (d == ack.dig) known = true;
+            if (!known) crn.self_acked.push_back(ack.dig);
+        }
         cr->agreements |= bit;
         i64 agreement_count = (i64)__builtin_popcountll(cr->agreements);
 
@@ -1894,6 +2460,9 @@ struct ClientD {
                     attention.erase(rn);
                     continue;
                 }
+                // attention_tick mutates per-candidate fetch state and
+                // reads agreements (fetch targets): private-state ground.
+                led_ensure_private(*crn);
                 if (crn->attention_tick(actions, nodes, ctx->intern))
                     schedule_resend(*crn, tick_count + ACK_RESEND_TICKS);
                 update_attention(*crn);
@@ -1935,11 +2504,43 @@ struct Disseminator {
     vector<ClientStateS> client_states;
     std::map<i32, MsgBuffer> msg_buffers;
     std::map<i64, shared_ptr<ClientD>> clients;
+    vector<ClientD *> client_dense;  // direct index for small dense ids
     std::set<i64> ack_dirty;
+    // Ack-ledger receiver state: the global stream cursor plus the
+    // aggregates that gate the wave-level fast path.
+    LedView led_view;
+    i64 led_diverged_total = 0;
+    i64 led_classic_count = 0;
+    i64 led_max_lw = 0;          // max client low watermark (PAST gate)
+    i64 led_min_high = INT64_MAX;  // min client high watermark (FUTURE gate)
+
+    void led_refresh_bounds() {
+        led_max_lw = 0;
+        led_min_high = INT64_MAX;
+        led_classic_count = 0;
+        for (const auto &pr : clients) {
+            const ClientD &c = *pr.second;
+            if (c.client_state.lw > led_max_lw) led_max_lw = c.client_state.lw;
+            if (c.high_watermark < led_min_high) led_min_high = c.high_watermark;
+            if (c.led_classic) led_classic_count += 1;
+        }
+    }
 
     ClientD *client(i64 client_id) {
+        if ((u64)client_id < client_dense.size())
+            return client_dense[(size_t)client_id];
         auto it = clients.find(client_id);
         return it == clients.end() ? nullptr : it->second.get();
+    }
+
+    void rebuild_dense() {
+        client_dense.clear();
+        i64 max_id = -1;
+        for (const auto &pr : clients) max_id = std::max(max_id, pr.first);
+        if (max_id < 0 || max_id >= 4096) return;
+        client_dense.assign((size_t)max_id + 1, nullptr);
+        for (const auto &pr : clients)
+            client_dense[(size_t)pr.first] = pr.second.get();
     }
 
     Actions reinitialize(i64 seq_no, const NetStateS &network_state) {
@@ -1961,10 +2562,14 @@ struct Disseminator {
                 c->ctx = ctx;
                 c->my_config = my_config;
                 c->client_tracker = client_tracker;
+                c->led_view = &led_view;
+                c->led_diverged_total = &led_diverged_total;
+                c->led_classic_count = &led_classic_count;
             }
             clients.emplace(cs.id, c);
             concat(actions, c->reinitialize(seq_no, cs.id, cs, reconfiguring));
         }
+        led_refresh_bounds();
         auto old_msg_buffers = std::move(msg_buffers);
         msg_buffers.clear();
         for (i32 node : ctx->cfg.nodes) {
@@ -1978,6 +2583,7 @@ struct Disseminator {
                 msg_buffers.emplace(node, std::move(mb));
             }
         }
+        rebuild_dense();
         initialized = true;
         return actions;
     }
@@ -2002,36 +2608,148 @@ struct Disseminator {
         throw EngineError("unexpected client message type");
     }
 
+    // The classic per-ack classification loop over acks[i..end) — the
+    // AckBatch arm of disseminator.py:1056-1085; also the fallback for
+    // ledger segments outside the fast path's envelope.
+    void classic_slice(Actions &actions, i32 source, const vector<AckS> &acks,
+                       size_t i, size_t end) {
+        while (i < end) {
+            const AckS &ack = acks[i];
+            ClientD *c = client(ack.client);
+            if (!c) {
+                msg_buffers.at(source).store(mk_ack_msg(ack));  // FUTURE
+                i++;
+                continue;
+            }
+            i64 req_no = ack.reqno;
+            if (c->client_state.lw > req_no) {
+                i++;
+                continue;  // PAST
+            }
+            if (c->high_watermark < req_no) {
+                msg_buffers.at(source).store(mk_ack_msg(ack));  // FUTURE
+                i++;
+                continue;
+            }
+            i = c->ack_run(actions, source, acks, i);
+        }
+    }
+
     Actions step(i32 source, const MsgP &msg) {
+        if ((msg->t == MT::AckBatch || msg->t == MT::AckMsg) &&
+            msg->wave_id >= 0 && ctx->ack_ledger != nullptr) {
+            // Ledger wave consumption: ONE cursor bump per wave plus the
+            // precomputed quorum-crossing candidates.  See AckLedger.
+            u64 t0 = __rdtsc();
+            Actions actions;
+            const WaveReg &reg = ctx->ack_ledger->wave(msg->wave_id);
+            const vector<AckS> &acks = reg.msg->acks;
+            auto buffer_store = [&](size_t ack_index) {
+                msg_buffers.at(source).store(reg.single(ack_index));
+            };
+            if (source == my_config.id) {
+                // Own wave, consumed early via the self-send short-circuit.
+                for (const WaveSeg &seg : reg.segs) {
+                    ClientD *c = client(seg.client);
+                    if (!c) {
+                        for (u32 k = seg.ack_start; k < seg.ack_end; k++)
+                            buffer_store(k);
+                        continue;
+                    }
+                    if (c->led_classic) {
+                        classic_slice(actions, source, acks, seg.ack_start,
+                                      seg.ack_end);
+                        continue;
+                    }
+                    CanonClient &cc = *(CanonClient *)seg.canon;
+                    for (size_t k = 0; k < seg.touches.size(); k++)
+                        c->led_own_touch(cc, reg.pos, seg.touches[k],
+                                         acks[seg.ack_start + k], actions);
+                }
+                if (led_view.version == reg.pos) {
+                    led_view.version += 1;
+                    led_view.absorb();
+                } else {
+                    led_view.own_early.push_back(reg.pos);
+                }
+                g_parts[0].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
+                return actions;
+            }
+            // Arrival: the cursor must be exactly at this wave's position
+            // (gaps can only be our own early-consumed waves).
+            led_view.absorb();
+            if (led_view.version != reg.pos) {
+                // Outside the modeled envelope: switch every client to the
+                // classic path, permanently (safe, exact).  The cursor
+                // still advances so ledger pruning is not blocked.
+                for (const auto &pr : clients)
+                    pr.second->led_fallback_all_classic();
+                led_refresh_bounds();
+                if (reg.pos + 1 > led_view.version) {
+                    led_view.version = reg.pos + 1;
+                    led_view.own_early.clear();
+                }
+                classic_slice(actions, source, acks, 0, acks.size());
+                g_parts[0].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
+                return actions;
+            }
+            if (led_classic_count == 0 && led_diverged_total == 0 &&
+                reg.max_any < led_min_high && reg.min_any >= led_max_lw) {
+                // Steady-state: only quorum-crossing candidates cost work.
+                for (u32 si : reg.candidate_segs) {
+                    const WaveSeg &seg = reg.segs[si];
+                    ClientD *c = client(seg.client);
+                    CanonClient &cc = *(CanonClient *)seg.canon;
+                    for (u32 ci : seg.candidates) {
+                        const WaveTouch &t = seg.touches[ci];
+                        CanonRec *R = cc.rec(t.req_no);
+                        c->led_candidate(*R, t, reg.pos,
+                                         acks[seg.ack_start + ci], actions);
+                    }
+                }
+            } else {
+                for (const WaveSeg &seg : reg.segs) {
+                    ClientD *c = client(seg.client);
+                    if (!c) {
+                        for (u32 k = seg.ack_start; k < seg.ack_end; k++)
+                            buffer_store(k);
+                        continue;
+                    }
+                    if (c->led_classic) {
+                        classic_slice(actions, source, acks, seg.ack_start,
+                                      seg.ack_end);
+                        continue;
+                    }
+                    // Per-segment gate: an in-window segment with no
+                    // diverged records costs only its candidates.
+                    if (c->led_diverged == 0 &&
+                        c->client_state.lw <= seg.min_reqno &&
+                        seg.max_reqno <= c->high_watermark) {
+                        CanonClient &cc = *(CanonClient *)seg.canon;
+                        for (u32 ci : seg.candidates) {
+                            const WaveTouch &t = seg.touches[ci];
+                            CanonRec *R = cc.rec(t.req_no);
+                            c->led_candidate(*R, t, reg.pos,
+                                             acks[seg.ack_start + ci],
+                                             actions);
+                        }
+                        continue;
+                    }
+                    c->led_seg_slow(seg, reg.pos, acks, actions, buffer_store);
+                }
+            }
+            led_view.version = reg.pos + 1;
+            led_view.absorb();
+            g_parts[0].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
+            return actions;
+        }
         if (msg->t == MT::AckBatch) {
             u64 t0 = __rdtsc();
             // Per-ack classification; in-window same-client runs go through
             // ack_run (the AckBatch arm of disseminator.py:1056-1085 — the
             // pure semantics the native plane replays).
             Actions actions;
-            const vector<AckS> &acks = msg->acks;
-            size_t n = acks.size();
-            size_t i = 0;
-            while (i < n) {
-                const AckS &ack = acks[i];
-                ClientD *c = client(ack.client);
-                if (!c) {
-                    msg_buffers.at(source).store(mk_ack_msg(ack));  // FUTURE
-                    i++;
-                    continue;
-                }
-                i64 req_no = ack.reqno;
-                if (c->client_state.lw > req_no) {
-                    i++;
-                    continue;  // PAST
-                }
-                if (c->high_watermark < req_no) {
-                    msg_buffers.at(source).store(mk_ack_msg(ack));  // FUTURE
-                    i++;
-                    continue;
-                }
-                i = c->ack_run(actions, source, acks, i);
-            }
+            classic_slice(actions, source, msg->acks, 0, msg->acks.size());
             g_parts[0].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
             return actions;
         }
@@ -2087,12 +2805,18 @@ struct Disseminator {
             ClientD *c = client(cs.id);
             concat(actions, c->allocate(seq_no, cs, reconfiguring));
         }
+        led_refresh_bounds();
         for (i32 node : ctx->cfg.nodes) {
             msg_buffers.at(node).iterate(
                 [this](const MsgS &m) { return filter(m); },
                 [this, node, &actions](MsgP m) {
                     concat(actions, apply_msg(node, m));
                 });
+        }
+        if (ctx->ack_ledger != nullptr) {
+            for (const auto &cs : network_state.clients)
+                client(cs.id)->led_try_realign();
+            led_refresh_bounds();
         }
         return actions;
     }
@@ -2101,6 +2825,7 @@ struct Disseminator {
         ClientD *c = client(a.client);
         if (!c || !c->in_watermarks(a.reqno)) return Actions();
         CRNP crn = c->req_no_of(a.reqno);
+        c->led_ensure_private(*crn);  // reads agreements (our own bit)
         CRP *data = crn->requests.get(a.dig);
         if (!data || !(((*data)->agreements >> my_config.id) & 1))
             return Actions();
@@ -4915,6 +5640,9 @@ struct Engine {
     std::unordered_map<string, i32> wave_memo;
     // Cluster-shared app hash-chain DAG (see AppChain above).
     AppChain app_chain;
+    // Cluster-shared ack-wave ledger (see AckLedger above); enabled when
+    // link latency is uniform (so send order == arrival order).
+    AckLedger ack_ledger;
 
     ClientSpec *spec_of(i64 client_id) {
         for (auto &cs : client_specs)
@@ -5029,6 +5757,28 @@ struct Engine {
         return net_actions;
     }
 
+    // Bound ledger memory: drop waves every live receiver's cursor has
+    // passed and canonical records below every receiver's low watermark.
+    void prune_ledger() {
+        u32 minv = UINT32_MAX;
+        std::map<i64, i64> min_lw;
+        for (const auto &np : nodes) {
+            if (!np->machine || !np->machine->client_hash_disseminator)
+                continue;
+            Disseminator &d = *np->machine->client_hash_disseminator;
+            if (!d.initialized) continue;
+            if (d.led_view.version < minv) minv = d.led_view.version;
+            for (const auto &pr : d.clients) {
+                i64 lw = pr.second->client_state.lw;
+                auto it = min_lw.find(pr.first);
+                if (it == min_lw.end() || lw < it->second)
+                    min_lw[pr.first] = lw;
+            }
+        }
+        if (minv == UINT32_MAX) return;
+        ctx.ack_ledger->prune(minv, min_lw);
+    }
+
     Events process_net_actions(EngineNode &node, Actions &&actions) {
         Events events;
         u64 t0 = __rdtsc();
@@ -5036,6 +5786,20 @@ struct Engine {
         g_parts[3].fetch_add(__rdtsc() - t0, std::memory_order_relaxed);
         for (auto &action : coalesced) {
             MsgP m = action.msg();
+            // Register broadcast ack waves in the cluster ledger at send
+            // time (send order == arrival order under uniform latency), so
+            // receivers consume them as cursor bumps + crossing replays.
+            if (ctx.ack_ledger != nullptr &&
+                (action.targets == ctx.bcast || *action.targets == *ctx.bcast)) {
+                if (m->t == MT::AckBatch || m->t == MT::AckMsg) {
+                    ctx.ack_ledger->register_msg(m, node.id);
+                } else if (m->t == MT::MsgBatch) {
+                    for (const auto &im : m->inner)
+                        if (im->t == MT::AckBatch || im->t == MT::AckMsg)
+                            ctx.ack_ledger->register_msg(im, node.id);
+                }
+                if (ctx.ack_ledger->waves.size() >= 256) prune_ledger();
+            }
             for (i32 replica : *action.targets) {
                 if (replica == node.id) {
                     EventS e;
@@ -5521,6 +6285,27 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
             node->init_parms.new_epoch_timeout_ticks = get_i64(spec.p, 13);
             node->init_parms.buffer_size = get_i64(spec.p, 14);
             engine->nodes.push_back(std::move(node));
+        }
+
+        // Ack ledger: requires send order == arrival order, i.e. uniform
+        // link latency across nodes.  Late-started nodes miss canonical
+        // stream prefixes, so they consume classically.
+        {
+            bool uniform = true;
+            for (const auto &node : engine->nodes)
+                if (node->runtime.link_latency !=
+                    engine->nodes[0]->runtime.link_latency)
+                    uniform = false;
+            const char *env = std::getenv("MIRBFT_FAST_LEDGER");
+            bool enabled = uniform && !(env && env[0] == '0');
+            if (enabled) {
+                engine->ack_ledger.wq = engine->ctx.wq;
+                engine->ack_ledger.sq = engine->ctx.iq;
+                engine->ctx.ack_ledger = &engine->ack_ledger;
+                for (auto &node : engine->nodes)
+                    if (node->start_delay > 0)
+                        node->init_parms.led_classic = true;
+            }
         }
 
         // Seed node worlds + initialize events (Recorder.recording()).

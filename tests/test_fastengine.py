@@ -200,6 +200,29 @@ def test_out_of_envelope_escalates_cleanly():
         fr.drain_clients(timeout=10_000_000)
 
 
+@pytest.mark.parametrize("seed", [0, 3, 9, 17])
+def test_randomized_small_width_differential(seed):
+    """Tiny client windows force the ack ledger's edge paths — FUTURE
+    buffering, per-record divergence, post-replay re-alignment, window
+    straddling — far more often than the default width does.  Bit-identity
+    must survive all of them."""
+    import random
+
+    rng = random.Random(seed * 104729 + 17)
+    spec = Spec(
+        node_count=rng.randint(1, 16),
+        client_count=rng.randint(1, 6),
+        reqs_per_client=rng.randint(5, 60),
+        batch_size=rng.choice([1, 2, 5, 20]),
+        client_width=rng.choice([4, 8, 10, 20, 50]),
+        signed_requests=rng.random() < 0.2,
+    )
+    steps_py, time_py, state_py = _python_run(spec, timeout=30_000_000)
+    steps_fast, time_fast, state_fast = _fast_run(spec, timeout=30_000_000)
+    assert (steps_fast, time_fast) == (steps_py, time_py), spec
+    assert state_fast == state_py, spec
+
+
 @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5, 6])
 def test_randomized_differential(seed):
     """Seeded random in-envelope configs: node count, client count, request
