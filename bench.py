@@ -90,6 +90,8 @@ def run_fast_engine(
     batch_size,
     signed=False,
     device=True,
+    device_authoritative=False,
+    streaming_auth=False,
     timeout=100_000_000,
 ):
     """One native-engine run (bit-identical twin of the Python engine; see
@@ -113,7 +115,12 @@ def run_fast_engine(
     # (device waves or host fallback) happens at FastRecording construction,
     # and the Python engine pays the equivalent work inside its drain.
     start = time.perf_counter()
-    recording = FastRecording(spec, device=device)
+    recording = FastRecording(
+        spec,
+        device=device,
+        device_authoritative=device_authoritative,
+        streaming_auth=streaming_auth,
+    )
     steps = recording.drain_clients(timeout=timeout)
     elapsed = time.perf_counter() - start
     by_seq = {}
@@ -140,6 +147,7 @@ def run_fast_engine(
         "hash_msgs": int(snap.get("device_hashed_messages", 0)),
         "verify_dispatches": int(snap.get("device_verify_dispatches", 0)),
         "verify_sigs": int(snap.get("device_verified_signatures", 0)),
+        "device_stall_s": recording.device_stall_s,
         "recording": recording,
     }
 
@@ -489,6 +497,22 @@ def main():
         res_u["unique_per_s"] / res["unique_per_s"], 2
     )
 
+    # Config 2, streaming-auth variant: verdicts produced by device
+    # lookahead waves DURING the run (the engine pauses wall-clock-only
+    # when its proposal cursor outruns them; simulated schedule and step
+    # count stay bit-identical to the bitmap row above).
+    try:
+        res_s = run_fast_engine(
+            16, 16, 50, 100, signed=True, device=True, streaming_auth=True
+        )
+        assert res_s["steps"] == detail["c2_16n_signed_sim_steps"], (
+            "streaming schedule diverged"
+        )
+        put(detail, "c2s_16n_streaming", res_s)
+        detail["c2s_16n_streaming_stall_s"] = round(res_s["device_stall_s"], 2)
+    except Exception as exc:  # must not sink the bench
+        detail["c2s_error"] = f"{type(exc).__name__}: {exc}"[:160]
+
     # Config 3 (north star): 64-replica stress, device crypto.  The fast
     # run is measured twice and the better run reported (both walls are on
     # record): this rig's shared tunnel/host varies +/-40% run to run, and
@@ -517,6 +541,24 @@ def main():
         put(detail, "c3_64n", res)
     headline = res["unique_per_s"]
     detail["c3_64n_commit_ops"] = res["commit_ops"]
+
+    # Config 3, device-authoritative variant: the TPU is the PRODUCER of
+    # every wave-eligible protocol digest (engine does no host hashing
+    # above the floor; it pauses wall-clock-only at hash barriers).  Step
+    # count is bit-identical to the mirror-mode rows; the wall honestly
+    # carries one tunnel round-trip per unique content generation, on
+    # record next to the mirror row (docs/PERFORMANCE.md).
+    try:
+        res_dev = run_fast_engine(
+            64, 64, 100, 100, device=True, device_authoritative=True
+        )
+        assert res_dev["steps"] == detail["c3py_64n_sim_steps"], (
+            "device-authoritative schedule diverged"
+        )
+        put(detail, "c3dev_64n", res_dev)
+        detail["c3dev_64n_stall_s"] = round(res_dev["device_stall_s"], 2)
+    except Exception as exc:
+        detail["c3dev_error"] = f"{type(exc).__name__}: {exc}"[:160]
     if res is not res_py:
         # Mean fast wall vs the single Python run: comparing best-of-2
         # against a single sample would bias the ratio upward.

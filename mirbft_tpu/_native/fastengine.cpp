@@ -1482,6 +1482,13 @@ struct BatchTracker {
 // ack accumulation rules this plane replays canonically).
 // ---------------------------------------------------------------------------
 
+// The host-fast floor shared by hash_parts and check_ready (mirrors
+// crypto.py::_host_fast's complement): single parts under 512 B stay on
+// the host; everything else is wave-eligible device content.
+inline bool hash_is_host_floor(const vector<string> &parts) {
+    return parts.size() == 1 && parts[0].size() < 512;
+}
+
 struct WaveTouch {
     i64 req_no;
     i32 dig;      // digest interner id
@@ -5643,6 +5650,18 @@ struct Engine {
     // Cluster-shared ack-wave ledger (see AckLedger above); enabled when
     // link latency is uniform (so send order == arrival order).
     AckLedger ack_ledger;
+    // Device-authoritative crypto (docs/FastEngine.md "Device crypto"):
+    // in device_hash_mode, wave-eligible digests are CONSUMED from device
+    // collects — the engine pauses (wall-clock only; the simulated
+    // schedule is untouched, so step counts stay bit-identical to mirror
+    // mode) whenever the next event needs a digest not yet supplied.  In
+    // streaming_auth_mode, signed-request verdicts arrive in lookahead
+    // waves during the run instead of one pre-run bitmap.
+    bool device_hash_mode = false;
+    bool streaming_auth_mode = false;
+    std::unordered_map<string, i32> device_digests;  // content -> digest id
+    vector<string> need_hash_content;
+    vector<std::pair<i64, i64>> need_verdicts;  // (client, verdicts needed up to)
 
     ClientSpec *spec_of(i64 client_id) {
         for (auto &cs : client_specs)
@@ -5654,7 +5673,7 @@ struct Engine {
     // hashlib; wave-eligible content (multi-part or >= 512 B single part —
     // the complement of crypto.py::_host_fast) is mirrored for the device.
     i32 hash_parts(const vector<string> &parts) {
-        if (parts.size() == 1 && parts[0].size() < 512) {
+        if (hash_is_host_floor(parts)) {
             // Below the wave floor (host-only content).  Memo lookup keys
             // on the part itself — no copy on the hit path.
             auto hit = host_memo.find(parts[0]);
@@ -5670,6 +5689,15 @@ struct Engine {
         }
         string joined;
         for (const auto &p : parts) joined.append(p);
+        if (device_hash_mode) {
+            // The device is authoritative for wave-eligible content: no
+            // host hash, no mirror log.  check_ready() guarantees the
+            // digest was supplied before this event ran.
+            auto dit = device_digests.find(joined);
+            if (dit == device_digests.end())
+                throw EngineError("device digest missing at hash time");
+            return dit->second;
+        }
         auto hit = wave_memo.find(joined);
         if (hit != wave_memo.end()) return hit->second;
         auto t0 = std::chrono::steady_clock::now();
@@ -5776,6 +5804,34 @@ struct Engine {
             }
         }
         if (minv == UINT32_MAX) return;
+        // Clear stale divergence marks on fully-retired records (below
+        // every receiver's low watermark no window will ever cover them
+        // again); fixes the owning receivers' counters so the fast gates
+        // and prune() are not blocked by a missed release.
+        for (auto &cp : ctx.ack_ledger->clients) {
+            auto it = min_lw.find(cp.first);
+            if (it == min_lw.end()) continue;
+            CanonClient &cc = cp.second;
+            for (i64 rn = cc.base; rn >= 0 && rn < it->second &&
+                                   rn - cc.base < (i64)cc.recs.size();
+                 rn++) {
+                CanonRec &R = cc.recs[(size_t)(rn - cc.base)];
+                while (R.diverged != 0) {
+                    int r = __builtin_ctzll(R.diverged);
+                    R.diverged &= R.diverged - 1;
+                    EngineNode &dn = *nodes[(size_t)r];
+                    if (!dn.machine || !dn.machine->client_hash_disseminator)
+                        continue;
+                    ClientD *dc =
+                        dn.machine->client_hash_disseminator->client(cp.first);
+                    if (dc && !dc->led_classic) {
+                        dc->led_diverged -= 1;
+                        dn.machine->client_hash_disseminator
+                            ->led_diverged_total -= 1;
+                    }
+                }
+            }
+        }
         ctx.ack_ledger->prune(minv, min_lw);
     }
 
@@ -5880,7 +5936,46 @@ struct Engine {
     }
 
     void step();
-    i64 run(i64 max_steps, i64 timeout, bool *done, bool *timed_out);
+    i64 run(i64 max_steps, i64 timeout, bool *done, bool *timed_out,
+            bool *need_device);
+
+    // Inspect the queue head: does the next event need device results the
+    // wrapper has not supplied yet?  Fills need_hash_content /
+    // need_verdicts when so.  Consumes nothing; the simulated schedule is
+    // independent of the pause.
+    bool check_ready() {
+        if (!device_hash_mode && !streaming_auth_mode) return true;
+        if (queue.heap.empty()) return true;
+        const SimEv &head = queue.heap.front();
+        need_hash_content.clear();
+        need_verdicts.clear();
+        if (device_hash_mode && head.kind == SK::ProcessHash) {
+            for (const auto &action : *head.actions) {
+                if (action.t != AT::Hash) continue;
+                HashReqP hr = action.hash();
+                const vector<string> &parts = hr->parts;
+                if (hash_is_host_floor(parts)) continue;
+                string joined;
+                for (const auto &p : parts) joined.append(p);
+                if (device_digests.find(joined) != device_digests.end())
+                    continue;
+                bool dup = false;  // same content twice in one event batch
+                for (const auto &c : need_hash_content)
+                    if (c == joined) { dup = true; break; }
+                if (!dup) need_hash_content.push_back(std::move(joined));
+            }
+        }
+        if (streaming_auth_mode && head.kind == SK::ClientProposal) {
+            ClientSpec *cs = spec_of(head.client);
+            if (cs && cs->signed_mode) {
+                i64 need_to = std::min(head.reqno + (i64)PROPOSAL_CHUNK,
+                                       cs->total);
+                if ((i64)cs->verdicts.size() < need_to)
+                    need_verdicts.emplace_back(head.client, need_to);
+            }
+        }
+        return need_hash_content.empty() && need_verdicts.empty();
+    }
     bool drained() const {
         return nodes_not_ready == 0 && clients_unsatisfied == 0;
     }
@@ -6126,11 +6221,17 @@ void Engine::step() {
     }
 }
 
-i64 Engine::run(i64 max_steps, i64 timeout, bool *done, bool *timed_out) {
+i64 Engine::run(i64 max_steps, i64 timeout, bool *done, bool *timed_out,
+                bool *need_device) {
     *done = false;
     *timed_out = false;
+    *need_device = false;
     i64 executed = 0;
     while (executed < max_steps) {
+        if (!check_ready()) {
+            *need_device = true;
+            return executed;
+        }
         steps += 1;
         executed += 1;
         step();
@@ -6351,13 +6452,14 @@ PyObject *engine_run(PyObject *self, PyObject *args) {
     long long max_steps, timeout;
     if (!PyArg_ParseTuple(args, "LL", &max_steps, &timeout)) return nullptr;
     Engine *e = ((PyEngine *)self)->engine;
-    bool done = false, timed_out = false;
+    bool done = false, timed_out = false, need_device = false;
     i64 executed = 0;
     string error;
     {
         PyThreadState *save = PyEval_SaveThread();
         try {
-            executed = e->run(max_steps, timeout, &done, &timed_out);
+            executed = e->run(max_steps, timeout, &done, &timed_out,
+                              &need_device);
         } catch (const std::exception &ex) {
             error = ex.what();
             if (error.empty()) error = "fastengine error";
@@ -6368,8 +6470,8 @@ PyObject *engine_run(PyObject *self, PyObject *args) {
         PyErr_SetString(PyExc_RuntimeError, error.c_str());
         return nullptr;
     }
-    return Py_BuildValue("Lii", (long long)executed, done ? 1 : 0,
-                         timed_out ? 1 : 0);
+    return Py_BuildValue("Liii", (long long)executed, done ? 1 : 0,
+                         timed_out ? 1 : 0, need_device ? 1 : 0);
 }
 
 PyObject *engine_stats(PyObject *self, PyObject *) {
@@ -6508,8 +6610,94 @@ PyObject *engine_profile(PyObject *self, PyObject *) {
     return out;
 }
 
+// pending_device_work() -> (list[bytes] hash_content,
+//                            list[(client_id, need_verdicts_up_to)])
+PyObject *engine_pending_device_work(PyObject *self, PyObject *) {
+    Engine *e = ((PyEngine *)self)->engine;
+    PyObject *contents = PyList_New(0);
+    if (!contents) return nullptr;
+    for (const auto &c : e->need_hash_content) {
+        PyObject *b = PyBytes_FromStringAndSize(c.data(), (Py_ssize_t)c.size());
+        if (!b || PyList_Append(contents, b) < 0) {
+            Py_XDECREF(b);
+            Py_DECREF(contents);
+            return nullptr;
+        }
+        Py_DECREF(b);
+    }
+    PyObject *verdicts = PyList_New(0);
+    if (!verdicts) {
+        Py_DECREF(contents);
+        return nullptr;
+    }
+    for (const auto &pr : e->need_verdicts) {
+        PyObject *t = Py_BuildValue("LL", (long long)pr.first,
+                                    (long long)pr.second);
+        if (!t || PyList_Append(verdicts, t) < 0) {
+            Py_XDECREF(t);
+            Py_DECREF(contents);
+            Py_DECREF(verdicts);
+            return nullptr;
+        }
+        Py_DECREF(t);
+    }
+    return Py_BuildValue("NN", contents, verdicts);
+}
+
+// supply_digests([(content_bytes, digest_bytes), ...])
+PyObject *engine_supply_digests(PyObject *self, PyObject *args) {
+    PyObject *items;
+    if (!PyArg_ParseTuple(args, "O", &items)) return nullptr;
+    Engine *e = ((PyEngine *)self)->engine;
+    Py_ssize_t n = PySequence_Size(items);
+    if (n < 0) return nullptr;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyRef it(PySequence_GetItem(items, i));
+        if (!it) return nullptr;
+        const char *content, *digest;
+        Py_ssize_t clen, dlen;
+        if (!PyArg_ParseTuple(it.p, "y#y#", &content, &clen, &digest, &dlen))
+            return nullptr;
+        e->device_digests[string(content, (size_t)clen)] =
+            e->ctx.intern.put(string(digest, (size_t)dlen));
+    }
+    Py_RETURN_NONE;
+}
+
+// supply_verdicts(client_id, verdict_bytes) — appends to the client's
+// verdict array (streaming-auth mode).
+PyObject *engine_supply_verdicts(PyObject *self, PyObject *args) {
+    long long client_id;
+    const char *buf;
+    Py_ssize_t blen;
+    if (!PyArg_ParseTuple(args, "Ly#", &client_id, &buf, &blen))
+        return nullptr;
+    Engine *e = ((PyEngine *)self)->engine;
+    ClientSpec *cs = e->spec_of(client_id);
+    if (!cs) {
+        PyErr_SetString(PyExc_KeyError, "unknown client");
+        return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < blen; i++) cs->verdicts.push_back((u8)buf[i]);
+    Py_RETURN_NONE;
+}
+
+// set_device_modes(device_hash, streaming_auth)
+PyObject *engine_set_device_modes(PyObject *self, PyObject *args) {
+    int dh, sa;
+    if (!PyArg_ParseTuple(args, "ii", &dh, &sa)) return nullptr;
+    Engine *e = ((PyEngine *)self)->engine;
+    e->device_hash_mode = dh != 0;
+    e->streaming_auth_mode = sa != 0;
+    Py_RETURN_NONE;
+}
+
 PyMethodDef engine_methods[] = {
     {"run", engine_run, METH_VARARGS, nullptr},
+    {"pending_device_work", engine_pending_device_work, METH_NOARGS, nullptr},
+    {"supply_digests", engine_supply_digests, METH_VARARGS, nullptr},
+    {"supply_verdicts", engine_supply_verdicts, METH_VARARGS, nullptr},
+    {"set_device_modes", engine_set_device_modes, METH_VARARGS, nullptr},
     {"stats", engine_stats, METH_NOARGS, nullptr},
     {"node_summary", engine_node_summary, METH_VARARGS, nullptr},
     {"pop_hash_log", engine_pop_hash_log, METH_NOARGS, nullptr},

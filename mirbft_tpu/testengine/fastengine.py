@@ -67,9 +67,20 @@ class FastRecording:
         device: bool = False,
         hash_wave: int = 64,
         auth_wave: int = 1024,
+        device_authoritative: bool = False,
+        streaming_auth: bool = False,
     ):
+        """``device_authoritative``: the TPU is the producer of every
+        wave-eligible protocol digest — the engine pauses (wall-clock only;
+        the simulated schedule and step counts are bit-identical to mirror
+        mode) until the wrapper collects the digests from the device.
+        ``streaming_auth``: signed-request verdicts are produced by device
+        lookahead waves DURING the run (multiple dispatches overlapping
+        consensus) instead of one pre-run pass."""
         _require(_native.load_fast() is not None, "native engine unavailable")
         _require(1 <= spec.node_count <= 64, ">64 nodes")
+        if device_authoritative or streaming_auth:
+            _require(device, "device modes require device=True")
         recorder = spec.recorder()
         _require(recorder.mangler is None, "manglers")
         _require(not recorder.reconfig_points, "reconfiguration")
@@ -90,11 +101,18 @@ class FastRecording:
         self.spec = spec
         self.device = device
         self.hash_wave = hash_wave
+        self.device_authoritative = device_authoritative
+        self.streaming_auth = streaming_auth
+        self.auth_wave = auth_wave
         self._py_crypto_s = 0.0
         self._hasher = None
+        self._verifier = None
         self._inflight: List[tuple] = []
         self._pending_msgs: List[bytes] = []
         self._pending_digests: List[bytes] = []
+        # id -> (public_key, payloads, verdicts_supplied_so_far)
+        self._stream_clients: Dict[int, tuple] = {}
+        self.device_stall_s = 0.0
 
         client_states = [(c.id, c.width) for c in recorder.network_state.clients]
 
@@ -119,9 +137,18 @@ class FastRecording:
                     _u64(cc.id) + b"-" + _u64(req_no)
                     for req_no in range(cc.total)
                 ]
-        verdicts_by_client = self._device_verdicts(
-            signed_rows, sim_clients, payloads_by_client, auth_wave
-        )
+        if streaming_auth:
+            # Verdicts arrive in device lookahead waves during the run; the
+            # engine pauses when its proposal cursor outruns them.
+            verdicts_by_client = {}
+            for cid, client in sim_clients.items():
+                self._stream_clients[cid] = (
+                    client.public_key(), payloads_by_client[cid], 0
+                )
+        else:
+            verdicts_by_client = self._device_verdicts(
+                signed_rows, sim_clients, payloads_by_client, auth_wave
+            )
 
         client_specs = []
         for cc in recorder.client_configs:
@@ -150,6 +177,10 @@ class FastRecording:
              net.number_of_buckets, net.f),
             client_states, client_specs, node_specs,
         )
+        if device_authoritative or streaming_auth:
+            self._engine.set_device_modes(
+                int(device_authoritative), int(streaming_auth)
+            )
         self.steps = 0
         self.nodes: List[_NodeFinal] = []
 
@@ -260,15 +291,13 @@ class FastRecording:
         while len(self._pending_msgs) >= self.hash_wave:
             self._launch_waves()
 
-    def _launch_waves(self) -> None:
-        """One async dispatch per block bucket over the pending set, in
-        ladder-shape chunks (mirrors DeviceHashPlane._launch_wave)."""
-        pending = list(zip(self._pending_msgs, self._pending_digests))
-        self._pending_msgs = []
-        self._pending_digests = []
-        by_bucket: Dict[int, List[Tuple[bytes, bytes]]] = {}
-        for (bucket, message), digest in pending:
-            by_bucket.setdefault(bucket, []).append((message, digest))
+    def _dispatch_hash_chunks(self, by_bucket):
+        """Shared dispatch geometry (mirrors DeviceHashPlane._launch_wave):
+        one async dispatch per block bucket in ladder-shape chunks — both
+        the mirror and the authoritative path MUST hit the exact kernel
+        shapes the bench warms, or a fresh XLA compile fires mid-run.
+        ``by_bucket``: {block_bucket: [(message, aux), ...]}; yields
+        (handle, chunk) pairs."""
         for bucket in sorted(by_bucket):
             entries = by_bucket[bucket]
             for start in range(0, len(entries), self._BATCH_BUCKET):
@@ -278,9 +307,20 @@ class FastRecording:
                     block_bucket=bucket,
                     batch_bucket=self._BATCH_BUCKET,
                 )
-                self._inflight.append((handle, [d for _, d in chunk]))
                 metrics.counter("device_hash_dispatches").inc()
                 metrics.counter("device_hashed_messages").inc(len(chunk))
+                yield handle, chunk
+
+    def _launch_waves(self) -> None:
+        """One async dispatch per block bucket over the pending set."""
+        pending = list(zip(self._pending_msgs, self._pending_digests))
+        self._pending_msgs = []
+        self._pending_digests = []
+        by_bucket: Dict[int, List[Tuple[bytes, bytes]]] = {}
+        for (bucket, message), digest in pending:
+            by_bucket.setdefault(bucket, []).append((message, digest))
+        for handle, chunk in self._dispatch_hash_chunks(by_bucket):
+            self._inflight.append((handle, [d for _, d in chunk]))
 
     def _collect_inflight(self) -> None:
         if self._pending_msgs:
@@ -296,13 +336,123 @@ class FastRecording:
 
     # -- drive -------------------------------------------------------------
 
+    def _serve_device_work(self) -> None:
+        """The engine paused: the next simulated event needs device results.
+        Dispatch + collect them (pipelined; one blocking sync per pause)
+        and resume.  Stall time is wall-clock only — the simulated schedule
+        never observes it."""
+        import time as _time
+
+        stall_start = _time.perf_counter()
+        contents, verdict_needs = self._engine.pending_device_work()
+        if contents:
+            from .crypto import block_bucket_of
+
+            if self._hasher is None:
+                from ..ops.sha256 import TpuHasher
+
+                self._hasher = TpuHasher(min_device_batch=1)
+            host_side: List[bytes] = []
+            by_bucket: Dict[int, List[Tuple[bytes, None]]] = {}
+            for content in contents:
+                bucket = block_bucket_of(len(content))
+                if bucket is None:
+                    host_side.append(content)  # above the device ladder
+                else:
+                    by_bucket.setdefault(bucket, []).append((content, None))
+            handles = list(self._dispatch_hash_chunks(by_bucket))
+            supplied = []
+            for handle, chunk in handles:
+                for (content, _), digest in zip(
+                    chunk, self._hasher.collect(handle)
+                ):
+                    supplied.append((content, bytes(digest)))
+            if host_side:
+                # Above-ladder content keeps the host floor (same rule as
+                # the mirror planes); metered as host crypto.
+                t0 = _time.perf_counter()
+                supplied.extend(
+                    (c, hashlib.sha256(c).digest()) for c in host_side
+                )
+                self._py_crypto_s += _time.perf_counter() - t0
+            self._engine.supply_digests(supplied)
+        if verdict_needs:
+            self._serve_verdict_waves(verdict_needs)
+        self.device_stall_s += _time.perf_counter() - stall_start
+
+    _AUTH_LOOKAHEAD = 32
+
+    def _serve_verdict_waves(self, verdict_needs) -> None:
+        """Streaming-auth lookahead: one pipelined device pass covering the
+        requesting client's need plus a lookahead chunk, and opportunistic
+        lookahead for every signed client already in flight (so later
+        pauses usually find verdicts supplied)."""
+        from ..processor.verify import signing_payload, unseal
+
+        if self._verifier is None:
+            from ..ops.ed25519 import Ed25519BatchVerifier
+
+            self._verifier = Ed25519BatchVerifier(min_device_batch=1)
+        need_by_client = {cid: need_to for cid, need_to in verdict_needs}
+        plan: List[Tuple[int, int, int]] = []  # (client, start, stop)
+        for cid, (pub, payloads, have) in self._stream_clients.items():
+            total = len(payloads)
+            if cid in need_by_client:
+                target = min(
+                    max(need_by_client[cid], have + self._AUTH_LOOKAHEAD),
+                    total,
+                )
+            elif 0 < have < total:
+                target = min(have + self._AUTH_LOOKAHEAD, total)
+            else:
+                continue
+            if target > have:
+                plan.append((cid, have, target))
+        handles = []
+        for cid, start, stop in plan:
+            pub, payloads, _ = self._stream_clients[cid]
+            pubs, msgs, sigs = [], [], []
+            for req_no in range(start, stop):
+                parts = unseal(payloads[req_no])
+                if parts is None:
+                    pubs.append(b"\x00" * 32)
+                    msgs.append(b"")
+                    sigs.append(b"\x00" * 64)
+                    continue
+                payload, signature = parts
+                pubs.append(pub)
+                msgs.append(signing_payload(cid, req_no, payload))
+                sigs.append(signature)
+            for off in range(0, len(pubs), self.auth_wave):
+                handles.append(
+                    (cid, self._verifier.dispatch(
+                        pubs[off:off + self.auth_wave],
+                        msgs[off:off + self.auth_wave],
+                        sigs[off:off + self.auth_wave]))
+                )
+                metrics.counter("device_verify_dispatches").inc()
+                metrics.counter("device_verified_signatures").inc(
+                    len(pubs[off:off + self.auth_wave])
+                )
+        per_client: Dict[int, bytearray] = {}
+        for cid, handle in handles:
+            per_client.setdefault(cid, bytearray()).extend(
+                int(bool(v)) for v in self._verifier.collect(handle)
+            )
+        for cid, verdicts in per_client.items():
+            self._engine.supply_verdicts(cid, bytes(verdicts))
+            pub, payloads, have = self._stream_clients[cid]
+            self._stream_clients[cid] = (pub, payloads, have + len(verdicts))
+
     def drain_clients(self, timeout: int, slice_steps: int = 200_000) -> int:
         """Run until every client's requests commit on every node; returns
         the step count (bit-identical to the Python engine's)."""
         done = False
         while not done:
             try:
-                _, done, timed_out = self._engine.run(slice_steps, timeout)
+                _, done, timed_out, need_device = self._engine.run(
+                    slice_steps, timeout
+                )
             except RuntimeError as exc:
                 raise FastEngineUnsupported(str(exc)) from exc
             self._drain_hash_log()
@@ -315,6 +465,8 @@ class FastRecording:
                 raise TimeoutError(
                     f"fast engine timed out after {self.stats()[0]} steps"
                 )
+            if need_device:
+                self._serve_device_work()
         self._collect_inflight()
         self.steps = self._engine.stats()[0]
         self.nodes = [

@@ -170,6 +170,55 @@ def test_byzantine_signer_rejected():
         assert c.get(1, 0) == 0  # byzantine client never commits
 
 
+def test_device_authoritative_hashing_bit_identical():
+    """With device_authoritative=True the TPU (CPU backend under the test
+    harness) is the producer of every wave-eligible protocol digest; the
+    engine pauses on wall-clock only, so the simulated schedule — and the
+    step count — is bit-identical to mirror mode, and the engine does no
+    host hashing above the floor."""
+    from mirbft_tpu import metrics
+
+    spec = Spec(node_count=4, client_count=4, reqs_per_client=20, batch_size=5)
+    mirror = FastRecording(spec, device=False)
+    steps_mirror = mirror.drain_clients(timeout=10_000_000)
+    metrics.default_registry.reset()
+    auth = FastRecording(spec, device=True, device_authoritative=True)
+    steps_auth = auth.drain_clients(timeout=10_000_000)
+    assert steps_auth == steps_mirror
+    assert [(n.checkpoint_seq_no, n.active_hash_digest, dict(n.committed_reqs))
+            for n in mirror.nodes] == \
+           [(n.checkpoint_seq_no, n.active_hash_digest, dict(n.committed_reqs))
+            for n in auth.nodes]
+    assert metrics.counter("device_hash_dispatches").value > 0
+    # The engine hashed nothing above the floor: its chrono-metered crypto
+    # covers only below-floor content.
+    assert auth._engine.stats()[3] <= mirror._engine.stats()[3]
+
+
+def test_streaming_auth_matches_bitmap_mode():
+    """Streaming Ed25519: verdicts arrive in device lookahead waves during
+    the run (>1 dispatch), the schedule stays bit-identical to the pre-run
+    bitmap mode, and a byzantine signer stays rejected."""
+    from mirbft_tpu import metrics
+
+    def tweak(r):
+        r.client_configs[1].corrupt = True
+
+    spec = Spec(node_count=4, client_count=2, reqs_per_client=40, batch_size=5,
+                signed_requests=True, tweak_recorder=tweak)
+    bitmap = FastRecording(spec, device=True)
+    steps_bitmap = bitmap.drain_clients(timeout=10_000_000)
+    metrics.default_registry.reset()
+    stream = FastRecording(spec, device=True, streaming_auth=True)
+    steps_stream = stream.drain_clients(timeout=10_000_000)
+    assert steps_stream == steps_bitmap
+    assert [dict(n.committed_reqs) for n in stream.nodes] == \
+           [dict(n.committed_reqs) for n in bitmap.nodes]
+    assert metrics.counter("device_verify_dispatches").value > 1
+    for n in stream.nodes:
+        assert n.committed_reqs.get(1, 0) == 0  # byzantine never commits
+
+
 def test_unsupported_configs_raise():
     spec = Spec(node_count=65, client_count=1, reqs_per_client=1)
     with pytest.raises(FastEngineUnsupported):
