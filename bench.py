@@ -381,7 +381,7 @@ def bench_tpu_hash_kernel(batch=4096, msg_len=640, pipeline=20):
 
 
 def bench_tpu_verify_kernel(
-    batch=1024, n_keys=64, pipeline=10, sync_reps=5, kernel="vpu"
+    batch=1024, n_keys=64, pipeline=10, sync_reps=9, kernel="vpu"
 ):
     """Pipelined vs sync dispatch of the batched Ed25519 kernel.
 
@@ -424,15 +424,118 @@ def bench_tpu_verify_kernel(
     verifier.collect(handles[-1])
     piped = (time.perf_counter() - start) / pipeline
 
+    # Interleaved repetitions: this rig's tunnel varies +/-40% run to run,
+    # so the p99 is taken over reps spread across other device activity
+    # (a hash dispatch between verify round-trips) rather than
+    # back-to-back samples of one quiet window.
+    import numpy as np
+
+    interleave = None
+    if sync_reps > 1:
+        from mirbft_tpu.ops.sha256 import TpuHasher
+
+        _h = TpuHasher(min_device_batch=1)
+        _hmsgs = [b"p99-interleave-%d" % i for i in range(64)]
+        _h.collect(_h.dispatch(_hmsgs))  # warm
+        interleave = lambda: _h.collect(_h.dispatch(_hmsgs))  # noqa: E731
     sync_times = []
     for _ in range(sync_reps):
         start = time.perf_counter()
         verifier.collect(verifier.dispatch(pubs, msgs, sigs))
         sync_times.append(time.perf_counter() - start)
-    import numpy as np
-
+        if interleave is not None:
+            interleave()
     sync_p99 = float(np.percentile(np.array(sync_times), 99))
     return batch / piped, piped, sync_p99
+
+
+def bench_device_resident(detail, hash_batch=4096, msg_len=640,
+                          verify_batch=1024, reps=8):
+    """Device-resident kernel rates (inputs staged on device once; timing
+    covers kernel execution only, one trailing device->host barrier) — the
+    number the tunnel hides from the end-to-end rows, now on record
+    (docs/PERFORMANCE.md S3's presentation-gap fix), plus the int-op
+    utilization figures for both kernels.
+
+    Int-op accounting (recorded, not prose): SHA-256 compression ~= 2,500
+    integer ops per 64 B block (64 rounds x ~30 ops + schedule 48 x ~12);
+    Ed25519 ~= 280 G int-MACs per 1024-signature batch (the bit-serial
+    ladder's contraction count, docs/PERFORMANCE.md S2).  Utilization is
+    reported against the v5e's int8 MXU peak (~394 TOPS, the chip's
+    integer ceiling) — our int32 formulations cannot lower onto the MXU
+    (S2), so low percentages are structural, not waste; the VPU-relative
+    analysis lives in the doc."""
+    import numpy as np
+    import jax
+
+    from mirbft_tpu.ops.sha256 import TpuHasher, pad_message, sha256_batch_kernel
+
+    rng = np.random.default_rng(0)
+    msgs = [
+        rng.integers(0, 256, size=msg_len, dtype=np.uint8).tobytes()
+        for _ in range(hash_batch)
+    ]
+    padded = [pad_message(m) for m in msgs]
+    n_blocks_each = padded[0].shape[0]
+    blocks = np.zeros((hash_batch, n_blocks_each, 16), dtype=np.uint32)
+    for i, pb in enumerate(padded):
+        blocks[i, : pb.shape[0]] = pb
+    n_blocks = np.full(hash_batch, n_blocks_each, dtype=np.uint32)
+    dev_blocks = jax.device_put(blocks)
+    dev_n = jax.device_put(n_blocks)
+    np.asarray(sha256_batch_kernel(dev_blocks, dev_n))  # compile + warm
+    start = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = sha256_batch_kernel(dev_blocks, dev_n)
+    np.asarray(out)
+    hash_ms = (time.perf_counter() - start) / reps * 1e3
+    detail["hash_device_resident_4096_ms"] = round(hash_ms, 2)
+    detail["hash_device_resident_per_s"] = round(hash_batch / (hash_ms / 1e3), 1)
+    hash_int_ops = hash_batch * n_blocks_each * 2500
+    detail["hash_device_int_ops_per_s"] = round(hash_int_ops / (hash_ms / 1e3))
+    detail["hash_pct_of_chip_int8_peak"] = round(
+        100 * hash_int_ops / (hash_ms / 1e3) / 394e12, 3
+    )
+
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier, ed25519_verify_kernel
+
+    verifier = Ed25519BatchVerifier(min_device_batch=1)
+    key = Ed25519PrivateKey.from_private_bytes(b"\x07" * 32)
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    pubs, vmsgs, sigs = [], [], []
+    for i in range(verify_batch):
+        m = b"resident-%d" % i
+        pubs.append(pub)
+        vmsgs.append(m)
+        sigs.append(key.sign(m))
+    ax, ay, r_bytes, s_bits, h_bits, _valid = verifier.pack_inputs(
+        pubs, vmsgs, sigs
+    )
+    dev = [jax.device_put(a) for a in (ax, ay, r_bytes, s_bits, h_bits)]
+    np.asarray(ed25519_verify_kernel(*dev, backend="vpu"))  # warm
+    start = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = ed25519_verify_kernel(*dev, backend="vpu")
+    np.asarray(out)
+    ver_ms = (time.perf_counter() - start) / reps * 1e3
+    detail["verify_device_resident_1024_ms"] = round(ver_ms, 2)
+    detail["verify_device_resident_per_s"] = round(
+        verify_batch / (ver_ms / 1e3), 1
+    )
+    ed_int_ops = 280e9  # int-MACs per 1024-batch (docs/PERFORMANCE.md S2)
+    detail["verify_device_int_ops_per_s"] = round(ed_int_ops / (ver_ms / 1e3))
+    detail["verify_pct_of_chip_int8_peak"] = round(
+        100 * ed_int_ops / (ver_ms / 1e3) / 394e12, 3
+    )
 
 
 def measure_tunnel_rtt():
@@ -606,6 +709,10 @@ def main():
         detail["tunnel_rtt_ms"] = round(measure_tunnel_rtt() * 1e3, 1)
     except Exception:
         detail["tunnel_rtt_ms"] = None
+    try:
+        bench_device_resident(detail)
+    except Exception as exc:
+        detail["device_resident_error"] = f"{type(exc).__name__}: {exc}"[:160]
     try:
         per_s, piped, sync = bench_tpu_hash_kernel()
         detail["tpu_hashes_per_s"] = round(per_s, 1)

@@ -5943,10 +5943,13 @@ struct Engine {
     // wrapper has not supplied yet?  Fills need_hash_content /
     // need_verdicts when so.  Consumes nothing; the simulated schedule is
     // independent of the pause.
+    i64 ready_head_ctr = -1;  // head already vetted (pause/resume path)
+
     bool check_ready() {
         if (!device_hash_mode && !streaming_auth_mode) return true;
         if (queue.heap.empty()) return true;
         const SimEv &head = queue.heap.front();
+        if (head.ctr == ready_head_ctr) return true;
         need_hash_content.clear();
         need_verdicts.clear();
         if (device_hash_mode && head.kind == SK::ProcessHash) {
@@ -5974,7 +5977,9 @@ struct Engine {
                     need_verdicts.emplace_back(head.client, need_to);
             }
         }
-        return need_hash_content.empty() && need_verdicts.empty();
+        bool ready = need_hash_content.empty() && need_verdicts.empty();
+        if (ready) ready_head_ctr = head.ctr;
+        return ready;
     }
     bool drained() const {
         return nodes_not_ready == 0 && clients_unsatisfied == 0;

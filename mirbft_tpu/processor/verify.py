@@ -95,9 +95,18 @@ class RequestAuthenticator:
             del self._memo[key]
 
     def authenticate_batch(
-        self, items: Sequence[Tuple[int, int, bytes]]
+        self,
+        items: Sequence[Tuple[int, int, bytes]],
+        memoize: bool = False,
     ) -> np.ndarray:
-        """items: (client_id, req_no, envelope) triples -> bool per item."""
+        """items: (client_id, req_no, envelope) triples -> bool per item.
+
+        ``memoize=True`` records each verdict in the per-envelope memo, so
+        an embedder can verify a whole ingress window in ONE device
+        dispatch and have the scalar ``authenticate`` gate (the propose
+        path) serve from it — the bulk-verify-then-propose pattern of the
+        async crypto plane.  The memo pins the envelope objects; verdicts
+        apply only to the exact objects passed here."""
         if not items:
             return np.zeros(0, dtype=bool)
         ok = np.zeros(len(items), dtype=bool)
@@ -122,6 +131,13 @@ class RequestAuthenticator:
             self.verified_count += len(rows)
             for row, verdict in zip(rows, verdicts):
                 ok[row] = bool(verdict)
+        if memoize:
+            for i, (client_id, req_no, envelope) in enumerate(items):
+                if len(self._memo) >= self._MEMO_CAP:
+                    self._memo.clear()
+                self._memo[(client_id, req_no, id(envelope))] = (
+                    envelope, bool(ok[i])
+                )
         return ok
 
     def authenticate(self, client_id: int, req_no: int, envelope: bytes) -> bool:

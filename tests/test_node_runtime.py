@@ -106,7 +106,8 @@ class CountingApp:
 
 
 def _run_stress_cluster(
-    tmp_path, node_count, reqs, envelope_factory, authenticator_factory=None
+    tmp_path, node_count, reqs, envelope_factory, authenticator_factory=None,
+    hasher_factory=None,
 ):
     """Shared tier-4 stress scaffolding: build a real-thread cluster on
     durable stores, propose ``reqs`` envelopes from client 0 to every node,
@@ -124,7 +125,7 @@ def _run_stress_cluster(
             Config(id=i, batch_size=1),
             ProcessorConfig(
                 link=transport.link(i),
-                hasher=CpuHasher(),
+                hasher=hasher_factory() if hasher_factory else CpuHasher(),
                 app=app,
                 wal=WAL(str(tmp_path / f"wal-{i}")),
                 request_store=Store(str(tmp_path / f"reqs-{i}.db")),
@@ -325,5 +326,83 @@ def test_stressy_signed_requests(tmp_path):
         forged = seal(b"forged", b"\x11" * 64)
         with pytest.raises(AuthenticationError):
             nodes[0].client(0).propose(reqs, forged)
+    finally:
+        stop()
+
+
+def test_stressy_device_crypto(tmp_path):
+    """Tier-4 stress with DEVICE crypto on the real (L3 threaded) runtime
+    (reference mirbft.go:282 doHashWork): every node's hash worker — the
+    async hash plane — dispatches its batches through the TPU hasher, and
+    signed-request verdicts come from bulk device verification whose
+    memoized verdicts serve the propose-time ingress gate.  Crypto work is
+    metered (dispatch seconds + verified counts) and a forged envelope is
+    rejected on the device path."""
+    import hashlib
+
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from mirbft_tpu import metrics
+    from mirbft_tpu.node import AuthenticationError
+    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier
+    from mirbft_tpu.ops.sha256 import TpuHasher
+    from mirbft_tpu.processor.verify import (
+        RequestAuthenticator,
+        seal,
+        signing_payload,
+    )
+
+    metrics.default_registry.reset()
+    reqs = 10
+    key = Ed25519PrivateKey.from_private_bytes(
+        hashlib.sha256(b"stressy-device-client-0").digest()
+    )
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    envelopes = []
+    for req_no in range(reqs):
+        payload = b"device-req-%d" % req_no
+        envelopes.append(
+            seal(payload, key.sign(signing_payload(0, req_no, payload)))
+        )
+    forged = seal(b"forged", b"\x22" * 64)
+
+    authenticators = []
+
+    def authenticator():
+        auth = RequestAuthenticator(
+            verifier=Ed25519BatchVerifier(min_device_batch=1)
+        )
+        auth.register(0, pub)
+        # Bulk device verification of the whole ingress window in one
+        # dispatch; the propose gate serves from the memoized verdicts.
+        verdicts = auth.authenticate_batch(
+            [(0, r, envelopes[r]) for r in range(reqs)]
+            + [(0, reqs, forged)],
+            memoize=True,
+        )
+        assert verdicts[:reqs].all() and not verdicts[reqs]
+        authenticators.append(auth)
+        return auth
+
+    nodes, _, stop = _run_stress_cluster(
+        tmp_path, 4, reqs, lambda r: envelopes[r],
+        authenticator_factory=authenticator,
+        hasher_factory=lambda: TpuHasher(min_device_batch=1),
+    )
+    try:
+        with pytest.raises(AuthenticationError):
+            nodes[0].client(0).propose(reqs, forged)
+        # Crypto share is metered: the hash plane timed device dispatches,
+        # and every authenticator verified its window on the device path.
+        snap = metrics.snapshot()
+        assert snap.get("hash_dispatch_seconds_count", 0) > 0, snap
+        for auth in authenticators:
+            assert auth.verified_count >= reqs + 1
+            assert auth.dispatch_seconds, "no verify dispatch recorded"
     finally:
         stop()
