@@ -18,7 +18,7 @@
 //
 // Supported envelope (outside it, construction or stepping raises
 // RuntimeError and callers fall back to the Python engine):
-//   * <= 64 nodes (single-word replica bitmasks), dense ids 0..n-1
+//   * <= 256 nodes (4-word replica bitmasks), dense ids 0..n-1
 //   * no manglers, no reconfigurations, no state transfer, no restarts
 //   * signed-request mode via precomputed verdicts (the device auth plane
 //     verifies envelopes; the engine consumes the verdict bitmap)
@@ -299,6 +299,13 @@ struct EpochChangeS {
     i64 new_epoch;
     vector<std::pair<i64, i32>> checkpoints;  // (seq_no, value id)
     vector<ECSetEntryS> p_set, q_set;
+    // Hash-data caches (lazily built; shared by every receiver of the same
+    // broadcast EC object).  At 128+ nodes the hash data spans thousands of
+    // parts per message — rebuilding it per ack was the dominant cost of
+    // cascaded view changes.
+    mutable string hash_joined_cache;  // plain concat (the digest preimage)
+    mutable string hash_key_cache;     // length-prefixed join (memo key)
+    mutable bool hash_cache_done = false;
 };
 using EpochChangeP = shared_ptr<const EpochChangeS>;
 
@@ -843,10 +850,47 @@ void concat(Actions &into, Actions &&from) {
     for (auto &a : from) into.push_back(std::move(a));
 }
 
-vector<i32> mask_to_nodes(u64 mask) {
+// Replica bitmask over up to 256 nodes (4 u64 words; BASELINE config 5 is
+// a 256-replica network).  Bit index == dense node id.
+struct Mask {
+    u64 w[4] = {0, 0, 0, 0};
+    bool test(i64 i) const {
+        return (w[(size_t)(i >> 6)] >> (i & 63)) & 1;
+    }
+    void set(i64 i) { w[(size_t)(i >> 6)] |= 1ull << (i & 63); }
+    void clearbit(i64 i) { w[(size_t)(i >> 6)] &= ~(1ull << (i & 63)); }
+    i64 count() const {
+        return __builtin_popcountll(w[0]) + __builtin_popcountll(w[1]) +
+               __builtin_popcountll(w[2]) + __builtin_popcountll(w[3]);
+    }
+    bool any() const { return (w[0] | w[1] | w[2] | w[3]) != 0; }
+    bool operator==(const Mask &o) const {
+        return w[0] == o.w[0] && w[1] == o.w[1] && w[2] == o.w[2] &&
+               w[3] == o.w[3];
+    }
+    bool operator!=(const Mask &o) const { return !(*this == o); }
+};
+
+// Fill both EC hash caches in one pass (see EpochChangeS).
+void ec_fill_hash_cache(const Interner &in, const EpochChangeS &ec) {
+    if (ec.hash_cache_done) return;
+    vector<string> parts = ec_hash_data(in, ec);
+    size_t total = 0;
+    for (const auto &p : parts) total += p.size();
+    ec.hash_joined_cache.reserve(total);
+    ec.hash_key_cache.reserve(total + parts.size() * 9);
+    for (const auto &p : parts) {
+        ec.hash_joined_cache.append(p);
+        enc_uv(ec.hash_key_cache, (u64)p.size());
+        ec.hash_key_cache.append(p);
+    }
+    ec.hash_cache_done = true;
+}
+
+vector<i32> mask_to_nodes(const Mask &mask) {
     vector<i32> out;
-    for (i32 i = 0; i < 64; i++)
-        if ((mask >> i) & 1) out.push_back(i);
+    for (i32 i = 0; i < 256; i++)
+        if (mask.test(i)) out.push_back(i);
     return out;
 }
 
@@ -1044,41 +1088,57 @@ struct MsgBuffer {
         nb->total_size += size;
     }
 
+    // next/iterate compact the deque in ONE pass instead of erasing from
+    // the middle per removed entry: erase-at-i on a deque is O(n), which
+    // turned big-buffer drains (cascading view changes buffer enormous
+    // message piles) into O(n^2) wall time.  Kept entries preserve their
+    // relative order and apply_fn-appended entries are still visited, so
+    // behavior is identical to the erase-based loop.
     template <typename F>
     MsgP next(F &&filter_fn) {
+        size_t kept = 0;
+        MsgP found;
         size_t i = 0;
-        while (i < buffer.size()) {
+        for (; i < buffer.size(); i++) {
             MsgP msg = buffer[i].first;
             i64 size = buffer[i].second;
             Applyable verdict = filter_fn(*msg);
             if (verdict == Applyable::FUTURE) {
-                i++;
+                if (kept != i) buffer[kept] = std::move(buffer[i]);
+                kept++;
                 continue;
             }
-            buffer.erase(buffer.begin() + (std::ptrdiff_t)i);
             if (group) (*group)--;
             nb->total_size -= size;
-            if (verdict == Applyable::CURRENT) return msg;
+            if (verdict == Applyable::CURRENT) {
+                found = std::move(msg);
+                i++;
+                break;
+            }
         }
-        return nullptr;
+        for (; i < buffer.size(); i++, kept++)
+            if (kept != i) buffer[kept] = std::move(buffer[i]);
+        buffer.resize(kept);
+        return found;
     }
 
     template <typename F, typename A>
     void iterate(F &&filter_fn, A &&apply_fn) {
-        size_t i = 0;
-        while (i < buffer.size()) {
+        size_t kept = 0;
+        for (size_t i = 0; i < buffer.size(); i++) {
             MsgP msg = buffer[i].first;
             i64 size = buffer[i].second;
             Applyable verdict = filter_fn(*msg);
             if (verdict == Applyable::FUTURE) {
-                i++;
+                if (kept != i) buffer[kept] = std::move(buffer[i]);
+                kept++;
                 continue;
             }
-            buffer.erase(buffer.begin() + (std::ptrdiff_t)i);
             if (group) (*group)--;
             nb->total_size -= size;
-            if (verdict == Applyable::CURRENT) apply_fn(msg);
+            if (verdict == Applyable::CURRENT) apply_fn(std::move(msg));
         }
+        buffer.resize(kept);
     }
 
     bool empty() const { return buffer.empty(); }
@@ -1557,7 +1617,7 @@ struct LedView {
 
 struct CanonDig {
     i32 dig;
-    u64 mask = 0;
+    Mask mask;
     // (stream position, source) per added bit, in canonical order.
     vector<std::pair<u32, u8>> add_log;
 
@@ -1570,10 +1630,10 @@ struct CanonDig {
 
 struct CanonRec {
     i64 req_no;
-    u64 non_null = 0;
+    Mask non_null;
     vector<std::pair<u32, u8>> nn_log;  // (position, source) per non-null bit
     vector<CanonDig> digs;              // canonical first-sight order
-    u64 diverged = 0;                   // receivers on private record state
+    Mask diverged;                      // receivers on private record state
 
     CanonDig *find(i32 dig) {
         for (auto &d : digs)
@@ -1644,7 +1704,7 @@ struct AckLedger {
             if (it == min_lw.end()) continue;
             CanonClient &cc = pr.second;
             while (cc.base >= 0 && cc.base < it->second && !cc.recs.empty() &&
-                   cc.recs.front().diverged == 0) {
+                   !cc.recs.front().diverged.any()) {
                 cc.recs.pop_front();
                 cc.base += 1;
             }
@@ -1666,7 +1726,6 @@ struct AckLedger {
         reg.min_any = INT64_MAX;
         reg.max_any = INT64_MIN;
         const vector<AckS> &acks = m->acks;
-        u64 bit = 1ull << source;
         size_t i = 0;
         while (i < acks.size()) {
             i64 client_id = acks[i].client;
@@ -1688,36 +1747,36 @@ struct AckLedger {
                 t.dig = a.dig;
                 t.post = 0;
                 t.candidate = false;
-                if (a.dig != 0 && (R.non_null & bit)) {
+                if (a.dig != 0 && R.non_null.test(source)) {
                     // Source already voted non-null: only a same-digest
                     // revote proceeds (as a DUP); otherwise the vote is
                     // rejected (at most creating an empty candidate entry).
                     CanonDig *ex = R.find(a.dig);
-                    if (!ex || !(ex->mask & bit)) {
+                    if (!ex || !ex->mask.test(source)) {
                         if (!ex) R.digs.push_back(CanonDig{a.dig});
                         t.kind = 2;  // REJECT: no receiver-visible effect
                     } else {
                         t.kind = 1;  // DUP
-                        t.post = (u32)__builtin_popcountll(ex->mask);
+                        t.post = (u32)ex->mask.count();
                         t.candidate = is_candidate_count((i64)t.post);
                     }
                 } else {
                     if (a.dig != 0) {
-                        if (!(R.non_null & bit)) {
-                            R.non_null |= bit;
+                        if (!R.non_null.test(source)) {
+                            R.non_null.set(source);
                             R.nn_log.emplace_back(reg.pos, (u8)source);
                         }
                     }
                     CanonDig &D = R.find_or_create(a.dig);
-                    if (D.mask & bit) {
+                    if (D.mask.test(source)) {
                         t.kind = 1;  // DUP (null revote or same-digest)
-                        t.post = (u32)__builtin_popcountll(D.mask);
+                        t.post = (u32)D.mask.count();
                         t.candidate = is_candidate_count((i64)t.post);
                     } else {
-                        D.mask |= bit;
+                        D.mask.set(source);
                         D.add_log.emplace_back(reg.pos, (u8)source);
                         t.kind = 0;  // NEW
-                        t.post = (u32)__builtin_popcountll(D.mask);
+                        t.post = (u32)D.mask.count();
                         t.candidate = is_candidate_count((i64)t.post);
                     }
                 }
@@ -1740,7 +1799,7 @@ struct AckLedger {
 
 // ---------------------------------------------------------------------------
 // Client request dissemination (statemachine/disseminator.py).
-// Vote masks are single u64 words (engine envelope: <= 64 nodes).
+// Vote masks are 4-word Masks (engine envelope: <= 256 nodes).
 // ---------------------------------------------------------------------------
 
 constexpr i64 CORRECT_FETCH_TICKS = 4;
@@ -1749,7 +1808,7 @@ constexpr i64 ACK_RESEND_TICKS = 20;
 
 struct ClientRequestD {
     AckS ack;
-    u64 agreements = 0;
+    Mask agreements;
     bool stored = false;
     bool fetching = false;
     i64 ticks_fetching = 0;
@@ -1794,7 +1853,7 @@ struct SmallDigMap {
 struct ClientReqNoD {
     i64 client_id, req_no;
     i64 valid_after_seq_no;
-    u64 non_null_voters = 0;
+    Mask non_null_voters;
     SmallDigMap<CRP> requests;         // all observed candidates
     SmallDigMap<CRP> weak_requests;    // correct
     SmallDigMap<CRP> strong_requests;  // proposable
@@ -2056,33 +2115,31 @@ struct ClientD {
     // the record diverged so every later touch goes the classic path.
     void led_ensure_private(ClientReqNoD &crn) {
         if (!led_enabled()) return;
-        u64 mybit = 1ull << my_config.id;
         CanonClient &cc = ctx->ack_ledger->client(client_state.id);
         CanonRec &R = cc.rec_or_create(crn.req_no);
-        if (R.diverged & mybit) return;
-        u64 nn = 0;
+        if (R.diverged.test(my_config.id)) return;
+        Mask nn;
         for (const auto &pr : R.nn_log)
-            if (led_view->consumed(pr.first)) nn |= 1ull << pr.second;
+            if (led_view->consumed(pr.first)) nn.set(pr.second);
         crn.non_null_voters = nn;
         for (const auto &D : R.digs) {
             CRP cr = crn.client_req(AckS{crn.client_id, crn.req_no, D.dig});
-            u64 m = 0;
+            Mask m;
             for (const auto &pr : D.add_log)
-                if (led_view->consumed(pr.first)) m |= 1ull << pr.second;
+                if (led_view->consumed(pr.first)) m.set(pr.second);
             cr->agreements = m;
         }
-        R.diverged |= mybit;
+        R.diverged.set(my_config.id);
         led_diverged += 1;
         if (led_diverged_total) *led_diverged_total += 1;
     }
 
     void led_release(i64 req_no) {
         if (!led_enabled()) return;
-        u64 mybit = 1ull << my_config.id;
         CanonClient &cc = ctx->ack_ledger->client(client_state.id);
         CanonRec *R = cc.rec(req_no);
-        if (R && (R->diverged & mybit)) {
-            R->diverged &= ~mybit;
+        if (R && R->diverged.test(my_config.id)) {
+            R->diverged.clearbit(my_config.id);
             led_diverged -= 1;
             if (led_diverged_total) *led_diverged_total -= 1;
         }
@@ -2096,27 +2153,26 @@ struct ClientD {
     // CRPs is orthogonal to alignment (the fast path never touches it).
     void led_try_realign() {
         if (!led_enabled() || led_diverged == 0) return;
-        u64 mybit = 1ull << my_config.id;
         CanonClient &cc = ctx->ack_ledger->client(client_state.id);
         for (const auto &crnp : win) {
             ClientReqNoD &crn = *crnp;
             CanonRec *R = cc.rec(crn.req_no);
-            if (!R || !(R->diverged & mybit)) continue;
-            u64 nn = 0;
+            if (!R || !R->diverged.test(my_config.id)) continue;
+            Mask nn;
             for (const auto &pr : R->nn_log)
-                if (led_view->consumed(pr.first)) nn |= 1ull << pr.second;
+                if (led_view->consumed(pr.first)) nn.set(pr.second);
             if (crn.non_null_voters != nn) continue;
             bool equal = true;
             for (const auto &D : R->digs) {
-                u64 m = 0;
+                Mask m;
                 for (const auto &pr : D.add_log)
-                    if (led_view->consumed(pr.first)) m |= 1ull << pr.second;
+                    if (led_view->consumed(pr.first)) m.set(pr.second);
                 CRP *cr = crn.requests.get(D.dig);
-                u64 actual = cr ? (*cr)->agreements : 0;
+                Mask actual = cr ? (*cr)->agreements : Mask();
                 if (actual != m) { equal = false; break; }
             }
             if (!equal) continue;
-            R->diverged &= ~mybit;
+            R->diverged.clearbit(my_config.id);
             led_diverged -= 1;
             if (led_diverged_total) *led_diverged_total -= 1;
             if (led_diverged == 0) break;
@@ -2175,12 +2231,11 @@ struct ClientD {
     // plus this touch itself.
     void led_own_touch(CanonClient &cc, u32 wave_pos, const WaveTouch &t,
                        const AckS &a, Actions &actions) {
-        u64 mybit = 1ull << my_config.id;
         if (client_state.lw > t.req_no) return;  // PAST
         if (high_watermark < t.req_no)
             throw EngineError("own ack beyond own high watermark");
         CanonRec &R = cc.rec_or_create(t.req_no);
-        if (R.diverged & mybit) {
+        if (R.diverged.test(my_config.id)) {
             ack_into(actions, my_config.id, a, false);
             return;
         }
@@ -2232,7 +2287,6 @@ struct ClientD {
     void led_seg_slow(const WaveSeg &seg, u32 wave_pos,
                       const vector<AckS> &acks, Actions &actions,
                       BufferStore &&buffer_store) {
-        u64 mybit = 1ull << my_config.id;
         CanonClient &cc = *(CanonClient *)seg.canon;
         if (led_diverged == 0) {
             // No private records: only candidates and the FUTURE suffix
@@ -2255,8 +2309,8 @@ struct ClientD {
                     if (t.req_no <= high_watermark) continue;  // unsorted guard
                     buffer_store(seg.ack_start + k);
                     CanonRec &R = cc.rec_or_create(t.req_no);
-                    if (!(R.diverged & mybit)) {
-                        R.diverged |= mybit;
+                    if (!R.diverged.test(my_config.id)) {
+                        R.diverged.set(my_config.id);
                         led_diverged += 1;
                         if (led_diverged_total) *led_diverged_total += 1;
                     }
@@ -2274,15 +2328,15 @@ struct ClientD {
                 // window, so fresh classic state is exact).
                 buffer_store(seg.ack_start + k);
                 CanonRec &R = cc.rec_or_create(t.req_no);
-                if (!(R.diverged & mybit)) {
-                    R.diverged |= mybit;
+                if (!R.diverged.test(my_config.id)) {
+                    R.diverged.set(my_config.id);
                     led_diverged += 1;
                     if (led_diverged_total) *led_diverged_total += 1;
                 }
                 continue;
             }
             CanonRec *R = cc.rec(t.req_no);
-            if (R && (R->diverged & mybit)) {
+            if (R && R->diverged.test(my_config.id)) {
                 ack_into(actions, (i32)seg.src, a, false);
                 continue;
             }
@@ -2299,25 +2353,24 @@ struct ClientD {
         led_ensure_private(*crnp);
         ClientReqNoD &crn = *crnp;
 
-        u64 bit = 1ull << source;
         if (ack.dig != 0 && !force) {
             CRP *existing = crn.requests.get(ack.dig);
             bool already_voted_this =
-                existing && ((*existing)->agreements & bit);
-            if ((crn.non_null_voters & bit) && !already_voted_this)
+                existing && (*existing)->agreements.test(source);
+            if (crn.non_null_voters.test(source) && !already_voted_this)
                 return crn.client_req(ack);
         }
-        if (ack.dig != 0) crn.non_null_voters |= bit;
+        if (ack.dig != 0) crn.non_null_voters.set(source);
 
         CRP cr = crn.client_req(ack);
-        if (source == my_config.id && !(cr->agreements & bit)) {
+        if (source == my_config.id && !cr->agreements.test(source)) {
             bool known = false;
             for (i32 d : crn.self_acked)
                 if (d == ack.dig) known = true;
             if (!known) crn.self_acked.push_back(ack.dig);
         }
-        cr->agreements |= bit;
-        i64 agreement_count = (i64)__builtin_popcountll(cr->agreements);
+        cr->agreements.set(source);
+        i64 agreement_count = cr->agreements.count();
 
         bool newly_correct = agreement_count == weak_quorum;
         if (newly_correct) {
@@ -2355,7 +2408,7 @@ struct ClientD {
             i32 digest = ack.dig;
             ClientReqNoD &crn = *win[(size_t)(req_no - win_base)];
             CRP cr;
-            if (digest != 0 && (crn.non_null_voters & bit)) {
+            if (digest != 0 && crn.non_null_voters.test(source)) {
                 CRP *existing = crn.requests.get(digest);
                 if (!existing) {
                     auto fresh = std::make_shared<ClientRequestD>();
@@ -2363,10 +2416,10 @@ struct ClientD {
                     crn.requests.put(digest, fresh);
                     continue;
                 }
-                if (!((*existing)->agreements & bit)) continue;
+                if (!(*existing)->agreements.test(source)) continue;
                 cr = *existing;
             } else {
-                if (digest != 0) crn.non_null_voters |= bit;
+                if (digest != 0) crn.non_null_voters.set(source);
                 CRP *existing = crn.requests.get(digest);
                 if (existing) {
                     cr = *existing;
@@ -2376,9 +2429,8 @@ struct ClientD {
                     crn.requests.put(digest, cr);
                 }
             }
-            u64 votes = cr->agreements | bit;
-            cr->agreements = votes;
-            i64 count = (i64)__builtin_popcountll(votes);
+            cr->agreements.set(source);
+            i64 count = cr->agreements.count();
             if (count < weak_q) continue;
             bool newly_correct = count == weak_q;
             if (newly_correct) {
@@ -2834,7 +2886,7 @@ struct Disseminator {
         CRNP crn = c->req_no_of(a.reqno);
         c->led_ensure_private(*crn);  // reads agreements (our own bit)
         CRP *data = crn->requests.get(a.dig);
-        if (!data || !(((*data)->agreements >> my_config.id) & 1))
+        if (!data || !(*data)->agreements.test(my_config.id))
             return Actions();
         Actions actions;
         actions.push_back(act_forward({source}, a));
@@ -3213,7 +3265,7 @@ struct Sequence {
     std::unordered_set<AckS, AckHash> outstanding_reqs;
     bool has_outstanding_set = false;
     i32 digest = -1;  // -1 = None
-    u64 prep_mask = 0, commit_mask = 0;
+    Mask prep_mask, commit_mask;
     SmallDigMap<i64> prepares, commits;
     i32 my_prepare_digest = -1;
 
@@ -3303,10 +3355,10 @@ struct Sequence {
 
         if (owner == my_id) {
             for (const auto &cr : client_requests) {
-                u64 agreements = cr->agreements;
+                const Mask &agreements = cr->agreements;
                 vector<i32> missing;
                 for (i32 node : ctx->cfg.nodes)
-                    if (!((agreements >> node) & 1)) missing.push_back(node);
+                    if (!agreements.test(node)) missing.push_back(node);
                 if (!missing.empty())
                     actions.push_back(act_forward(std::move(missing), cr->ack));
             }
@@ -3321,9 +3373,9 @@ struct Sequence {
 
     // apply_prepare_msg (sequence.py:255-291); dig -1 = None.
     Actions apply_prepare_msg(i32 source, i32 dig) {
-        u64 bit = 1ull << source;
-        if ((prep_mask | commit_mask) & bit) return Actions();  // duplicate
-        prep_mask |= bit;
+        if (prep_mask.test(source) || commit_mask.test(source))
+            return Actions();  // duplicate
+        prep_mask.set(source);
         if (source == my_id) my_prepare_digest = dig;
         i32 key = key_of(dig);
         i64 *cnt = prepares.get(key);
@@ -3343,7 +3395,8 @@ struct Sequence {
         i32 my_key = key_of(digest);
         const i64 *cntp = prepares.get(my_key);
         i64 agreements = cntp ? *cntp : 0;
-        if (!(((prep_mask | commit_mask) >> my_id) & 1)) return Actions();
+        if (!prep_mask.test(my_id) && !commit_mask.test(my_id))
+            return Actions();
         i32 my_digest = key_of(my_prepare_digest);
         if (my_digest != my_key) return Actions();
         if (agreements < ctx->iq) return Actions();
@@ -3356,9 +3409,8 @@ struct Sequence {
     }
 
     void apply_commit_msg(i32 source, i32 dig) {
-        u64 bit = 1ull << source;
-        if (commit_mask & bit) return;  // duplicate
-        commit_mask |= bit;
+        if (commit_mask.test(source)) return;  // duplicate
+        commit_mask.set(source);
         i32 key = key_of(dig);
         i64 *cnt = commits.get(key);
         i64 count = cnt ? *cnt + 1 : 1;
@@ -3371,7 +3423,7 @@ struct Sequence {
         i32 my_key = key_of(digest);
         const i64 *cntp = commits.get(my_key);
         i64 agreements = cntp ? *cntp : 0;
-        if (!((commit_mask >> my_id) & 1)) return;
+        if (!commit_mask.test(my_id)) return;
         if (agreements < ctx->iq) return;
         state = SeqState::COMMITTED;
     }
@@ -3716,14 +3768,14 @@ struct ActiveEpoch {
             i64 offset = seq_no - low;
             Sequence &s =
                 *sequences[(size_t)(offset / ci)][(size_t)(offset % ci)];
-            u64 bit = 1ull << source;
             i32 key = s.key_of(m.dig);
             i32 expected = s.key_of(s.digest);
             bool matches = key == expected;
             bool hint = false;
             if (kind == 0) {
-                if ((s.prep_mask | s.commit_mask) & bit) continue;  // dup
-                s.prep_mask |= bit;
+                if (s.prep_mask.test(source) || s.commit_mask.test(source))
+                    continue;  // dup
+                s.prep_mask.set(source);
                 if (source == s.my_id) s.my_prepare_digest = m.dig;
                 i64 *cnt = s.prepares.get(key);
                 i64 n = cnt ? *cnt + 1 : 1;
@@ -3735,8 +3787,8 @@ struct ActiveEpoch {
                     hint = true;
                 }
             } else {
-                if (s.commit_mask & bit) continue;  // dup
-                s.commit_mask |= bit;
+                if (s.commit_mask.test(source)) continue;  // dup
+                s.commit_mask.set(source);
                 i64 *cnt = s.commits.get(key);
                 i64 n = cnt ? *cnt + 1 : 1;
                 s.commits.put(key, n);
@@ -4381,8 +4433,8 @@ struct EpochTarget {
 
     Actions apply_epoch_change_ack_msg(i32 source, i32 origin,
                                        const EpochChangeP &ec) {
-        vector<string> parts = ec_hash_data(ctx->intern, *ec);
-        string key = join_with_lengths(parts);
+        ec_fill_hash_cache(ctx->intern, *ec);
+        const string &key = ec->hash_key_cache;
         auto it = ec_digests.find(key);
         if (it != ec_digests.end()) {
             if (it->second.first != -1)
@@ -4398,13 +4450,22 @@ struct EpochTarget {
         ho.origin = origin;
         ho.ec = ec;
         Actions actions;
-        actions.push_back(act_hash(std::move(parts), std::move(ho)));
+        // Small ECs stay multi-part so the host-floor classification (and
+        // with it the device-plane routing) is unchanged from the
+        // pre-cache behavior; only large certs use the single-part cache.
+        if (ec->hash_joined_cache.size() < 512)
+            actions.push_back(act_hash(ec_hash_data(ctx->intern, *ec),
+                                       std::move(ho)));
+        else
+            actions.push_back(act_hash(vector<string>{ec->hash_joined_cache},
+                                       std::move(ho)));
         return actions;
     }
 
     Actions apply_epoch_change_digest(const HashOriginS &origin, i32 digest) {
         const EpochChangeP &msg = origin.ec;
-        string key = join_with_lengths(ec_hash_data(ctx->intern, *msg));
+        ec_fill_hash_cache(ctx->intern, *msg);
+        const string &key = msg->hash_key_cache;
         vector<std::pair<i32, i32>> waiters;
         auto it = ec_digests.find(key);
         if (it != ec_digests.end() && it->second.first == -1)
@@ -5659,6 +5720,19 @@ struct Engine {
     // waves during the run instead of one pre-run bitmap.
     bool device_hash_mode = false;
     bool streaming_auth_mode = false;
+    // Structured drop mangler (testengine/manglers.py DropMessages): drop
+    // MsgReceived deliveries matching (from, to); empty set = match any.
+    // The only mangler inside the fast envelope.
+    bool drop_mangler = false;
+    Mask drop_from, drop_to;
+    bool drop_from_any = false, drop_to_any = false;
+
+    bool drop_matches(i32 source, i32 target) const {
+        if (source == target) return false;  // self-links stay reliable
+        if (!drop_from_any && !drop_from.test(source)) return false;
+        if (!drop_to_any && !drop_to.test(target)) return false;
+        return true;
+    }
     std::unordered_map<string, i32> device_digests;  // content -> digest id
     vector<string> need_hash_content;
     vector<std::pair<i64, i64>> need_verdicts;  // (client, verdicts needed up to)
@@ -5816,10 +5890,11 @@ struct Engine {
                                    rn - cc.base < (i64)cc.recs.size();
                  rn++) {
                 CanonRec &R = cc.recs[(size_t)(rn - cc.base)];
-                while (R.diverged != 0) {
-                    int r = __builtin_ctzll(R.diverged);
-                    R.diverged &= R.diverged - 1;
-                    EngineNode &dn = *nodes[(size_t)r];
+                if (!R.diverged.any()) continue;
+                for (size_t r = 0; r < nodes.size(); r++) {
+                    if (!R.diverged.test((i64)r)) continue;
+                    R.diverged.clearbit((i64)r);
+                    EngineNode &dn = *nodes[r];
                     if (!dn.machine || !dn.machine->client_hash_disseminator)
                         continue;
                     ClientD *dc =
@@ -5864,6 +5939,8 @@ struct Engine {
                     e.payload = m;
                     events.push_back(std::move(e));
                 } else {
+                    if (drop_mangler && drop_matches(node.id, replica))
+                        continue;  // mangled away (DropMessages)
                     SimEv ev;
                     ev.time = queue.fake_time + node.runtime.link_latency;
                     ev.kind = SK::MsgReceived;
@@ -6288,8 +6365,9 @@ void engine_dealloc(PyObject *self) {
 
 PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
     PyObject *net_tuple, *client_states, *client_specs, *node_specs;
-    if (!PyArg_ParseTuple(args, "OOOO", &net_tuple, &client_states,
-                          &client_specs, &node_specs))
+    PyObject *mangler = Py_None;
+    if (!PyArg_ParseTuple(args, "OOOO|O", &net_tuple, &client_states,
+                          &client_specs, &node_specs, &mangler))
         return nullptr;
     auto *engine = new Engine();
     try {
@@ -6298,8 +6376,8 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
         engine->ctx.cfg.nb = get_i64(net_tuple, 3);
         engine->ctx.cfg.f = get_i64(net_tuple, 4);
         i64 n_nodes = get_i64(net_tuple, 0);
-        if (n_nodes < 1 || n_nodes > 64)
-            throw EngineError("fastengine supports 1..64 nodes");
+        if (n_nodes < 1 || n_nodes > 256)
+            throw EngineError("fastengine supports 1..256 nodes");
         for (i64 i = 0; i < n_nodes; i++)
             engine->ctx.cfg.nodes.push_back((i32)i);
         engine->ctx.finish_init();
@@ -6393,11 +6471,36 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
             engine->nodes.push_back(std::move(node));
         }
 
+        // Drop mangler descriptor: ("drop", from_nodes, to_nodes).
+        if (mangler != Py_None) {
+            PyRef kind(PySequence_GetItem(mangler, 0));
+            if (!kind) throw EngineError("bad mangler descriptor");
+            engine->drop_mangler = true;
+            PyRef froms(PySequence_GetItem(mangler, 1));
+            PyRef tos(PySequence_GetItem(mangler, 2));
+            if (!froms || !tos) throw EngineError("bad mangler descriptor");
+            Py_ssize_t nf = PySequence_Size(froms.p);
+            Py_ssize_t nt = PySequence_Size(tos.p);
+            auto checked = [n_nodes](i64 id) {
+                if (id < 0 || id >= n_nodes)
+                    throw EngineError("mangler node id out of range");
+                return id;
+            };
+            if (nf == 0) engine->drop_from_any = true;
+            for (Py_ssize_t i = 0; i < nf; i++)
+                engine->drop_from.set(checked(get_i64(froms.p, i)));
+            if (nt == 0) engine->drop_to_any = true;
+            for (Py_ssize_t i = 0; i < nt; i++)
+                engine->drop_to.set(checked(get_i64(tos.p, i)));
+        }
+
         // Ack ledger: requires send order == arrival order, i.e. uniform
         // link latency across nodes.  Late-started nodes miss canonical
-        // stream prefixes, so they consume classically.
+        // stream prefixes, so they consume classically — and a drop
+        // mangler breaks every-receiver-sees-every-wave, so it disables
+        // the ledger outright (classic paths handle drops exactly).
         {
-            bool uniform = true;
+            bool uniform = !engine->drop_mangler;
             for (const auto &node : engine->nodes)
                 if (node->runtime.link_latency !=
                     engine->nodes[0]->runtime.link_latency)

@@ -120,43 +120,56 @@ class MsgBuffer:
             except ValueError:
                 pass
 
+    # next/iterate compact the deque in ONE pass instead of deleting from
+    # the middle per removed entry: ``del deque[i]`` is O(n), which turned
+    # big-buffer drains (cascading view changes buffer enormous message
+    # piles) into O(n^2) wall time.  Kept entries preserve their relative
+    # order and apply_fn-appended entries are still visited, so behavior is
+    # identical to the delete-based loop.
+
     def next(self, filter_fn: FilterFn) -> Optional[Msg]:
         """Pop the first CURRENT message, dropping PAST/INVALID along the way;
         FUTURE messages are skipped in place (reference msgbuffers.go:178-204)."""
-        i = 0
-        while i < len(self.buffer):
-            msg, size = self.buffer[i]
+        buf = self.buffer
+        found = None
+        remaining = len(buf)  # rotation pass: deque indexing is O(n)
+        while remaining:
+            remaining -= 1
+            entry = buf.popleft()
+            if found is not None:
+                buf.append(entry)
+                continue
+            msg, size = entry
             verdict = filter_fn(self.node_buffer.id, msg)
             if verdict == Applyable.FUTURE:
-                i += 1
+                buf.append(entry)
                 continue
-            del self.buffer[i]
             if self.group is not None:
                 self.group[0] -= 1
             self.node_buffer._msg_removed(size)
-            self._deregister_if_empty()
             if verdict == Applyable.CURRENT:
-                return msg
-            # PAST / INVALID: dropped; continue scanning at same index
-        return None
+                found = msg
+        self._deregister_if_empty()
+        return found
 
     def iterate(self, filter_fn: FilterFn, apply_fn: ApplyFn) -> None:
         """Apply every CURRENT message, dropping PAST/INVALID, keeping FUTURE
         (reference msgbuffers.go:206-226)."""
-        i = 0
-        while i < len(self.buffer):
-            msg, size = self.buffer[i]
+        buf = self.buffer
+        remaining = len(buf)  # rotation pass: deque indexing is O(n)
+        while remaining:
+            remaining -= 1
+            msg, size = buf.popleft()
             verdict = filter_fn(self.node_buffer.id, msg)
             if verdict == Applyable.FUTURE:
-                i += 1
+                buf.append((msg, size))
                 continue
-            del self.buffer[i]
             if self.group is not None:
                 self.group[0] -= 1
             self.node_buffer._msg_removed(size)
-            self._deregister_if_empty()
             if verdict == Applyable.CURRENT:
                 apply_fn(self.node_buffer.id, msg)
+        self._deregister_if_empty()
 
     def __len__(self) -> int:
         return len(self.buffer)

@@ -5,7 +5,7 @@ tests consume (``drain_clients``, per-node final state), running the WHOLE
 simulation in C++.  It is a bit-identical twin of the Python engine on
 supported configs (see the equivalence contract in fastengine.cpp and
 tests/test_fastengine.py); configs outside the envelope (manglers,
-reconfiguration, state transfer, restarts, >64 nodes) raise
+reconfiguration, state transfer, restarts, >256 nodes) raise
 ``FastEngineUnsupported`` at construction so callers can fall back.
 
 Device crypto in fast runs:
@@ -78,11 +78,23 @@ class FastRecording:
         lookahead waves DURING the run (multiple dispatches overlapping
         consensus) instead of one pre-run pass."""
         _require(_native.load_fast() is not None, "native engine unavailable")
-        _require(1 <= spec.node_count <= 64, ">64 nodes")
+        _require(1 <= spec.node_count <= 256, ">256 nodes")
         if device_authoritative or streaming_auth:
             _require(device, "device modes require device=True")
         recorder = spec.recorder()
-        _require(recorder.mangler is None, "manglers")
+        from .manglers import DropMessages
+
+        mangler_desc = None
+        if recorder.mangler is not None:
+            _require(
+                isinstance(recorder.mangler, DropMessages),
+                "manglers (only DropMessages is in the fast envelope)",
+            )
+            mangler_desc = (
+                "drop",
+                tuple(recorder.mangler.from_nodes),
+                tuple(recorder.mangler.to_nodes),
+            )
         _require(not recorder.reconfig_points, "reconfiguration")
         _require(recorder.event_log_writer is None, "event log interception")
         # defer_unready makes the Python engine's step counts wall-clock
@@ -175,7 +187,7 @@ class FastRecording:
         self._engine = _native.fast.FastEngine(
             (spec.node_count, net.checkpoint_interval, net.max_epoch_length,
              net.number_of_buckets, net.f),
-            client_states, client_specs, node_specs,
+            client_states, client_specs, node_specs, mangler_desc,
         )
         if device_authoritative or streaming_auth:
             self._engine.set_device_modes(

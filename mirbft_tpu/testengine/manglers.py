@@ -247,6 +247,37 @@ class _Mangling:
         return self.do(action)
 
 
+@dataclass(frozen=True)
+class DropMessages:
+    """Structured unconditional drop mangler.
+
+    Equivalent to ``For(matching.msgs().from_nodes(*from_nodes)
+    [.to_nodes(*to_nodes)]).drop()`` (empty set = match any), but
+    introspectable — the native fast engine recognizes it and applies the
+    same drop at its queue, making it the one mangler inside the fast
+    envelope (BASELINE config 4's silenced-leader scenario).  Self-links
+    stay reliable, matching the ``from_nodes`` matcher."""
+
+    from_nodes: tuple = ()
+    to_nodes: tuple = ()
+
+    def matches(self, source: int, target: int) -> bool:
+        if source == target:
+            return False
+        if self.from_nodes and source not in self.from_nodes:
+            return False
+        if self.to_nodes and target not in self.to_nodes:
+            return False
+        return True
+
+    def mangle(self, random: int, event: SimEvent) -> List[MangleResult]:
+        if event.msg_received is None:
+            return [MangleResult(event)]
+        if self.matches(event.msg_received[0], event.target):
+            return []
+        return [MangleResult(event)]
+
+
 def For(matcher: Conditional) -> _Mangling:
     """Apply whenever the condition matches (reference manglers.go:74-79)."""
     return _Mangling(matcher)

@@ -170,6 +170,48 @@ def test_byzantine_signer_rejected():
         assert c.get(1, 0) == 0  # byzantine client never commits
 
 
+def test_drop_mangler_silenced_node_bit_identical():
+    """The structured DropMessages mangler is the one mangler inside the
+    fast envelope (BASELINE config 4's silenced-leader shape): all messages
+    FROM node 0 are dropped, the network suspects it and changes epochs,
+    and the engines must stay bit-identical through the whole failure
+    path — including a 128-node shape at reduced request count."""
+    from mirbft_tpu.testengine.manglers import DropMessages
+
+    def silence(r):
+        r.mangler = DropMessages(from_nodes=(0,))
+
+    spec = Spec(node_count=4, client_count=4, reqs_per_client=10, batch_size=2,
+                tweak_recorder=silence)
+    steps_py, time_py, state_py = _python_run(spec, timeout=30_000_000)
+    steps_fast, time_fast, state_fast = _fast_run(spec, timeout=30_000_000)
+    assert (steps_fast, time_fast) == (steps_py, time_py)
+    assert state_fast == state_py
+    assert any(node[2] > 0 for node in state_fast), "expected an epoch change"
+
+    def silence_wan(r):
+        for nc in r.node_configs:
+            nc.runtime_parms.link_latency = 1000
+        r.mangler = DropMessages(from_nodes=(0,))
+
+    spec = Spec(node_count=128, client_count=4, reqs_per_client=1, batch_size=2,
+                tweak_recorder=silence_wan)
+    steps_py, time_py, state_py = _python_run(spec, timeout=30_000_000)
+    steps_fast, time_fast, state_fast = _fast_run(spec, timeout=30_000_000)
+    assert (steps_fast, time_fast) == (steps_py, time_py)
+    assert state_fast == state_py
+
+
+def test_256_replica_bit_identical():
+    """The config-5 scale (256 replicas; 4-word masks) at tiny request
+    count: full-evolution bit-identity beyond the one-word mask range."""
+    spec = Spec(node_count=96, client_count=2, reqs_per_client=2, batch_size=2)
+    steps_py, time_py, state_py = _python_run(spec, timeout=100_000_000)
+    steps_fast, time_fast, state_fast = _fast_run(spec, timeout=100_000_000)
+    assert (steps_fast, time_fast) == (steps_py, time_py)
+    assert state_fast == state_py
+
+
 def test_device_authoritative_hashing_bit_identical():
     """With device_authoritative=True the TPU (CPU backend under the test
     harness) is the producer of every wave-eligible protocol digest; the
@@ -220,7 +262,7 @@ def test_streaming_auth_matches_bitmap_mode():
 
 
 def test_unsupported_configs_raise():
-    spec = Spec(node_count=65, client_count=1, reqs_per_client=1)
+    spec = Spec(node_count=257, client_count=1, reqs_per_client=1)
     with pytest.raises(FastEngineUnsupported):
         FastRecording(spec)
 
