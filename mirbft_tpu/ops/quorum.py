@@ -1,0 +1,146 @@
+"""Device-resident quorum plane: ack/vote accumulation as tensor ops.
+
+The TPU-first redesign of the protocol's hot loop (round-3 verdict item 2;
+it generalizes the reference's own parallelism hook — reference
+``mirbft.go:470`` ``doHashWork // TODO, spawn more of these`` — beyond
+crypto): the cluster-wide canonical ack state that the native engine's
+AckLedger accumulates per broadcast wave (``_native/fastengine.cpp``
+``AckLedger::register_msg``) is here a set of fixed-shape integer tensors,
+
+    masks  [W, D, 8]  uint32 — per (req-slot, digest-slot) 256-bit replica
+                               bitmask, one u32 word per 32 replicas
+    counts [W, D]     int32  — popcounts of the masks
+
+and one broadcast wave is a padded touch tensor ``[K, 2]`` of
+(req-slot, digest-slot) rows plus its source replica id.  ``accumulate``
+scatter-ORs the source bit, recounts, and returns the per-touch post-counts
+— exactly the ``WaveTouch.post`` values the ledger's receivers replay — so
+quorum crossings fall out as ``post ∈ {wq-1, wq, sq-1, sq}`` comparisons.
+A whole SEQUENCE of waves runs in one dispatch via ``lax.scan`` (the
+"pack waves into fixed-shape tensors" formulation), so the tunnel cost
+amortizes over the stream.
+
+``host_accumulate`` is the numpy reference implementation used for
+differential testing and for the honest A/B in ``bench.py`` /
+``docs/PERFORMANCE.md``: on this rig the C++ ledger registers a touch in
+~40 cycles on host, so the device plane must win on throughput per wave
+stream, not per touch — the bench records both sides.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+MASK_WORDS = 8  # 256 replicas
+
+
+def pack_wave_stream(
+    waves: Sequence[Tuple[int, Sequence[Tuple[int, int]]]], k: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack [(source, [(req_slot, dig_slot), ...]), ...] into fixed shapes:
+    returns (sources [N], touches [N, K, 2], valid [N, K]) with touch rows
+    padded to ``k`` per wave (a wave with more than ``k`` touches must be
+    split by the caller)."""
+    n = len(waves)
+    sources = np.zeros(n, dtype=np.int32)
+    touches = np.zeros((n, k, 2), dtype=np.int32)
+    valid = np.zeros((n, k), dtype=bool)
+    for i, (source, rows) in enumerate(waves):
+        if len(rows) > k:
+            raise ValueError(f"wave {i} exceeds K={k} touches")
+        if len(set(rows)) != len(rows):
+            # The kernel reads all old words before writing (vectorized), so
+            # duplicate rows would double-count; the ledger never emits them
+            # (one ack per (req_no, digest) per wave).
+            raise ValueError(f"wave {i} has duplicate touch rows")
+        sources[i] = source
+        for j, (w, d) in enumerate(rows):
+            touches[i, j, 0] = w
+            touches[i, j, 1] = d
+            valid[i, j] = True
+    return sources, touches, valid
+
+
+def _kernel(masks, counts, sources, touches, valid):
+    import jax
+    import jax.numpy as jnp
+
+    def one_wave(carry, wave):
+        masks, counts = carry
+        source, touch, ok = wave
+        word = source // 32
+        bit = jnp.uint32(1) << jnp.uint32(source % 32)
+        w_idx = touch[:, 0]
+        d_idx = touch[:, 1]
+        old_words = masks[w_idx, d_idx, word]
+        # A touch only adds the bit when valid.  Scatter with .max, not
+        # .set: padding rows alias slot (0, 0), and duplicate-index .set
+        # order is undefined — max(old, old|bit) == old|bit is exact since
+        # every row of a wave carries the same single source bit.
+        add = jnp.where(ok, bit, jnp.uint32(0))
+        new_words = old_words | add
+        masks = masks.at[w_idx, d_idx, word].max(new_words)
+        newbit = ok & (old_words & bit == 0)
+        counts = counts.at[w_idx, d_idx].add(newbit.astype(jnp.int32))
+        post = counts[w_idx, d_idx]
+        return (masks, counts), (post, newbit)
+
+    (masks, counts), (posts, newbits) = jax.lax.scan(
+        one_wave, (masks, counts), (sources, touches, valid)
+    )
+    return masks, counts, posts, newbits
+
+
+_jitted_kernel = None
+
+
+def device_accumulate(masks, counts, sources, touches, valid):
+    """One dispatch over a wave stream; returns updated (masks, counts) and
+    per-wave per-touch (post_counts, newbit) arrays.
+
+    Precondition (enforced by pack_wave_stream): no duplicate (slot,
+    digest) rows within one wave — the ledger never emits them (one ack
+    per (req_no, digest) per source per wave), and the vectorized
+    read-all-then-write update would double-count them.
+    """
+    global _jitted_kernel
+    if _jitted_kernel is None:
+        import jax
+
+        # One module-level jit wrapper: a fresh jax.jit(f) per call would
+        # re-trace the scan every invocation and pollute the timed A/B.
+        _jitted_kernel = jax.jit(_kernel)
+    return _jitted_kernel(masks, counts, sources, touches, valid)
+
+
+def host_accumulate(masks, counts, sources, touches, valid):
+    """Numpy reference (also the honest host-side A/B contender)."""
+    masks = masks.copy()
+    counts = counts.copy()
+    n, k, _ = touches.shape
+    posts = np.zeros((n, k), dtype=np.int32)
+    newbits = np.zeros((n, k), dtype=bool)
+    for i in range(n):
+        word = sources[i] // 32
+        bit = np.uint32(1 << (sources[i] % 32))
+        for j in range(k):
+            if not valid[i, j]:
+                posts[i, j] = counts[touches[i, j, 0], touches[i, j, 1]]
+                continue
+            w, d = touches[i, j]
+            if not masks[w, d, word] & bit:
+                masks[w, d, word] |= bit
+                counts[w, d] += 1
+                newbits[i, j] = True
+            posts[i, j] = counts[w, d]
+    return masks, counts, posts, newbits
+
+
+def crossings(posts: np.ndarray, wq: int, sq: int) -> np.ndarray:
+    """Candidate map: touches whose post-count sits at a quorum edge
+    (the ±1 band covers the ledger's own-ack adjustment)."""
+    return (
+        (posts == wq - 1) | (posts == wq) | (posts == sq - 1) | (posts == sq)
+    )

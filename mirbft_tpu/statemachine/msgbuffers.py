@@ -129,46 +129,60 @@ class MsgBuffer:
 
     def next(self, filter_fn: FilterFn) -> Optional[Msg]:
         """Pop the first CURRENT message, dropping PAST/INVALID along the way;
-        FUTURE messages are skipped in place (reference msgbuffers.go:178-204)."""
+        FUTURE messages are skipped in place (reference msgbuffers.go:178-204).
+
+        Rotation pass (deque indexing is O(n)): scanned FUTURE entries are
+        re-appended and, once the CURRENT message is found, the deque is
+        rotated back so order is preserved — a front-resident CURRENT entry
+        costs O(1), keeping consecutive-drain loops linear."""
         buf = self.buffer
+        kept = 0
+        scanned = 0
+        total = len(buf)
         found = None
-        remaining = len(buf)  # rotation pass: deque indexing is O(n)
-        while remaining:
-            remaining -= 1
+        while scanned < total:
+            scanned += 1
             entry = buf.popleft()
-            if found is not None:
-                buf.append(entry)
-                continue
             msg, size = entry
             verdict = filter_fn(self.node_buffer.id, msg)
             if verdict == Applyable.FUTURE:
                 buf.append(entry)
+                kept += 1
                 continue
             if self.group is not None:
                 self.group[0] -= 1
             self.node_buffer._msg_removed(size)
             if verdict == Applyable.CURRENT:
                 found = msg
+                break
+        if kept:
+            buf.rotate(kept)
         self._deregister_if_empty()
         return found
 
     def iterate(self, filter_fn: FilterFn, apply_fn: ApplyFn) -> None:
         """Apply every CURRENT message, dropping PAST/INVALID, keeping FUTURE
-        (reference msgbuffers.go:206-226)."""
+        (reference msgbuffers.go:206-226).
+
+        Single pass draining the deque; kept (FUTURE) entries collect into
+        a side list restored at the end, so entries stored by apply_fn
+        during the pass are drained and visited too — matching the C++
+        twin's compaction loop, which re-reads buffer.size().  Kept
+        originals precede kept apply_fn-appended entries, as in C++."""
         buf = self.buffer
-        remaining = len(buf)  # rotation pass: deque indexing is O(n)
-        while remaining:
-            remaining -= 1
+        kept = []
+        while buf:
             msg, size = buf.popleft()
             verdict = filter_fn(self.node_buffer.id, msg)
             if verdict == Applyable.FUTURE:
-                buf.append((msg, size))
+                kept.append((msg, size))
                 continue
             if self.group is not None:
                 self.group[0] -= 1
             self.node_buffer._msg_removed(size)
             if verdict == Applyable.CURRENT:
                 apply_fn(self.node_buffer.id, msg)
+        buf.extend(kept)
         self._deregister_if_empty()
 
     def __len__(self) -> int:

@@ -202,10 +202,18 @@ def test_drop_mangler_silenced_node_bit_identical():
     assert state_fast == state_py
 
 
-def test_256_replica_bit_identical():
-    """The config-5 scale (256 replicas; 4-word masks) at tiny request
-    count: full-evolution bit-identity beyond the one-word mask range."""
+def test_multiword_mask_bit_identical():
+    """Beyond the one-word (64-replica) mask range: 96 nodes exercise mask
+    word 1, and 132 nodes exercise word 2 (replica ids above 128 — the
+    range BASELINE config 5's 256-replica network lives in), both pinned
+    bit-identically against the Python engine at tiny request counts."""
     spec = Spec(node_count=96, client_count=2, reqs_per_client=2, batch_size=2)
+    steps_py, time_py, state_py = _python_run(spec, timeout=100_000_000)
+    steps_fast, time_fast, state_fast = _fast_run(spec, timeout=100_000_000)
+    assert (steps_fast, time_fast) == (steps_py, time_py)
+    assert state_fast == state_py
+
+    spec = Spec(node_count=132, client_count=1, reqs_per_client=1, batch_size=1)
     steps_py, time_py, state_py = _python_run(spec, timeout=100_000_000)
     steps_fast, time_fast, state_fast = _fast_run(spec, timeout=100_000_000)
     assert (steps_fast, time_fast) == (steps_py, time_py)

@@ -1,0 +1,85 @@
+"""Device-resident quorum plane (ops/quorum.py): differential equivalence
+against the numpy reference on random wave streams, and crossing-band
+extraction.  Runs on the CPU backend under the test harness; the real-chip
+A/B numbers live in bench.py / docs/PERFORMANCE.md."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mirbft_tpu.ops.quorum import (
+    MASK_WORDS,
+    crossings,
+    device_accumulate,
+    host_accumulate,
+    pack_wave_stream,
+)
+
+
+def random_stream(rng, n_waves, n_nodes, w, d, k):
+    waves = []
+    for _ in range(n_waves):
+        source = int(rng.integers(0, n_nodes))
+        rows = set()
+        for _ in range(int(rng.integers(1, k + 1))):
+            rows.add((int(rng.integers(0, w)), int(rng.integers(0, d))))
+        waves.append((source, sorted(rows)))
+    return waves
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_device_matches_host_reference(seed):
+    rng = np.random.default_rng(seed)
+    w, d, k = 32, 3, 16
+    n_nodes = 256 if seed == 2 else 64  # cover the multi-word range
+    waves = random_stream(rng, n_waves=40, n_nodes=n_nodes, w=w, d=d, k=k)
+    sources, touches, valid = pack_wave_stream(waves, k)
+    masks0 = np.zeros((w, d, MASK_WORDS), dtype=np.uint32)
+    counts0 = np.zeros((w, d), dtype=np.int32)
+
+    hm, hc, hp, hn = host_accumulate(masks0, counts0, sources, touches, valid)
+    dm, dc, dp, dn = device_accumulate(masks0, counts0, sources, touches, valid)
+    np.testing.assert_array_equal(np.asarray(dm), hm)
+    np.testing.assert_array_equal(np.asarray(dc), hc)
+    np.testing.assert_array_equal(np.asarray(dp) * valid, hp * valid)
+    np.testing.assert_array_equal(np.asarray(dn), hn)
+
+    # Resumed stream (second dispatch continues from the carried state).
+    waves2 = random_stream(rng, n_waves=10, n_nodes=n_nodes, w=w, d=d, k=k)
+    s2, t2, v2 = pack_wave_stream(waves2, k)
+    hm2, hc2, hp2, _ = host_accumulate(hm, hc, s2, t2, v2)
+    dm2, dc2, dp2, _ = device_accumulate(dm, dc, s2, t2, v2)
+    np.testing.assert_array_equal(np.asarray(dm2), hm2)
+    np.testing.assert_array_equal(np.asarray(dc2), hc2)
+    np.testing.assert_array_equal(np.asarray(dp2) * v2, hp2 * v2)
+
+
+def test_counts_match_mask_popcounts_and_crossings():
+    rng = np.random.default_rng(7)
+    w, d, k = 16, 2, 8
+    waves = random_stream(rng, n_waves=200, n_nodes=64, w=w, d=d, k=k)
+    sources, touches, valid = pack_wave_stream(waves, k)
+    masks = np.zeros((w, d, MASK_WORDS), dtype=np.uint32)
+    counts = np.zeros((w, d), dtype=np.int32)
+    masks, counts, posts, _ = host_accumulate(
+        masks, counts, sources, touches, valid
+    )
+    pop = np.zeros_like(counts)
+    for word in range(MASK_WORDS):
+        pop += np.vectorize(lambda x: bin(int(x)).count("1"))(
+            masks[:, :, word]
+        ).astype(np.int32)
+    np.testing.assert_array_equal(pop, counts)
+
+    wq, sq = 22, 43
+    band = crossings(posts, wq, sq)
+    expect = np.isin(posts, (wq - 1, wq, sq - 1, sq))
+    np.testing.assert_array_equal(band, expect)
+
+
+def test_pack_rejects_duplicates_and_overflow():
+    with pytest.raises(ValueError):
+        pack_wave_stream([(0, [(1, 0), (1, 0)])], k=4)
+    with pytest.raises(ValueError):
+        pack_wave_stream([(0, [(i, 0) for i in range(5)])], k=4)
