@@ -4210,9 +4210,29 @@ struct EpochTarget {
     Disseminator *client_hash_disseminator;
     BatchTracker *batch_tracker;
     InitParms my_config;
-    // content-key -> (digest or -1 pending, waiters (source, origin))
+    // digest state per EC content: (digest | -1 pending | -2 fresh,
+    // waiting (source, origin) pairs).  The content-keyed map is the
+    // source of truth; the pointer cache avoids hashing the multi-KB
+    // content key per ack (EC objects are shared across every receiver of
+    // a broadcast; unordered_map values are node-stable under rehash).
     std::unordered_map<string, std::pair<i32, vector<std::pair<i32, i32>>>>
         ec_digests;
+    std::unordered_map<const void *,
+                       std::pair<i32, vector<std::pair<i32, i32>>> *>
+        ec_entry_by_ptr;
+
+    std::pair<i32, vector<std::pair<i32, i32>>> &ec_entry(
+        const EpochChangeP &ec) {
+        auto pit = ec_entry_by_ptr.find((const void *)ec.get());
+        if (pit != ec_entry_by_ptr.end()) return *pit->second;
+        ec_fill_hash_cache(ctx->intern, *ec);
+        auto [it, inserted] = ec_digests.try_emplace(
+            ec->hash_key_cache,
+            std::make_pair((i32)-2, vector<std::pair<i32, i32>>()));
+        (void)inserted;
+        ec_entry_by_ptr.emplace((const void *)ec.get(), &it->second);
+        return it->second;
+    }
 
     EpochTarget(const Ctx *c, i64 num, PersistedLog *p, NodeBuffers *nbufs,
                 CommitState *cs, ClientTracker *ct, Disseminator *dis,
@@ -4433,17 +4453,14 @@ struct EpochTarget {
 
     Actions apply_epoch_change_ack_msg(i32 source, i32 origin,
                                        const EpochChangeP &ec) {
-        ec_fill_hash_cache(ctx->intern, *ec);
-        const string &key = ec->hash_key_cache;
-        auto it = ec_digests.find(key);
-        if (it != ec_digests.end()) {
-            if (it->second.first != -1)
-                return apply_ec_digest(source, origin, ec, it->second.first);
-            it->second.second.emplace_back(source, origin);
+        auto &entry = ec_entry(ec);
+        if (entry.first >= 0)
+            return apply_ec_digest(source, origin, ec, entry.first);
+        if (entry.first == -1) {  // hash already in flight
+            entry.second.emplace_back(source, origin);
             return Actions();
         }
-        ec_digests.emplace(key,
-                           std::make_pair(-1, vector<std::pair<i32, i32>>()));
+        entry.first = -1;
         HashOriginS ho;
         ho.t = OT::EpochChange;
         ho.source = source;
@@ -4464,13 +4481,11 @@ struct EpochTarget {
 
     Actions apply_epoch_change_digest(const HashOriginS &origin, i32 digest) {
         const EpochChangeP &msg = origin.ec;
-        ec_fill_hash_cache(ctx->intern, *msg);
-        const string &key = msg->hash_key_cache;
+        auto &entry = ec_entry(msg);
         vector<std::pair<i32, i32>> waiters;
-        auto it = ec_digests.find(key);
-        if (it != ec_digests.end() && it->second.first == -1)
-            waiters = std::move(it->second.second);
-        ec_digests[key] = std::make_pair(digest, vector<std::pair<i32, i32>>());
+        if (entry.first == -1) waiters = std::move(entry.second);
+        entry.first = digest;
+        entry.second.clear();
         Actions actions =
             apply_ec_digest(origin.source, origin.origin, msg, digest);
         for (const auto &w : waiters)
@@ -4836,11 +4851,19 @@ struct EpochTracker {
             case MT::Suspect:
                 target->apply_suspect_msg(source);
                 return Actions();
-            case MT::EpochChange:
-                return target->apply_epoch_change_msg(source, msg);
-            case MT::EpochChangeAck:
-                return target->apply_epoch_change_ack_msg(
+            case MT::EpochChange: {
+                u64 _t0 = __rdtsc();
+                Actions _a = target->apply_epoch_change_msg(source, msg);
+                g_parts[4].fetch_add(__rdtsc() - _t0, std::memory_order_relaxed);
+                return _a;
+            }
+            case MT::EpochChangeAck: {
+                u64 _t0 = __rdtsc();
+                Actions _a = target->apply_epoch_change_ack_msg(
                     source, msg->originator, msg->ec);
+                g_parts[5].fetch_add(__rdtsc() - _t0, std::memory_order_relaxed);
+                return _a;
+            }
             case MT::NewEpoch:
                 if (msg->necfg->config.number % (i64)ctx->cfg.nodes.size() !=
                     source)
