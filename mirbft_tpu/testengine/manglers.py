@@ -9,15 +9,36 @@ plain chained predicates.  Usage reads the same::
     For(matching.msgs().from_self()).crash_and_restart_after(100, init_parms)
 
 Filters apply first-to-last; order matters (reference manglers.go:26-34).
+
+Two deliberate divergences from the reference's matcher semantics, both
+consequences of this engine's transport envelopes (the reference delivers
+every message bare; this engine coalesces a node's sends into ``MsgBatch``
+envelopes and its acks into ``AckBatch``, processor/serial.py):
+
+* **Envelope expansion** — message-scoped filters (``of_type``,
+  ``with_sequence``, ``with_epoch``) match a delivered event if ANY message
+  inside its envelope satisfies ALL of them ("the delivery contains a
+  Commit for seq 10").  The action then applies to the WHOLE delivery —
+  dropping an envelope drops everything bundled with the matching message,
+  which is the honest semantics for a transport-level fault.
+* **Ack batching** — ``of_type(AckMsg)`` also matches ``AckBatch`` (the
+  batched transport form of the same traffic), so "drop 70% of acks"
+  scenarios exercise what they did in the reference.
+
+Every predicate carries an introspectable ``kind``/``params`` descriptor so
+the native fast engine can compile a DSL-built mangler into its own
+representation (see fastengine.py) and stay bit-identical to this one.
 """
 
 from __future__ import annotations
 
 import copy
 from dataclasses import dataclass
-from typing import Callable, List, Optional, Sequence, Type
+from typing import Callable, List, Optional, Sequence, Tuple, Type
 
 from ..messages import (
+    AckBatch,
+    AckMsg,
     CheckpointMsg,
     Commit,
     EpochChange,
@@ -25,6 +46,7 @@ from ..messages import (
     FetchBatch,
     ForwardBatch,
     Msg,
+    MsgBatch,
     NewEpoch,
     NewEpochEcho,
     NewEpochReady,
@@ -40,9 +62,6 @@ from .queue import SimEvent
 class MangleResult:
     event: SimEvent
     remangle: bool = False
-
-
-Predicate = Callable[[int, SimEvent], bool]
 
 
 def _msg_epoch(msg: Msg) -> Optional[int]:
@@ -67,24 +86,71 @@ def _msg_seq_no(msg: Msg) -> Optional[int]:
     return None
 
 
+def _expand(msg: Msg):
+    """The delivered message plus, for envelopes, every bundled message."""
+    yield msg
+    if isinstance(msg, MsgBatch):
+        for inner in msg.msgs:
+            yield from _expand(inner)
+
+
+class Predicate:
+    """One introspectable filter predicate.
+
+    ``scope`` is ``"event"`` (fn(random, event) -> bool) or ``"msg"``
+    (fn(msg) -> bool, evaluated per candidate message under envelope
+    expansion).  ``kind``/``params`` describe the predicate for the native
+    engine's mangler compiler."""
+
+    __slots__ = ("kind", "params", "scope", "fn")
+
+    def __init__(self, kind: str, params: tuple, scope: str, fn: Callable):
+        self.kind = kind
+        self.params = params
+        self.scope = scope
+        self.fn = fn
+
+
 class Conditional:
-    """A chainable conjunction of predicates (the reference's ``matching``)."""
+    """A chainable conjunction of predicates (the reference's ``matching``).
+
+    Event-scoped predicates are a plain conjunction over the event.
+    Message-scoped predicates match if any single message in the delivered
+    envelope satisfies all of them (see module docstring)."""
 
     def __init__(self, predicates: Sequence[Predicate]):
         self._predicates = list(predicates)
 
     def matches(self, random: int, event: SimEvent) -> bool:
-        return all(p(random, event) for p in self._predicates)
+        msg_preds: List[Predicate] = []
+        for p in self._predicates:
+            if p.scope == "msg":
+                msg_preds.append(p)
+            elif not p.fn(random, event):
+                return False
+        if not msg_preds:
+            return True
+        if event.msg_received is None:
+            return False
+        return any(
+            all(p.fn(candidate) for p in msg_preds)
+            for candidate in _expand(event.msg_received[1])
+        )
 
-    def _and(self, predicate: Predicate) -> "Conditional":
-        return Conditional(self._predicates + [predicate])
+    def _and(self, kind: str, params: tuple, scope: str, fn) -> "Conditional":
+        return Conditional(
+            self._predicates + [Predicate(kind, params, scope, fn)]
+        )
 
     # --- message-scoped filters ---
 
     def from_self(self) -> "Conditional":
         return self._and(
+            "from_self",
+            (),
+            "event",
             lambda r, e: e.msg_received is not None
-            and e.msg_received[0] == e.target
+            and e.msg_received[0] == e.target,
         )
 
     def from_node(self, node_id: int) -> "Conditional":
@@ -93,46 +159,62 @@ class Conditional:
     def from_nodes(self, *node_ids: int) -> "Conditional":
         # Ignores self-referential messages (links to self must stay reliable).
         return self._and(
+            "from_nodes",
+            tuple(node_ids),
+            "event",
             lambda r, e: e.msg_received is not None
             and e.msg_received[0] != e.target
-            and e.msg_received[0] in node_ids
+            and e.msg_received[0] in node_ids,
         )
 
     def to_node(self, node_id: int) -> "Conditional":
         return self.to_nodes(node_id)
 
     def to_nodes(self, *node_ids: int) -> "Conditional":
-        return self._and(lambda r, e: e.target in node_ids)
+        return self._and(
+            "to_nodes",
+            tuple(node_ids),
+            "event",
+            lambda r, e: e.target in node_ids,
+        )
 
     # synonyms used for startup matching
     for_node = to_node
     for_nodes = to_nodes
 
     def at_percent(self, percent: int) -> "Conditional":
-        return self._and(lambda r, e: r % 100 <= percent)
+        return self._and(
+            "at_percent", (percent,), "event", lambda r, e: r % 100 <= percent
+        )
 
     def with_sequence(self, seq_no: int) -> "Conditional":
         return self._and(
-            lambda r, e: e.msg_received is not None
-            and _msg_seq_no(e.msg_received[1]) == seq_no
+            "with_sequence",
+            (seq_no,),
+            "msg",
+            lambda m: _msg_seq_no(m) == seq_no,
         )
 
     def with_epoch(self, epoch: int) -> "Conditional":
         return self._and(
-            lambda r, e: e.msg_received is not None
-            and _msg_epoch(e.msg_received[1]) == epoch
+            "with_epoch", (epoch,), "msg", lambda m: _msg_epoch(m) == epoch
         )
 
     def of_type(self, *msg_types: Type) -> "Conditional":
-        return self._and(
-            lambda r, e: e.msg_received is not None
-            and isinstance(e.msg_received[1], msg_types)
-        )
+        ack_batched = AckMsg in msg_types
+
+        def fn(m, _types=msg_types, _ab=ack_batched):
+            return isinstance(m, _types) or (_ab and isinstance(m, AckBatch))
+
+        return self._and("of_type", tuple(msg_types), "msg", fn)
 
     def from_client(self, client_id: int) -> "Conditional":
         return self._and(
+            "from_client",
+            (client_id,),
+            "event",
             lambda r, e: e.client_proposal is not None
-            and e.client_proposal[0] == client_id
+            and e.client_proposal[0] == client_id,
         )
 
     # --- terminal constructors (sugar for For(self).X()) ---
@@ -161,15 +243,39 @@ class _MatchingNamespace:
 
     @staticmethod
     def msgs() -> Conditional:
-        return Conditional([lambda r, e: e.msg_received is not None])
+        return Conditional(
+            [
+                Predicate(
+                    "msgs", (), "event", lambda r, e: e.msg_received is not None
+                )
+            ]
+        )
 
     @staticmethod
     def node_startup() -> Conditional:
-        return Conditional([lambda r, e: e.initialize is not None])
+        return Conditional(
+            [
+                Predicate(
+                    "node_startup",
+                    (),
+                    "event",
+                    lambda r, e: e.initialize is not None,
+                )
+            ]
+        )
 
     @staticmethod
     def client_proposal() -> Conditional:
-        return Conditional([lambda r, e: e.client_proposal is not None])
+        return Conditional(
+            [
+                Predicate(
+                    "client_proposal",
+                    (),
+                    "event",
+                    lambda r, e: e.client_proposal is not None,
+                )
+            ]
+        )
 
 
 matching = _MatchingNamespace()
@@ -182,36 +288,67 @@ matching = _MatchingNamespace()
 
 class EventMangling:
     """A conditional mangler: applies ``action`` when the filter matches,
-    passes the event through untouched otherwise."""
+    passes the event through untouched otherwise.
 
-    def __init__(self, filter_: Conditional, action: Callable[[int, SimEvent], List[MangleResult]]):
-        self.filter = filter_
+    ``wrap`` ("for" | "until" | "after") carries the For/Until/After
+    combinator; the latch state lives here so the base ``matcher`` stays a
+    pure introspectable conjunction."""
+
+    def __init__(
+        self,
+        matcher: Conditional,
+        wrap: str,
+        action_kind: str,
+        action_params: tuple,
+        action: Callable[[int, SimEvent], List[MangleResult]],
+    ):
+        self.matcher = matcher
+        self.wrap = wrap
+        self.action_kind = action_kind
+        self.action_params = action_params
         self.action = action
+        self._matched = False  # Until/After latch
+
+    def _applies(self, random: int, event: SimEvent) -> bool:
+        if self.wrap == "for":
+            return self.matcher.matches(random, event)
+        if self.wrap == "until":
+            if self._matched or self.matcher.matches(random, event):
+                self._matched = True
+                return False
+            return True
+        if self.wrap == "after":
+            if self._matched or self.matcher.matches(random, event):
+                self._matched = True
+                return True
+            return False
+        raise AssertionError(f"unknown mangler wrap {self.wrap!r}")
 
     def mangle(self, random: int, event: SimEvent) -> List[MangleResult]:
-        if not self.filter.matches(random, event):
+        if not self._applies(random, event):
             return [MangleResult(event)]
         return self.action(random, event)
 
 
 class _Mangling:
-    """Builder bound to a filter (the reference's ``Mangling``)."""
+    """Builder bound to a filter + combinator (the reference's ``Mangling``)."""
 
-    def __init__(self, filter_: Conditional):
+    def __init__(self, filter_: Conditional, wrap: str = "for"):
         self.filter = filter_
+        self.wrap = wrap
 
-    def do(self, action) -> EventMangling:
-        return EventMangling(self.filter, action)
+    def do(self, action, kind: str = "custom", params: tuple = ()) -> EventMangling:
+        return EventMangling(self.filter, self.wrap, kind, params, action)
 
     def drop(self) -> EventMangling:
-        return self.do(lambda r, e: [])
+        return self.do(lambda r, e: [], kind="drop")
 
     def jitter(self, max_delay: int) -> EventMangling:
         def action(r: int, e: SimEvent) -> List[MangleResult]:
             e.time += r % max_delay
             return [MangleResult(e)]
 
-        return self.do(action)
+        return self.do(action, kind="jitter", params=(max_delay,))
 
     def duplicate(self, max_delay: int) -> EventMangling:
         def action(r: int, e: SimEvent) -> List[MangleResult]:
@@ -219,7 +356,7 @@ class _Mangling:
             clone.time += r % max_delay
             return [MangleResult(e), MangleResult(clone)]
 
-        return self.do(action)
+        return self.do(action, kind="duplicate", params=(max_delay,))
 
     def delay(self, delay: int) -> EventMangling:
         def action(r: int, e: SimEvent) -> List[MangleResult]:
@@ -227,7 +364,7 @@ class _Mangling:
             # remangle: a delayed event may be delayed again on next touch
             return [MangleResult(e, remangle=True)]
 
-        return self.do(action)
+        return self.do(action, kind="delay", params=(delay,))
 
     def crash_and_restart_after(
         self, delay: int, init_parms: EventInitialParameters
@@ -244,7 +381,9 @@ class _Mangling:
                 ),
             ]
 
-        return self.do(action)
+        return self.do(
+            action, kind="crash_and_restart_after", params=(delay, init_parms)
+        )
 
 
 @dataclass(frozen=True)
@@ -252,11 +391,11 @@ class DropMessages:
     """Structured unconditional drop mangler.
 
     Equivalent to ``For(matching.msgs().from_nodes(*from_nodes)
-    [.to_nodes(*to_nodes)]).drop()`` (empty set = match any), but
-    introspectable — the native fast engine recognizes it and applies the
-    same drop at its queue, making it the one mangler inside the fast
-    envelope (BASELINE config 4's silenced-leader scenario).  Self-links
-    stay reliable, matching the ``from_nodes`` matcher."""
+    [.to_nodes(*to_nodes)]).drop()`` (empty set = match any), but applied by
+    the native fast engine at its SEND queue (no per-event RNG draw), making
+    it the cheapest mangler in the fast envelope (BASELINE config 4's
+    silenced-leader scenario).  Self-links stay reliable, matching the
+    ``from_nodes`` matcher."""
 
     from_nodes: tuple = ()
     to_nodes: tuple = ()
@@ -280,31 +419,15 @@ class DropMessages:
 
 def For(matcher: Conditional) -> _Mangling:
     """Apply whenever the condition matches (reference manglers.go:74-79)."""
-    return _Mangling(matcher)
+    return _Mangling(matcher, "for")
 
 
 def Until(matcher: Conditional) -> _Mangling:
     """Apply until the condition first matches (reference manglers.go:41-56)."""
-    state = {"matched": False}
-
-    def predicate(random: int, event: SimEvent) -> bool:
-        if state["matched"] or matcher.matches(random, event):
-            state["matched"] = True
-            return False
-        return True
-
-    return _Mangling(Conditional([predicate]))
+    return _Mangling(matcher, "until")
 
 
 def After(matcher: Conditional) -> _Mangling:
     """Apply only after the condition first matches
     (reference manglers.go:59-71)."""
-    state = {"matched": False}
-
-    def predicate(random: int, event: SimEvent) -> bool:
-        if state["matched"] or matcher.matches(random, event):
-            state["matched"] = True
-            return True
-        return False
-
-    return _Mangling(Conditional([predicate]))
+    return _Mangling(matcher, "after")

@@ -179,15 +179,23 @@ def test_duplication_75_percent():
 def test_crash_and_restart():
     # Node 3 crashes when it sees a Commit for seq 10 and restarts after a
     # delay; it must catch back up (reference integration_test.go crash test).
+    # The delay must land the restart inside the run (this engine's
+    # pipelined proposals finish the whole run in ~6.5k sim units, so the
+    # reference's leisurely crash windows would fire after drain).
     spec = Spec(node_count=4, client_count=4, reqs_per_client=30)
     recorder = spec.recorder()
     init_parms = recorder.node_configs[3].init_parms
     recorder.mangler = For(
         matching.msgs().to_node(3).of_type(Commit).with_sequence(10)
-    ).crash_and_restart_after(5000, init_parms)
+    ).crash_and_restart_after(500, init_parms)
     recording = recorder.recording()
+    restarts = []
+    node3 = recording.nodes[3]
+    orig_initialize = node3.initialize
+    node3.initialize = lambda parms: (restarts.append(1), orig_initialize(parms))[1]
     count = recording.drain_clients(timeout=100000)
     assert_all_nodes_agree(recording)
+    assert len(restarts) > 1, "the crash must actually restart the node mid-run"
 
 
 def test_client_ignores_node_forces_state_transfer():
@@ -356,7 +364,7 @@ def test_reconfig_with_crash_and_restart():
     init_parms = recorder.node_configs[2].init_parms
     recorder.mangler = For(
         matching.msgs().to_node(2).of_type(Commit).with_sequence(40)
-    ).crash_and_restart_after(5000, init_parms)
+    ).crash_and_restart_after(500, init_parms)
     recording = recorder.recording()
     recording.drain_clients(timeout=400000)
     assert_all_nodes_agree(recording)
