@@ -17,11 +17,19 @@
 //   of its Python counterpart (cited by file/class); any divergence is a bug.
 //
 // Supported envelope (outside it, construction or stepping raises
-// RuntimeError and callers fall back to the Python engine):
+// RuntimeError and callers fall back to the Python engine; the single
+// source of truth is docs/FastEngine.md):
 //   * <= 256 nodes (4-word replica bitmasks), dense ids 0..n-1
-//   * no manglers, no reconfigurations, no state transfer, no restarts
+//   * all five DSL mangler actions (drop/jitter/duplicate/delay/
+//     crash-and-restart) under For/Until/After with the full matcher set,
+//     via a CPython-compatible MT19937 stream (PyRandom above), plus the
+//     send-side structured DropMessages
+//   * restarts (crash-and-restart WAL recovery, mid-epoch resume) and
+//     state transfer (incl. app-level failure injection + retry backoff)
 //   * signed-request mode via precomputed verdicts (the device auth plane
 //     verifies envelopes; the engine consumes the verdict bitmap)
+//   * still outside: reconfiguration; device-paced modes combined with a
+//     consume-time (generic) mangler; defer_unready crypto
 //
 // Device crypto: protocol digests are SHA-256 over the same bytes either
 // way, so the engine hashes inline (host) and mirrors every wave-eligible
@@ -72,6 +80,99 @@ using u64 = uint64_t;
 // 3 coalesce): cumulative across all engines — never dangle, safe under
 // concurrent engines (relaxed atomics; profiling only).
 std::atomic<u64> g_parts[6] = {};
+
+// CPython-compatible Mersenne Twister (MT19937, Matsumoto & Nishimura's
+// public reference algorithm with init_by_array seeding — the exact scheme
+// CPython's random.Random uses for int seeds).  The generic manglers draw
+// one 62-bit value per first-touch event consumption, exactly like the
+// Python engine's ``rand.getrandbits(62)`` (testengine/queue.py), so the
+// random streams — and with them jitter/duplicate/percent decisions — are
+// bit-identical across engines.
+struct PyRandom {
+    u32 mt[624];
+    int mti = 625;
+
+    void init_genrand(u32 s) {
+        mt[0] = s;
+        for (mti = 1; mti < 624; mti++)
+            mt[mti] = 1812433253u * (mt[mti - 1] ^ (mt[mti - 1] >> 30)) +
+                      (u32)mti;
+    }
+
+    void init_by_array(const std::vector<u32> &key) {
+        init_genrand(19650218u);
+        int i = 1, j = 0;
+        int k = 624 > (int)key.size() ? 624 : (int)key.size();
+        for (; k; k--) {
+            mt[i] = (mt[i] ^ ((mt[i - 1] ^ (mt[i - 1] >> 30)) * 1664525u)) +
+                    key[(size_t)j] + (u32)j;
+            i++;
+            j++;
+            if (i >= 624) {
+                mt[0] = mt[623];
+                i = 1;
+            }
+            if (j >= (int)key.size()) j = 0;
+        }
+        for (k = 623; k; k--) {
+            mt[i] =
+                (mt[i] ^ ((mt[i - 1] ^ (mt[i - 1] >> 30)) * 1566083941u)) -
+                (u32)i;
+            i++;
+            if (i >= 624) {
+                mt[0] = mt[623];
+                i = 1;
+            }
+        }
+        mt[0] = 0x80000000u;
+        mti = 624;
+    }
+
+    // CPython random_seed(int): the absolute seed split into 32-bit words,
+    // least-significant first; seed 0 keys on [0].
+    void seed_from_u64(u64 n) {
+        std::vector<u32> key;
+        if (n == 0) key.push_back(0);
+        while (n) {
+            key.push_back((u32)(n & 0xffffffffu));
+            n >>= 32;
+        }
+        init_by_array(key);
+    }
+
+    u32 genrand() {
+        static const u32 mag01[2] = {0u, 0x9908b0dfu};
+        u32 y;
+        if (mti >= 624) {
+            int kk;
+            for (kk = 0; kk < 624 - 397; kk++) {
+                y = (mt[kk] & 0x80000000u) | (mt[kk + 1] & 0x7fffffffu);
+                mt[kk] = mt[kk + 397] ^ (y >> 1) ^ mag01[y & 1u];
+            }
+            for (; kk < 623; kk++) {
+                y = (mt[kk] & 0x80000000u) | (mt[kk + 1] & 0x7fffffffu);
+                mt[kk] = mt[kk + (397 - 624)] ^ (y >> 1) ^ mag01[y & 1u];
+            }
+            y = (mt[623] & 0x80000000u) | (mt[0] & 0x7fffffffu);
+            mt[623] = mt[396] ^ (y >> 1) ^ mag01[y & 1u];
+            mti = 0;
+        }
+        y = mt[mti++];
+        y ^= (y >> 11);
+        y ^= (y << 7) & 0x9d2c5680u;
+        y ^= (y << 15) & 0xefc60000u;
+        y ^= (y >> 18);
+        return y;
+    }
+
+    // CPython getrandbits(62): two words, least-significant first, the
+    // second shifted down to its remaining 30 bits.
+    u64 getrandbits62() {
+        u32 lo = genrand();
+        u32 hi = genrand() >> 2;
+        return (u64)lo | ((u64)hi << 32);
+    }
+};
 
 struct EngineError : std::runtime_error {
     explicit EngineError(const string &what) : std::runtime_error(what) {}
@@ -342,13 +443,13 @@ struct QEntryS {
 };
 using QEntryP = shared_ptr<const QEntryS>;
 
-enum class PET : u8 { Q, P, C, N, F, EC, Suspect };
+enum class PET : u8 { Q, P, C, N, F, EC, Suspect, T };
 
 struct PersistEntS {
     PET t;
     QEntryP q;                 // Q
-    i64 seq = 0;               // P / C / N
-    i32 dig = 0;               // P digest / C value
+    i64 seq = 0;               // P / C / N / T
+    i32 dig = 0;               // P digest / C value / T value
     NetStateP netstate;        // C
     EpochCfgS epoch_config;    // N / F
     i64 num = 0;               // EC epoch_number / Suspect epoch
@@ -580,6 +681,7 @@ using HashReqP = shared_ptr<const HashReqS>;
 enum class AT : u8 {
     Send, Hash, Persist, Truncate, Commit, Checkpoint,
     AllocatedRequest, CorrectRequest, ForwardRequest, StateApplied,
+    StateTransfer,  // a = seq_no, b = checkpoint value interner id
 };
 
 using Targets = shared_ptr<const vector<i32>>;
@@ -623,6 +725,8 @@ enum class ET : u8 {
     InitialParameters, LoadPersistedEntry, LoadCompleted,
     HashResult, CheckpointResult, RequestPersisted,
     Step, TickElapsed, ActionsReceived,
+    StateTransferComplete,  // a = seq, digest = value id, payload = netstate
+    StateTransferFailed,    // a = seq, digest = value id
 };
 
 // Same slimming as ActionS: one type-erased payload per event.
@@ -651,6 +755,16 @@ using Events = vector<EventS>;
 // Simulation event queue (testengine/queue.py; no mangler in the envelope).
 // ---------------------------------------------------------------------------
 
+struct InitParms {
+    i32 id;
+    i64 batch_size, heartbeat_ticks, suspect_ticks, new_epoch_timeout_ticks,
+        buffer_size;
+    // This node consumes the ack ledger's canonical streams only if it was
+    // live from the start (a late-started or restarted node misses stream
+    // prefixes).
+    bool led_classic = false;
+};
+
 enum class SK : u8 {
     Initialize, MsgReceived, ClientProposal, Tick,
     ProcessWal, ProcessNet, ProcessHash, ProcessClient, ProcessApp,
@@ -668,6 +782,12 @@ struct SimEv {
     i32 data = 0;                        // payload interner id (proposal)
     shared_ptr<Actions> actions;         // Process{Wal,Net,Hash,Client,App}
     shared_ptr<Events> events;           // Process{ReqStore,Result}
+    // Generic-mangler state: an event already touched by the mangler is
+    // delivered as-is on next pop (the Python engine's _mangled id-pin).
+    bool mangled = false;
+    // Restart parameters carried by a crash-and-restart Initialize event
+    // (null on the genesis Initialize, which uses the node's config).
+    shared_ptr<const InitParms> init;
 };
 
 struct SimEvCmp {
@@ -677,10 +797,178 @@ struct SimEvCmp {
     }
 };
 
+// ---------------------------------------------------------------------------
+// Generic mangler (testengine/manglers.py compiled by fastengine.py): one
+// filter conjunction under a For/Until/After combinator, driving one of the
+// five reference actions.  Message-scoped predicates use the same envelope
+// expansion as the Python DSL (any bundled message satisfying all of them
+// matches; of_type(AckMsg) also matches AckBatch).
+// ---------------------------------------------------------------------------
+
+// Epoch/seq extraction mirrors manglers.py _msg_epoch/_msg_seq_no.
+inline bool mangler_msg_epoch(const MsgS &m, i64 *out) {
+    switch (m.t) {
+        case MT::Preprepare:
+        case MT::Prepare:
+        case MT::Commit:
+        case MT::Suspect:
+            *out = m.epoch;
+            return true;
+        case MT::EpochChange:
+        case MT::EpochChangeAck:
+            *out = m.ec->new_epoch;
+            return true;
+        case MT::NewEpoch:
+        case MT::NewEpochEcho:
+        case MT::NewEpochReady:
+            *out = m.necfg->config.number;
+            return true;
+        default:
+            return false;
+    }
+}
+
+inline bool mangler_msg_seq(const MsgS &m, i64 *out) {
+    switch (m.t) {
+        case MT::Preprepare:
+        case MT::Prepare:
+        case MT::Commit:
+        case MT::Checkpoint:
+        case MT::FetchBatch:
+        case MT::ForwardBatch:
+            *out = m.seq;
+            return true;
+        default:
+            return false;
+    }
+}
+
+struct MPredD {
+    enum K : u8 {
+        Msgs, NodeStartup, ClientProposalEv, FromSelf, FromNodes, ToNodes,
+        AtPercent, WithSequence, WithEpoch, OfType, FromClient,
+    } k;
+    vector<i64> ids;    // FromNodes / ToNodes
+    i64 value = 0;      // AtPercent / WithSequence / WithEpoch / FromClient
+    u32 type_mask = 0;  // OfType: bit per MT value
+
+    bool msg_scoped() const {
+        return k == WithSequence || k == WithEpoch || k == OfType;
+    }
+
+    bool event_match(u64 r, const SimEv &e) const {
+        switch (k) {
+            case Msgs:
+                return e.kind == SK::MsgReceived;
+            case NodeStartup:
+                return e.kind == SK::Initialize;
+            case ClientProposalEv:
+                return e.kind == SK::ClientProposal;
+            case FromSelf:
+                return e.kind == SK::MsgReceived && e.src == e.target;
+            case FromNodes: {
+                if (e.kind != SK::MsgReceived || e.src == e.target)
+                    return false;
+                for (i64 id : ids)
+                    if (id == e.src) return true;
+                return false;
+            }
+            case ToNodes: {
+                for (i64 id : ids)
+                    if (id == e.target) return true;
+                return false;
+            }
+            case AtPercent:
+                return (i64)(r % 100) <= value;
+            case FromClient:
+                return e.kind == SK::ClientProposal && e.client == value;
+            default:
+                throw EngineError("msg-scoped predicate in event position");
+        }
+    }
+
+    bool msg_match(const MsgS &m) const {
+        switch (k) {
+            case WithSequence: {
+                i64 seq;
+                return mangler_msg_seq(m, &seq) && seq == value;
+            }
+            case WithEpoch: {
+                i64 epoch;
+                return mangler_msg_epoch(m, &epoch) && epoch == value;
+            }
+            case OfType: {
+                if (type_mask & (1u << (u32)m.t)) return true;
+                // AckBatch is the batched transport form of AckMsg.
+                return m.t == MT::AckBatch &&
+                       (type_mask & (1u << (u32)MT::AckMsg));
+            }
+            default:
+                throw EngineError("event-scoped predicate in msg position");
+        }
+    }
+};
+
+struct ManglerG {
+    enum W : u8 { WFor, WUntil, WAfter } wrap = WFor;
+    bool latch = false;
+    vector<MPredD> preds;
+    enum A : u8 { Drop, Jitter, Duplicate, Delay, CrashRestart } action;
+    i64 value = 0;  // jitter/duplicate max, delay amount, crash restart delay
+    InitParms restart_parms{};
+    PyRandom rng;
+
+    // Does every msg-scoped predicate hold on some single message in the
+    // envelope (manglers.py Conditional.matches)?
+    bool msg_candidates_match(const MsgS &m,
+                              const vector<const MPredD *> &mp) const {
+        bool all_ok = true;
+        for (const MPredD *p : mp)
+            if (!p->msg_match(m)) {
+                all_ok = false;
+                break;
+            }
+        if (all_ok) return true;
+        if (m.t == MT::MsgBatch)
+            for (const auto &inner : m.inner)
+                if (msg_candidates_match(*inner, mp)) return true;
+        return false;
+    }
+
+    bool base_match(u64 r, const SimEv &e) const {
+        vector<const MPredD *> msg_preds;
+        for (const auto &p : preds) {
+            if (p.msg_scoped()) msg_preds.push_back(&p);
+            else if (!p.event_match(r, e)) return false;
+        }
+        if (msg_preds.empty()) return true;
+        if (e.kind != SK::MsgReceived) return false;
+        return msg_candidates_match(*e.msg, msg_preds);
+    }
+
+    bool applies(u64 r, const SimEv &e) {
+        if (wrap == WFor) return base_match(r, e);
+        if (wrap == WUntil) {
+            if (latch || base_match(r, e)) {
+                latch = true;
+                return false;
+            }
+            return true;
+        }
+        // WAfter
+        if (latch || base_match(r, e)) {
+            latch = true;
+            return true;
+        }
+        return false;
+    }
+};
+
 struct EventQueue {
     vector<SimEv> heap;
     i64 counter = 0;
     i64 fake_time = 0;
+    std::unique_ptr<ManglerG> mangler;  // null = no consume-time mangler
 
     size_t size() const { return heap.size(); }
 
@@ -691,14 +979,71 @@ struct EventQueue {
         std::push_heap(heap.begin(), heap.end(), SimEvCmp());
     }
 
-    SimEv consume() {
-        if (heap.empty())
-            throw EngineError("event queue drained to empty");
+    SimEv pop() {
         std::pop_heap(heap.begin(), heap.end(), SimEvCmp());
         SimEv ev = std::move(heap.back());
         heap.pop_back();
-        fake_time = ev.time;
         return ev;
+    }
+
+    SimEv consume() {
+        // First-touch mangling (testengine/queue.py consume): draw one
+        // random per unmangled pop, apply the mangler, reinsert its results
+        // (each with a fresh FIFO counter — even a pass-through moves to
+        // the back of its timestamp group, exactly like the Python engine),
+        // and loop.  Mangled events are delivered as-is.
+        while (true) {
+            if (heap.empty())
+                throw EngineError("event queue drained to empty");
+            SimEv ev = pop();
+            if (!mangler || ev.mangled) {
+                fake_time = ev.time;
+                return ev;
+            }
+            u64 r = mangler->rng.getrandbits62();
+            if (!mangler->applies(r, ev)) {
+                ev.mangled = true;
+                insert(std::move(ev));
+                continue;
+            }
+            switch (mangler->action) {
+                case ManglerG::Drop:
+                    continue;
+                case ManglerG::Jitter:
+                    ev.time += (i64)(r % (u64)mangler->value);
+                    ev.mangled = true;
+                    insert(std::move(ev));
+                    continue;
+                case ManglerG::Duplicate: {
+                    SimEv clone = ev;  // shallow: payload pointers shared
+                    clone.time += (i64)(r % (u64)mangler->value);
+                    ev.mangled = true;
+                    clone.mangled = true;
+                    insert(std::move(ev));
+                    insert(std::move(clone));
+                    continue;
+                }
+                case ManglerG::Delay:
+                    ev.time += mangler->value;
+                    // remangle: stays unmangled, may be delayed again
+                    insert(std::move(ev));
+                    continue;
+                case ManglerG::CrashRestart: {
+                    i64 when = ev.time + mangler->value;
+                    ev.mangled = true;
+                    insert(std::move(ev));
+                    SimEv restart;
+                    restart.time = when;
+                    restart.kind = SK::Initialize;
+                    restart.target = mangler->restart_parms.id;
+                    restart.init = std::make_shared<const InitParms>(
+                        mangler->restart_parms);
+                    restart.mangled = true;
+                    insert(std::move(restart));
+                    continue;
+                }
+            }
+        }
     }
 
     void remove_events_for(i32 target) {
@@ -772,15 +1117,6 @@ string join_with_lengths(const vector<string> &parts) {
 // Shared engine context.
 // ---------------------------------------------------------------------------
 
-struct InitParms {
-    i32 id;
-    i64 batch_size, heartbeat_ticks, suspect_ticks, new_epoch_timeout_ticks,
-        buffer_size;
-    // This node consumes the ack ledger's canonical streams only if it was
-    // live from the start (a late-started node misses stream prefixes).
-    bool led_classic = false;
-};
-
 struct AckLedger;  // defined below (cluster-shared ack-wave canon)
 
 struct Ctx {
@@ -841,6 +1177,13 @@ ActionS act_forward(vector<i32> targets, AckS ack) {
     ActionS a; a.t = AT::ForwardRequest;
     a.targets = std::make_shared<const vector<i32>>(std::move(targets));
     a.ack = ack; return a;
+}
+ActionS act_state_transfer(i64 seq, i32 value) {
+    ActionS a;
+    a.t = AT::StateTransfer;
+    a.a = seq;
+    a.b = value;
+    return a;
 }
 ActionS act_state_applied(i64 seq, NetStateP ns) {
     ActionS a; a.t = AT::StateApplied; a.a = seq; a.payload = std::move(ns); return a;
@@ -1052,6 +1395,13 @@ PersistEntP pe_f(EpochCfgS cfg) {
 }
 PersistEntP pe_ec(i64 num) {
     auto e = std::make_shared<PersistEntS>(); e->t = PET::EC; e->num = num; return e;
+}
+PersistEntP pe_t(i64 seq, i32 value) {
+    auto e = std::make_shared<PersistEntS>();
+    e->t = PET::T;
+    e->seq = seq;
+    e->dig = value;
+    return e;
 }
 PersistEntP pe_suspect(i64 epoch) {
     auto e = std::make_shared<PersistEntS>(); e->t = PET::Suspect; e->num = epoch; return e;
@@ -3109,11 +3459,20 @@ struct CommitState {
     vector<QEntryP> lower_half_commits, upper_half_commits;
     bool checkpoint_pending = false;
     bool transferring = false;
+    // Failed-transfer retry machinery (commitstate.py:221-229; completes
+    // the reference's open edge, state_machine.go:210-212).
+    i64 transfer_retry_in = 0;
+    i64 transfer_retry_backoff = 0;
+    bool have_retry_target = false;
+    i64 retry_seq = 0;
+    i32 retry_value = 0;
 
     Actions reinitialize() {
-        const PersistEntS *last_c = nullptr;
-        for (const auto &pr : persisted->entries)
+        const PersistEntS *last_c = nullptr, *last_t = nullptr;
+        for (const auto &pr : persisted->entries) {
             if (pr.second->t == PET::C) last_c = pr.second.get();
+            else if (pr.second->t == PET::T) last_t = pr.second.get();
+        }
         if (!last_c) throw EngineError("log must contain a CEntry");
 
         active_state = last_c->netstate;
@@ -3135,12 +3494,52 @@ struct CommitState {
         for (const auto &cs : active_state->clients)
             committing_clients.emplace(cs.id,
                                        CommittingClient(low_watermark, cs));
-        transferring = false;
+
+        transfer_retry_in = 0;
+        transfer_retry_backoff = 0;
+        have_retry_target = false;
+
+        if (!last_t || last_c->seq >= last_t->seq) {
+            transferring = false;
+            return actions;
+        }
+        // Crashed mid-state-transfer: re-issue the transfer request.
+        transferring = true;
+        actions.push_back(act_state_transfer(last_t->seq, last_t->dig));
         return actions;
     }
 
-    Actions transfer_to(i64, i32) {
-        throw EngineError("fastengine: state transfer outside envelope");
+    Actions transfer_to(i64 seq_no, i32 value) {
+        if (transferring)
+            throw EngineError("concurrent state transfers are not supported");
+        transferring = true;
+        Actions actions = persisted->append(pe_t(seq_no, value));
+        actions.push_back(act_state_transfer(seq_no, value));
+        return actions;
+    }
+
+    Actions apply_transfer_failed(i64 seq_no, i32 value) {
+        // Stale failure from before a reinitialization — ignore.
+        if (!transferring) return Actions();
+        transfer_retry_backoff =
+            transfer_retry_backoff == 0
+                ? 1
+                : std::min<i64>(transfer_retry_backoff * 2, 8);
+        transfer_retry_in = transfer_retry_backoff;
+        have_retry_target = true;
+        retry_seq = seq_no;
+        retry_value = value;
+        return Actions();
+    }
+
+    Actions tick() {
+        if (!have_retry_target) return Actions();
+        transfer_retry_in -= 1;
+        if (transfer_retry_in > 0) return Actions();
+        have_retry_target = false;
+        Actions actions;
+        actions.push_back(act_state_transfer(retry_seq, retry_value));
+        return actions;
     }
 
     Actions apply_checkpoint_result(i64 seq_no, i32 value, NetStateP ns) {
@@ -4202,6 +4601,11 @@ struct EpochTarget {
     bool have_leader_choice = false;
     MsgP leader_new_epoch;          // NewEpoch message
     NewEpochCfgP network_new_epoch;
+    // Crash-recovery resume (no Bracha broadcast ran): the epoch config
+    // from the last NEntry, used to rebuild the active epoch at READY
+    // (epoch_target.py resume_epoch_config).
+    EpochCfgS resume_epoch_config{};
+    bool have_resume_config = false;
     bool is_primary;
     std::map<i32, MsgBuffer> prestart_buffers;
     PersistedLog *persisted;
@@ -4294,9 +4698,10 @@ struct EpochTarget {
 
     Actions fetch_new_epoch_state() {
         const NewEpochCfgS &nec = *leader_new_epoch->necfg;
-        if (commit_state->transferring) return Actions();
+        if (commit_state->transferring)
+            return Actions();  // wait for state transfer first
         if (nec.cp_seq > commit_state->highest_commit)
-            throw EngineError("fastengine: state transfer outside envelope");
+            return commit_state->transfer_to(nec.cp_seq, nec.cp_value);
 
         Actions actions;
         bool fetch_pending = false;
@@ -4634,12 +5039,12 @@ struct EpochTarget {
             } else if (state == ETS::RESUMING) {
                 check_epoch_resumed();
             } else if (state == ETS::READY) {
+                if (!network_new_epoch && !have_resume_config)
+                    throw EngineError(
+                        "READY with neither a network config nor a resume config");
                 const EpochCfgS &epoch_config = network_new_epoch
                                                     ? network_new_epoch->config
-                                                    : EpochCfgS{};
-                if (!network_new_epoch)
-                    throw EngineError(
-                        "fastengine: crash-resume epoch outside envelope");
+                                                    : resume_epoch_config;
                 active_epoch = std::make_shared<ActiveEpoch>(
                     ctx, epoch_config, persisted, node_buffers, commit_state,
                     client_tracker, my_config);
@@ -4716,6 +5121,7 @@ struct EpochTracker {
     vector<std::pair<i32, i64>> max_epochs;  // insertion-ordered (source, max)
     i64 max_correct_epoch = 0;
     i64 ticks_out_of_correct_epoch = 0;
+    bool needs_state_transfer = false;  // mirror of epoch_tracker.py's flag
 
     shared_ptr<EpochTarget> new_target(i64 number) {
         return std::make_shared<EpochTarget>(
@@ -4737,11 +5143,19 @@ struct EpochTracker {
         const PersistEntS *last_n = nullptr, *last_f = nullptr;
         bool have_ec = false;
         i64 last_ec_num = 0;
+        i64 highest_preprepared = 0;
         for (const auto &pr : persisted->entries) {
             const PersistEntS &e = *pr.second;
             if (e.t == PET::N) last_n = &e;
             else if (e.t == PET::F) last_f = &e;
             else if (e.t == PET::EC) { have_ec = true; last_ec_num = e.num; }
+            else if (e.t == PET::Q) {
+                if (e.q->seq > highest_preprepared)
+                    highest_preprepared = e.q->seq;
+            } else if (e.t == PET::C) {
+                // After state transfer we may have a CEntry with no QEntry.
+                if (e.seq > highest_preprepared) highest_preprepared = e.seq;
+            }
         }
         if (!last_n && !last_f)
             throw EngineError("no active epoch and no last epoch in log");
@@ -4750,8 +5164,34 @@ struct EpochTracker {
             throw EngineError("new epoch number must exceed last terminated epoch");
 
         if (last_n && (!have_ec || last_ec_num <= last_n->epoch_config.number)) {
-            // Mid-epoch crash-resume: outside the engine envelope.
-            throw EngineError("fastengine: mid-epoch resume outside envelope");
+            // Reinitializing mid-epoch: resume it (and suspect it, since we
+            // may have missed traffic while down) —
+            // epoch_tracker.py:163-181.
+            current_epoch = new_target(last_n->epoch_config.number);
+            i64 starting_seq_no = highest_preprepared + 1;
+            i64 ci = ctx->cfg.ci;
+            while (starting_seq_no % ci != 1) {
+                // Advance to the first sequence after some checkpoint, so
+                // we never re-consent on sequences we already consented on.
+                starting_seq_no += 1;
+                needs_state_transfer = true;
+            }
+            current_epoch->starting_seq_no = starting_seq_no;
+            current_epoch->state = ETS::RESUMING;
+            current_epoch->resume_epoch_config = last_n->epoch_config;
+            current_epoch->have_resume_config = true;
+            concat(actions,
+                   persisted->append(pe_suspect(last_n->epoch_config.number)));
+            actions.push_back(act_send(
+                ctx->bcast, mk_suspect(last_n->epoch_config.number)));
+            for (i32 node : ctx->cfg.nodes) {
+                future_msgs.at(node).iterate(
+                    [this](const MsgS &m) { return filter(m); },
+                    [this, node, &actions](MsgP m) {
+                        concat(actions, apply_msg(node, m));
+                    });
+            }
+            return actions;
         }
         if (last_f && (!have_ec || last_ec_num <= last_f->epoch_config.number)) {
             last_ec_num = last_f->epoch_config.number + 1;
@@ -5097,6 +5537,18 @@ struct Machine {
             } else if (event.t == ET::TickElapsed) {
                 concat(actions, client_hash_disseminator->tick());
                 concat(actions, epoch_tracker->tick());
+                concat(actions, commit_state->tick());
+            } else if (event.t == ET::StateTransferFailed) {
+                concat(actions, commit_state->apply_transfer_failed(
+                                    event.a, event.digest));
+            } else if (event.t == ET::StateTransferComplete) {
+                if (!commit_state->transferring)
+                    throw EngineError(
+                        "state transfer completed but none was requested");
+                concat(actions, persisted->append(
+                                    pe_c(event.a, event.digest,
+                                         event.netstate())));
+                concat(actions, reinitialize());
             } else {
                 throw EngineError("unknown event type");
             }
@@ -5228,6 +5680,7 @@ struct WorkItems {
                     break;
                 case AT::Commit:
                 case AT::Checkpoint:
+                case AT::StateTransfer:
                     app_actions.push_back(std::move(action));
                     break;
                 case AT::AllocatedRequest:
@@ -5297,11 +5750,6 @@ struct SimWAL {
 // still run per replica — only the symmetric computation is shared.
 struct AppChainNode {
     Sha256 hash_state;
-    // Committed-reqs CHANGES at this position vs the predecessor, as
-    // absolute assignments (client -> new value).  Replicas replay deltas
-    // into their own maps as their cursors advance, so the chain retains
-    // O(batch) per position, not O(clients).
-    vector<std::pair<i64, i64>> delta;
     std::unordered_map<u64, i32> next;       // (seq<<32|digest) -> node
     std::unordered_map<i32, i32> snap_next;  // checkpoint value id -> node
     string digest;  // memoized hash_state.digest()
@@ -5323,6 +5771,12 @@ struct AppState {
     string checkpoint_hash;
     NetStateP checkpoint_state;
     std::map<i64, i64> committed_reqs;
+    // State-transfer bookkeeping + app-level failure injection
+    // (testengine/recorder.py NodeState).
+    i64 fail_transfers = 0;
+    vector<i64> state_transfers;
+    vector<i64> transfer_failures;
+    vector<i64> transfer_attempt_times;
 
     const string &active_hash_digest() {
         AppChainNode &cur = chain->nodes[(size_t)chain_id];
@@ -5372,45 +5826,27 @@ struct AppState {
         {
             AppChainNode &cur = chain->nodes[(size_t)chain_id];
             auto it = cur.next.find(key);
-            if (it != cur.next.end()) {
-                nid = it->second;
-            } else {
-                it = cur.next.end();
-                nid = -1;
-            }
+            nid = it != cur.next.end() ? it->second : -1;
         }
         if (nid < 0) {
-            // First replica at this position: compute the transition.  Our
-            // own committed_reqs IS the canonical map here (we followed the
-            // chain to this point), so the delta derives from it.
+            // First replica at this position: compute the hash transition.
             AppChainNode nxt;
             nxt.hash_state = chain->nodes[(size_t)chain_id].hash_state;
-            for (const auto &request : batch.reqs) {
+            for (const auto &request : batch.reqs)
                 nxt.hash_state.update(intern.get(request.dig));
-                auto cit = committed_reqs.find(request.client);
-                i64 prev = cit == committed_reqs.end() ? 0 : cit->second;
-                if (request.reqno + 1 > prev) {
-                    // Within-batch later requests overwrite: keep absolute
-                    // assignments, one per client (last wins).
-                    bool found = false;
-                    for (auto &pr : nxt.delta)
-                        if (pr.first == request.client) {
-                            if (request.reqno + 1 > pr.second)
-                                pr.second = request.reqno + 1;
-                            found = true;
-                            break;
-                        }
-                    if (!found)
-                        nxt.delta.emplace_back(request.client,
-                                               request.reqno + 1);
-                }
-            }
             nid = (i32)chain->nodes.size();
             chain->nodes.push_back(std::move(nxt));
             chain->nodes[(size_t)chain_id].next.emplace(key, nid);
         }
-        for (const auto &pr : chain->nodes[(size_t)nid].delta)
-            committed_reqs[pr.first] = pr.second;
+        // Committed-reqs is per-replica (NOT chain-memoized): a replica
+        // that state-transferred past some commits has lower floors than
+        // one that applied the whole history, so the chain's view of "new
+        // highest" differs per replica around a transfer.  Python computes
+        // this per replica too (NodeState.apply).
+        for (const auto &request : batch.reqs) {
+            i64 &slot = committed_reqs[request.client];
+            if (request.reqno + 1 > slot) slot = request.reqno + 1;
+        }
         chain_id = nid;
     }
 };
@@ -5713,8 +6149,8 @@ struct Engine {
     std::unordered_map<i64, bool> client_satisfied;
     u64 kind_cycles[11] = {0};
     u64 kind_counts[11] = {0};
-    u64 ev_cycles[10] = {0};
-    u64 ev_counts[10] = {0};
+    u64 ev_cycles[12] = {0};
+    u64 ev_counts[12] = {0};
     u64 fix_cycles = 0;  // post-event GC+fixpoint share (inside apply_event)
     u64 crypto_ns = 0;  // host CPU spent hashing (SHA-256) in-engine
     // Wave mirror log: (joined message id, digest id) for wave-eligible
@@ -5818,6 +6254,7 @@ struct Engine {
         node.state.req_store = &node.req_store;
         node.state.chain = &app_chain;
         i32 checkpoint_value = node.state.snap(ctx.intern, init_clients);
+        register_snap(checkpoint_value, node.state);
         auto ns = node.state.checkpoint_state;
         node.wal.entries.clear();
         node.wal.low_index = 1;
@@ -6003,10 +6440,45 @@ struct Engine {
                 note_commits(node, *q);
             } else if (action.t == AT::Checkpoint) {
                 i32 value = node.state.snap(ctx.intern, *action.cstates());
+                register_snap(value, node.state);
                 refresh_node_ready(node);
                 EventS e;
                 e.t = ET::CheckpointResult;
                 e.a = action.a;
+                e.digest = value;
+                e.payload = node.state.checkpoint_state;
+                events.push_back(std::move(e));
+            } else if (action.t == AT::StateTransfer) {
+                // NodeState.transfer_to (testengine/recorder.py:189-206)
+                // with the engine's app-level failure injection.
+                node.state.transfer_attempt_times.push_back(queue.fake_time);
+                i64 seq = action.a;
+                i32 value = (i32)action.b;
+                if (node.state.fail_transfers > 0) {
+                    node.state.fail_transfers -= 1;
+                    node.state.transfer_failures.push_back(seq);
+                    EventS e;
+                    e.t = ET::StateTransferFailed;
+                    e.a = seq;
+                    e.digest = value;
+                    events.push_back(std::move(e));
+                    continue;
+                }
+                auto sit = snap_registry.find(value);
+                if (sit == snap_registry.end())
+                    throw EngineError(
+                        "transfer target value never snapped in this engine");
+                node.state.state_transfers.push_back(seq);
+                node.state.last_seq_no = seq;
+                node.state.checkpoint_seq_no = seq;
+                node.state.checkpoint_state = sit->second.second;
+                node.state.checkpoint_hash =
+                    ctx.intern.get(value).substr(0, 32);
+                node.state.chain_id = sit->second.first;
+                refresh_node_ready(node);
+                EventS e;
+                e.t = ET::StateTransferComplete;
+                e.a = seq;
                 e.digest = value;
                 e.payload = node.state.checkpoint_state;
                 events.push_back(std::move(e));
@@ -6015,6 +6487,17 @@ struct Engine {
             }
         }
         return events;
+    }
+
+    // Checkpoint value id -> (chain node, network state): every value a
+    // state transfer can target was produced by some replica's snap in this
+    // engine, so the app-side decode is a content-addressed lookup.
+    std::unordered_map<i32, std::pair<i32, NetStateP>> snap_registry;
+
+    void register_snap(i32 value, const AppState &state) {
+        snap_registry.emplace(value,
+                              std::make_pair(state.chain_id,
+                                             state.checkpoint_state));
     }
 
     Actions process_state_machine_events(EngineNode &node, Events &&events) {
@@ -6124,6 +6607,15 @@ void Engine::step() {
     switch (event.kind) {
         case SK::Initialize: {
             queue.remove_events_for(node.id);
+            if (event.init) {
+                // Crash-and-restart: reboot under the event's parameters.
+                // The restarted node missed ack-ledger wave prefixes while
+                // down, so it consumes classically from here on.
+                bool classic =
+                    node.init_parms.led_classic || ctx.ack_ledger != nullptr;
+                node.init_parms = *event.init;
+                node.init_parms.led_classic = classic;
+            }
             initialize_node(node);
             {
                 SimEv tick;
@@ -6389,8 +6881,9 @@ void engine_dealloc(PyObject *self) {
 PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
     PyObject *net_tuple, *client_states, *client_specs, *node_specs;
     PyObject *mangler = Py_None;
-    if (!PyArg_ParseTuple(args, "OOOO|O", &net_tuple, &client_states,
-                          &client_specs, &node_specs, &mangler))
+    long long random_seed = 0;
+    if (!PyArg_ParseTuple(args, "OOOO|OL", &net_tuple, &client_states,
+                          &client_specs, &node_specs, &mangler, &random_seed))
         return nullptr;
     auto *engine = new Engine();
     try {
@@ -6494,27 +6987,133 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
             engine->nodes.push_back(std::move(node));
         }
 
-        // Drop mangler descriptor: ("drop", from_nodes, to_nodes).
+        // Mangler descriptor: ("drop", from_nodes, to_nodes) for the
+        // send-side structured DropMessages, or
+        // ("generic", wrap, preds, action, value, restart_parms) for a
+        // compiled DSL mangler (see fastengine.py _compile_mangler).
         if (mangler != Py_None) {
-            PyRef kind(PySequence_GetItem(mangler, 0));
-            if (!kind) throw EngineError("bad mangler descriptor");
-            engine->drop_mangler = true;
-            PyRef froms(PySequence_GetItem(mangler, 1));
-            PyRef tos(PySequence_GetItem(mangler, 2));
-            if (!froms || !tos) throw EngineError("bad mangler descriptor");
-            Py_ssize_t nf = PySequence_Size(froms.p);
-            Py_ssize_t nt = PySequence_Size(tos.p);
-            auto checked = [n_nodes](i64 id) {
-                if (id < 0 || id >= n_nodes)
-                    throw EngineError("mangler node id out of range");
-                return id;
-            };
-            if (nf == 0) engine->drop_from_any = true;
-            for (Py_ssize_t i = 0; i < nf; i++)
-                engine->drop_from.set(checked(get_i64(froms.p, i)));
-            if (nt == 0) engine->drop_to_any = true;
-            for (Py_ssize_t i = 0; i < nt; i++)
-                engine->drop_to.set(checked(get_i64(tos.p, i)));
+            PyRef kind_obj(PySequence_GetItem(mangler, 0));
+            if (!kind_obj) throw EngineError("bad mangler descriptor");
+            const char *kind_s = PyUnicode_AsUTF8(kind_obj.p);
+            if (!kind_s) throw EngineError("bad mangler kind");
+            string kind(kind_s);
+            if (kind == "drop") {
+                engine->drop_mangler = true;
+                PyRef froms(PySequence_GetItem(mangler, 1));
+                PyRef tos(PySequence_GetItem(mangler, 2));
+                if (!froms || !tos) throw EngineError("bad mangler descriptor");
+                Py_ssize_t nf = PySequence_Size(froms.p);
+                Py_ssize_t nt = PySequence_Size(tos.p);
+                auto checked = [n_nodes](i64 id) {
+                    if (id < 0 || id >= n_nodes)
+                        throw EngineError("mangler node id out of range");
+                    return id;
+                };
+                if (nf == 0) engine->drop_from_any = true;
+                for (Py_ssize_t i = 0; i < nf; i++)
+                    engine->drop_from.set(checked(get_i64(froms.p, i)));
+                if (nt == 0) engine->drop_to_any = true;
+                for (Py_ssize_t i = 0; i < nt; i++)
+                    engine->drop_to.set(checked(get_i64(tos.p, i)));
+            } else if (kind == "generic") {
+                auto mg = std::make_unique<ManglerG>();
+                PyRef wrap_obj(PySequence_GetItem(mangler, 1));
+                const char *wrap_s =
+                    wrap_obj ? PyUnicode_AsUTF8(wrap_obj.p) : nullptr;
+                if (!wrap_s) throw EngineError("bad mangler wrap");
+                string wrap(wrap_s);
+                if (wrap == "for") mg->wrap = ManglerG::WFor;
+                else if (wrap == "until") mg->wrap = ManglerG::WUntil;
+                else if (wrap == "after") mg->wrap = ManglerG::WAfter;
+                else throw EngineError("unknown mangler wrap");
+
+                PyRef preds(PySequence_GetItem(mangler, 2));
+                if (!preds) throw EngineError("bad mangler predicates");
+                Py_ssize_t np = PySequence_Size(preds.p);
+                for (Py_ssize_t i = 0; i < np; i++) {
+                    PyRef pd(PySequence_GetItem(preds.p, i));
+                    if (!pd) throw EngineError("bad mangler predicate");
+                    PyRef pk_obj(PySequence_GetItem(pd.p, 0));
+                    const char *pk_s =
+                        pk_obj ? PyUnicode_AsUTF8(pk_obj.p) : nullptr;
+                    if (!pk_s) throw EngineError("bad predicate kind");
+                    string pk(pk_s);
+                    MPredD p{};
+                    if (pk == "msgs") p.k = MPredD::Msgs;
+                    else if (pk == "node_startup") p.k = MPredD::NodeStartup;
+                    else if (pk == "client_proposal")
+                        p.k = MPredD::ClientProposalEv;
+                    else if (pk == "from_self") p.k = MPredD::FromSelf;
+                    else if (pk == "from_nodes" || pk == "to_nodes") {
+                        p.k = pk == "from_nodes" ? MPredD::FromNodes
+                                                 : MPredD::ToNodes;
+                        PyRef ids(PySequence_GetItem(pd.p, 1));
+                        if (!ids) throw EngineError("bad node id list");
+                        Py_ssize_t ni = PySequence_Size(ids.p);
+                        for (Py_ssize_t j = 0; j < ni; j++)
+                            p.ids.push_back(get_i64(ids.p, j));
+                    } else if (pk == "at_percent" || pk == "with_sequence" ||
+                               pk == "with_epoch" || pk == "from_client") {
+                        if (pk == "at_percent") p.k = MPredD::AtPercent;
+                        else if (pk == "with_sequence")
+                            p.k = MPredD::WithSequence;
+                        else if (pk == "with_epoch") p.k = MPredD::WithEpoch;
+                        else p.k = MPredD::FromClient;
+                        p.value = get_i64(pd.p, 1);
+                    } else if (pk == "of_type") {
+                        p.k = MPredD::OfType;
+                        PyRef codes(PySequence_GetItem(pd.p, 1));
+                        if (!codes) throw EngineError("bad type code list");
+                        Py_ssize_t nc2 = PySequence_Size(codes.p);
+                        for (Py_ssize_t j = 0; j < nc2; j++) {
+                            i64 code = get_i64(codes.p, j);
+                            if (code < 0 || code > 15)
+                                throw EngineError("bad msg type code");
+                            p.type_mask |= 1u << (u32)code;
+                        }
+                    } else {
+                        throw EngineError("unknown mangler predicate kind");
+                    }
+                    mg->preds.push_back(std::move(p));
+                }
+
+                PyRef act_obj(PySequence_GetItem(mangler, 3));
+                const char *act_s =
+                    act_obj ? PyUnicode_AsUTF8(act_obj.p) : nullptr;
+                if (!act_s) throw EngineError("bad mangler action");
+                string act(act_s);
+                if (act == "drop") mg->action = ManglerG::Drop;
+                else if (act == "jitter") mg->action = ManglerG::Jitter;
+                else if (act == "duplicate") mg->action = ManglerG::Duplicate;
+                else if (act == "delay") mg->action = ManglerG::Delay;
+                else if (act == "crash_and_restart_after")
+                    mg->action = ManglerG::CrashRestart;
+                else throw EngineError("unknown mangler action");
+                mg->value = get_i64(mangler, 4);
+                if ((mg->action == ManglerG::Jitter ||
+                     mg->action == ManglerG::Duplicate) &&
+                    mg->value <= 0)
+                    throw EngineError("jitter/duplicate needs max_delay > 0");
+                if (mg->action == ManglerG::CrashRestart) {
+                    PyRef rp(PySequence_GetItem(mangler, 5));
+                    if (!rp || rp.p == Py_None)
+                        throw EngineError("crash restart needs init parms");
+                    mg->restart_parms.id = (i32)get_i64(rp.p, 0);
+                    mg->restart_parms.batch_size = get_i64(rp.p, 1);
+                    mg->restart_parms.heartbeat_ticks = get_i64(rp.p, 2);
+                    mg->restart_parms.suspect_ticks = get_i64(rp.p, 3);
+                    mg->restart_parms.new_epoch_timeout_ticks =
+                        get_i64(rp.p, 4);
+                    mg->restart_parms.buffer_size = get_i64(rp.p, 5);
+                    if (mg->restart_parms.id < 0 ||
+                        mg->restart_parms.id >= (i32)n_nodes)
+                        throw EngineError("restart target out of range");
+                }
+                mg->rng.seed_from_u64((u64)random_seed);
+                engine->queue.mangler = std::move(mg);
+            } else {
+                throw EngineError("unknown mangler descriptor kind");
+            }
         }
 
         // Ack ledger: requires send order == arrival order, i.e. uniform
@@ -6523,7 +7122,10 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
         // mangler breaks every-receiver-sees-every-wave, so it disables
         // the ledger outright (classic paths handle drops exactly).
         {
-            bool uniform = !engine->drop_mangler;
+            // A consume-time mangler breaks send-order == arrival-order
+            // (jitter/duplicates) and every-receiver-sees-every-wave
+            // (drops), so any generic mangler disables the ledger outright.
+            bool uniform = !engine->drop_mangler && !engine->queue.mangler;
             for (const auto &node : engine->nodes)
                 if (node->runtime.link_latency !=
                     engine->nodes[0]->runtime.link_latency)
@@ -6672,6 +7274,55 @@ PyObject *engine_node_summary(PyObject *self, PyObject *args) {
         (Py_ssize_t)active.size(), committed, lws);
 }
 
+// set_fail_transfers(node, count): the node's next `count` state-transfer
+// attempts fail at the app boundary (testengine NodeState.fail_transfers).
+PyObject *engine_set_fail_transfers(PyObject *self, PyObject *args) {
+    int i;
+    long long count;
+    if (!PyArg_ParseTuple(args, "iL", &i, &count)) return nullptr;
+    Engine *e = ((PyEngine *)self)->engine;
+    if (i < 0 || (size_t)i >= e->nodes.size()) {
+        PyErr_SetString(PyExc_IndexError, "node index out of range");
+        return nullptr;
+    }
+    e->nodes[(size_t)i]->state.fail_transfers = count;
+    Py_RETURN_NONE;
+}
+
+// node_transfers(i) -> (state_transfers, transfer_failures, attempt_times)
+PyObject *engine_node_transfers(PyObject *self, PyObject *args) {
+    int i;
+    if (!PyArg_ParseTuple(args, "i", &i)) return nullptr;
+    Engine *e = ((PyEngine *)self)->engine;
+    if (i < 0 || (size_t)i >= e->nodes.size()) {
+        PyErr_SetString(PyExc_IndexError, "node index out of range");
+        return nullptr;
+    }
+    const AppState &st = e->nodes[(size_t)i]->state;
+    auto build = [](const vector<i64> &v) -> PyObject * {
+        PyObject *t = PyTuple_New((Py_ssize_t)v.size());
+        if (!t) return nullptr;
+        for (size_t j = 0; j < v.size(); j++) {
+            PyObject *n = PyLong_FromLongLong(v[j]);
+            if (!n) {
+                Py_DECREF(t);
+                return nullptr;
+            }
+            PyTuple_SET_ITEM(t, (Py_ssize_t)j, n);
+        }
+        return t;
+    };
+    PyObject *a = build(st.state_transfers);
+    PyObject *b = a ? build(st.transfer_failures) : nullptr;
+    PyObject *c = b ? build(st.transfer_attempt_times) : nullptr;
+    if (!c) {
+        Py_XDECREF(a);
+        Py_XDECREF(b);
+        return nullptr;
+    }
+    return Py_BuildValue("NNN", a, b, c);
+}
+
 // pop_hash_log() -> list[(message_bytes, digest_bytes)]
 PyObject *engine_pop_hash_log(PyObject *self, PyObject *) {
     Engine *e = ((PyEngine *)self)->engine;
@@ -6729,11 +7380,12 @@ PyObject *engine_profile(PyObject *self, PyObject *) {
         if (PyDictSetItemStringSteal(out, part_names[i], v) < 0)
             return nullptr;
     }
-    static const char *ev_names[10] = {
+    static const char *ev_names[12] = {
         "ev_init", "ev_load", "ev_load_done", "ev_hash_result",
         "ev_checkpoint_result", "ev_request_persisted", "ev_step",
-        "ev_tick", "ev_actions_received", "ev_pad"};
-    for (int i = 0; i < 10; i++) {
+        "ev_tick", "ev_actions_received", "ev_transfer_complete",
+        "ev_transfer_failed", "ev_pad"};
+    for (int i = 0; i < 12; i++) {
         PyObject *v = Py_BuildValue("KK", (unsigned long long)e->ev_cycles[i],
                                     (unsigned long long)e->ev_counts[i]);
         if (PyDictSetItemStringSteal(out, ev_names[i], v) < 0) return nullptr;
@@ -6831,6 +7483,8 @@ PyMethodDef engine_methods[] = {
     {"set_device_modes", engine_set_device_modes, METH_VARARGS, nullptr},
     {"stats", engine_stats, METH_NOARGS, nullptr},
     {"node_summary", engine_node_summary, METH_VARARGS, nullptr},
+    {"set_fail_transfers", engine_set_fail_transfers, METH_VARARGS, nullptr},
+    {"node_transfers", engine_node_transfers, METH_VARARGS, nullptr},
     {"pop_hash_log", engine_pop_hash_log, METH_NOARGS, nullptr},
     {"profile", engine_profile, METH_NOARGS, nullptr},
     {nullptr, nullptr, 0, nullptr},

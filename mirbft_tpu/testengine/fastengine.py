@@ -4,8 +4,11 @@
 tests consume (``drain_clients``, per-node final state), running the WHOLE
 simulation in C++.  It is a bit-identical twin of the Python engine on
 supported configs (see the equivalence contract in fastengine.cpp and
-tests/test_fastengine.py); configs outside the envelope (manglers,
-reconfiguration, state transfer, restarts, >256 nodes) raise
+tests/test_fastengine.py), including the failure paths: DSL manglers
+(compiled to a native descriptor driving a CPython-compatible MT19937
+stream), crash-and-restart recovery, and state transfer.  Configs outside
+the envelope (reconfiguration, custom mangler actions, >256 nodes,
+device-paced modes combined with a consume-time mangler) raise
 ``FastEngineUnsupported`` at construction so callers can fall back.
 
 Device crypto in fast runs:
@@ -38,6 +41,72 @@ from .recorder import Spec, _u64
 class FastEngineUnsupported(RuntimeError):
     """The config (or a mid-run condition) is outside the fast engine's
     envelope; use the Python engine."""
+
+
+# Message classes -> the native MT enum codes (fastengine.cpp `enum MT`).
+def _mt_codes():
+    from .. import messages as m
+
+    return {
+        m.Preprepare: 0, m.Prepare: 1, m.Commit: 2, m.CheckpointMsg: 3,
+        m.Suspect: 4, m.EpochChange: 5, m.EpochChangeAck: 6, m.NewEpoch: 7,
+        m.NewEpochEcho: 8, m.NewEpochReady: 9, m.FetchBatch: 10,
+        m.ForwardBatch: 11, m.FetchRequest: 12, m.AckMsg: 13, m.AckBatch: 14,
+        m.MsgBatch: 15,
+    }
+
+
+def _compile_mangler(mangler):
+    """Compile a Python mangler into a native descriptor.
+
+    Returns ("drop", from, to) for the structured DropMessages (applied at
+    the native send queue, no RNG), or ("generic", wrap, preds, action,
+    value, restart_parms) for a DSL-built EventMangling — the native engine
+    then draws the same MT19937 stream and applies the same envelope-aware
+    matching as the Python queue.  Raises FastEngineUnsupported for mangler
+    shapes that cannot be expressed natively (e.g. a custom ``do`` action).
+    """
+    from .manglers import DropMessages, EventMangling
+
+    if isinstance(mangler, DropMessages):
+        return ("drop", tuple(mangler.from_nodes), tuple(mangler.to_nodes))
+    _require(isinstance(mangler, EventMangling), "non-DSL mangler")
+    _require(mangler._matched is False, "mangler with pre-latched state")
+    codes = _mt_codes()
+    preds = []
+    for p in mangler.matcher._predicates:
+        kind = getattr(p, "kind", None)
+        params = getattr(p, "params", ())
+        if kind in ("msgs", "node_startup", "client_proposal", "from_self"):
+            preds.append((kind,))
+        elif kind in ("from_nodes", "to_nodes"):
+            preds.append((kind, tuple(int(n) for n in params)))
+        elif kind in ("at_percent", "with_sequence", "with_epoch", "from_client"):
+            preds.append((kind, int(params[0])))
+        elif kind == "of_type":
+            type_codes = []
+            for t in params:
+                _require(t in codes, f"of_type({t.__name__}) not native")
+                type_codes.append(codes[t])
+            preds.append((kind, tuple(type_codes)))
+        else:
+            _require(False, f"mangler predicate {kind!r} not native")
+    action = mangler.action_kind
+    restart_parms = None
+    if action in ("jitter", "duplicate", "delay"):
+        value = int(mangler.action_params[0])
+    elif action == "drop":
+        value = 0
+    elif action == "crash_and_restart_after":
+        value = int(mangler.action_params[0])
+        ip = mangler.action_params[1]
+        restart_parms = (
+            ip.id, ip.batch_size, ip.heartbeat_ticks, ip.suspect_ticks,
+            ip.new_epoch_timeout_ticks, ip.buffer_size,
+        )
+    else:
+        _require(False, f"mangler action {action!r} not native")
+    return ("generic", mangler.wrap, tuple(preds), action, value, restart_parms)
 
 
 class _NodeFinal:
@@ -82,18 +151,17 @@ class FastRecording:
         if device_authoritative or streaming_auth:
             _require(device, "device modes require device=True")
         recorder = spec.recorder()
-        from .manglers import DropMessages
 
         mangler_desc = None
         if recorder.mangler is not None:
+            mangler_desc = _compile_mangler(recorder.mangler)
+        if device_authoritative or streaming_auth:
+            # check_ready() vets the queue HEAD for device needs; a
+            # consume-time mangler can swap the head at consumption, so
+            # device-paced modes only compose with the send-side drop.
             _require(
-                isinstance(recorder.mangler, DropMessages),
-                "manglers (only DropMessages is in the fast envelope)",
-            )
-            mangler_desc = (
-                "drop",
-                tuple(recorder.mangler.from_nodes),
-                tuple(recorder.mangler.to_nodes),
+                mangler_desc is None or mangler_desc[0] == "drop",
+                "generic manglers with device-paced modes",
             )
         _require(not recorder.reconfig_points, "reconfiguration")
         _require(recorder.event_log_writer is None, "event log interception")
@@ -188,6 +256,7 @@ class FastRecording:
             (spec.node_count, net.checkpoint_interval, net.max_epoch_length,
              net.number_of_buckets, net.f),
             client_states, client_specs, node_specs, mangler_desc,
+            recorder.random_seed,
         )
         if device_authoritative or streaming_auth:
             self._engine.set_device_modes(
@@ -521,6 +590,15 @@ class FastRecording:
         """(steps, fake_time, committed_ops)."""
         steps, fake_time, ops, _ = self._engine.stats()
         return steps, fake_time, ops
+
+    def set_fail_transfers(self, node_id: int, count: int) -> None:
+        """The node's next `count` state-transfer attempts fail at the app
+        boundary (mirrors NodeState.fail_transfers)."""
+        self._engine.set_fail_transfers(node_id, count)
+
+    def node_transfers(self, node_id: int):
+        """(state_transfers, transfer_failures, attempt_times) for a node."""
+        return self._engine.node_transfers(node_id)
 
     def host_crypto_seconds(self) -> float:
         """Host CPU seconds spent in crypto: in-engine SHA-256 (chrono-timed)

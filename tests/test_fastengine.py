@@ -274,29 +274,213 @@ def test_unsupported_configs_raise():
     with pytest.raises(FastEngineUnsupported):
         FastRecording(spec)
 
+    # A custom (non-DSL) mangler action cannot be compiled natively.
     spec = Spec(node_count=4, client_count=1, reqs_per_client=1)
 
-    def add_mangler(recorder):
-        recorder.mangler = For(matching.msgs().from_node(0)).drop()
+    def add_custom(recorder):
+        from mirbft_tpu.testengine.manglers import MangleResult
 
-    spec.tweak_recorder = add_mangler
+        recorder.mangler = For(matching.msgs()).do(
+            lambda r, e: [MangleResult(e)]
+        )
+
+    spec.tweak_recorder = add_custom
+    with pytest.raises(FastEngineUnsupported):
+        FastRecording(spec)
+
+    # Reconfiguration is still outside the envelope.
+    from mirbft_tpu.messages import ReconfigNewClient
+    from mirbft_tpu.testengine.recorder import ReconfigPoint
+
+    spec = Spec(node_count=4, client_count=1, reqs_per_client=1)
+
+    def add_reconfig(recorder):
+        recorder.reconfig_points = [
+            ReconfigPoint(
+                client_id=0,
+                req_no=0,
+                reconfiguration=ReconfigNewClient(id=4, width=100),
+            )
+        ]
+
+    spec.tweak_recorder = add_reconfig
     with pytest.raises(FastEngineUnsupported):
         FastRecording(spec)
 
 
-def test_out_of_envelope_escalates_cleanly():
-    """A config whose run needs state transfer (an ignored node can never
-    fetch the request bodies it lacks) raises instead of diverging."""
+# ---------------------------------------------------------------------------
+# Failure-path differentials: manglers, restarts, state transfer.  The
+# native engine twins the full scenario matrix of test_testengine.py
+# (reference integration_test.go:244-430) bit-identically — including the
+# MT19937 stream behind jitter/duplicate/percent decisions.
+# ---------------------------------------------------------------------------
+
+
+def _differential(spec, timeout=30_000_000):
+    steps_py, time_py, state_py = _python_run(spec, timeout=timeout)
+    steps_fast, time_fast, state_fast = _fast_run(spec, timeout=timeout)
+    assert (steps_fast, time_fast) == (steps_py, time_py)
+    assert state_fast == state_py
+    return state_fast
+
+
+def test_drop_two_percent_differential():
     spec = Spec(
-        node_count=4,
-        client_count=2,
-        reqs_per_client=10,
-        batch_size=2,
-        clients_ignore=(2,),
+        node_count=4, client_count=4, reqs_per_client=20,
+        tweak_recorder=lambda r: setattr(
+            r, "mangler", For(matching.msgs().at_percent(2)).drop()
+        ),
     )
+    _differential(spec)
+
+
+def test_heavy_ack_drop_differential():
+    from mirbft_tpu.messages import AckMsg
+
+    spec = Spec(
+        node_count=4, client_count=4, reqs_per_client=10,
+        tweak_recorder=lambda r: setattr(
+            r, "mangler",
+            For(matching.msgs().of_type(AckMsg).at_percent(70)).drop(),
+        ),
+    )
+    _differential(spec)
+
+
+@pytest.mark.parametrize("max_delay", [30, 1000])
+def test_jitter_differential(max_delay):
+    spec = Spec(
+        node_count=4, client_count=4, reqs_per_client=20,
+        tweak_recorder=lambda r: setattr(
+            r, "mangler", For(matching.msgs()).jitter(max_delay)
+        ),
+    )
+    _differential(spec)
+
+
+def test_duplication_differential():
+    spec = Spec(
+        node_count=4, client_count=4, reqs_per_client=20,
+        tweak_recorder=lambda r: setattr(
+            r, "mangler", For(matching.msgs().at_percent(75)).duplicate(300)
+        ),
+    )
+    _differential(spec)
+
+
+def test_delay_remangle_differential():
+    """delay() keeps events remangle-able: a delayed delivery is re-drawn
+    against at_percent on every touch, so each escapes with p=0.75 per
+    touch and the run terminates.  (An unconditional ``Until(X).delay``
+    livelocks by construction — every event is pushed forever and X never
+    arrives — identically in both engines and in the reference's
+    semantics, so that shape is untestable.)"""
+    spec = Spec(
+        node_count=4, client_count=2, reqs_per_client=10,
+        tweak_recorder=lambda r: setattr(
+            r, "mangler",
+            For(matching.msgs().from_node(1).at_percent(25)).delay(100),
+        ),
+    )
+    _differential(spec)
+
+
+def test_after_wrap_differential():
+    """After(cond): mangling starts only once cond first matches — every
+    event gets jittered once the first Commit for seq 8 is touched.  Pins
+    the After latch plus the RNG stream across the latch transition."""
+    from mirbft_tpu.messages import Commit
+    from mirbft_tpu.testengine import After
+
+    def tweak(r):
+        r.mangler = After(
+            matching.msgs().of_type(Commit).with_sequence(8)
+        ).jitter(50)
+
+    spec = Spec(node_count=4, client_count=2, reqs_per_client=10,
+                tweak_recorder=tweak)
+    _differential(spec)
+
+
+def test_crash_and_restart_differential():
+    """Crash-and-restart: mid-epoch WAL resume, suspect-driven epoch
+    change, and the catch-up state transfer, bit-identical across engines
+    (test_testengine.py::test_crash_and_restart's config)."""
+    from mirbft_tpu.messages import Commit
+
+    def crash(r):
+        r.mangler = For(
+            matching.msgs().to_node(3).of_type(Commit).with_sequence(10)
+        ).crash_and_restart_after(500, r.node_configs[3].init_parms)
+
+    spec = Spec(node_count=4, client_count=4, reqs_per_client=30,
+                tweak_recorder=crash)
+    state = _differential(spec)
+    assert any(node[2] > 1 for node in state), "expected an epoch change"
+
     fr = FastRecording(spec)
-    with pytest.raises(FastEngineUnsupported):
-        fr.drain_clients(timeout=10_000_000)
+    fr.drain_clients(timeout=30_000_000)
+    transfers = [fr.node_transfers(i)[0] for i in range(4)]
+    rec = spec.recorder().recording()
+    rec.drain_clients(timeout=30_000_000)
+    assert transfers == [tuple(n.state.state_transfers) for n in rec.nodes]
+
+
+def test_client_ignores_node_transfer_differential():
+    """An ignored node must state-transfer to catch up; both engines agree
+    on the full evolution and on who transferred."""
+    spec = Spec(
+        node_count=4, client_count=1, reqs_per_client=20, clients_ignore=(3,)
+    )
+    _differential(spec)
+    fr = FastRecording(spec)
+    fr.drain_clients(timeout=30_000_000)
+    assert fr.node_transfers(3)[0], "node 3 should have transferred"
+    for i in range(3):
+        assert not fr.node_transfers(i)[0]
+
+
+def test_late_start_transfer_differential():
+    spec = Spec(
+        node_count=4, client_count=4, reqs_per_client=20,
+        tweak_recorder=lambda r: setattr(
+            r.node_configs[3], "start_delay", 50000
+        ),
+    )
+    _differential(spec, timeout=100_000_000)
+    fr = FastRecording(spec)
+    fr.drain_clients(timeout=100_000_000)
+    assert fr.node_transfers(3)[0], "late-started node should transfer"
+
+
+def test_transfer_failure_retry_differential():
+    """App-level transfer-failure injection: three failed attempts, then
+    success after a doubling tick backoff — attempt times, failures, and
+    the whole evolution bit-identical across engines."""
+    spec = Spec(
+        node_count=4, client_count=4, reqs_per_client=20,
+        tweak_recorder=lambda r: setattr(
+            r.node_configs[3], "start_delay", 50000
+        ),
+    )
+
+    rec = spec.recorder().recording()
+    state = rec.nodes[3].state
+    state.fail_transfers = 3
+    state.time_source = lambda: rec.event_queue.fake_time
+    steps_py = rec.drain_clients(timeout=600_000_000)
+
+    fr = FastRecording(spec)
+    fr.set_fail_transfers(3, 3)
+    steps_fast = fr.drain_clients(timeout=600_000_000)
+
+    assert steps_fast == steps_py
+    transfers, failures, times = fr.node_transfers(3)
+    assert list(failures) == state.transfer_failures
+    assert list(transfers) == state.state_transfers
+    assert list(times) == state.transfer_attempt_times
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    assert gaps[0] < gaps[1] < gaps[2], gaps
 
 
 @pytest.mark.parametrize("seed", [0, 3, 9, 17])
