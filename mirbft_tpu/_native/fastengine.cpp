@@ -359,12 +359,28 @@ struct ClientStateS {
 struct NetConfigS {
     vector<i32> nodes;
     i64 ci, mel, nb, f;
+    bool operator==(const NetConfigS &o) const {
+        return nodes == o.nodes && ci == o.ci && mel == o.mel &&
+               nb == o.nb && f == o.f;
+    }
+};
+using NetCfgP = shared_ptr<const NetConfigS>;
+
+// Reconfiguration variants (messages.py ReconfigNewClient/RemoveClient/
+// NewConfig).  Engine envelope: a NewConfig may change number_of_buckets
+// and max_epoch_length only — the node set, f, and checkpoint_interval are
+// fixed engine-wide (enforced at construction; anything else falls back to
+// the Python engine).
+struct ReconfigS {
+    enum RT : u8 { NewClient, RemoveClient, NewConfig } t;
+    i64 id = 0, width = 0;  // NewClient / RemoveClient
+    NetCfgP config;         // NewConfig
 };
 
 struct NetStateS {
-    // config is engine-global (no reconfiguration in the envelope);
-    // pending_reconfigurations always empty.
+    NetCfgP config;  // always set (the active consensused config)
     vector<ClientStateS> clients;
+    vector<ReconfigS> pending;  // pending_reconfigurations
 };
 using NetStateP = shared_ptr<const NetStateS>;
 
@@ -464,7 +480,9 @@ using PersistEntP = shared_ptr<const PersistEntS>;
 // ---------------------------------------------------------------------------
 
 enum WireTag : u32 {
-    TAG_NetworkConfig = 0, TAG_ClientState = 1, TAG_NetworkState = 5,
+    TAG_NetworkConfig = 0, TAG_ClientState = 1, TAG_ReconfigNewClient = 2,
+    TAG_ReconfigRemoveClient = 3, TAG_ReconfigNewConfig = 4,
+    TAG_NetworkState = 5,
     TAG_RequestAck = 6, TAG_EpochConfig = 8, TAG_CheckpointMsg = 9,
     TAG_EpochChangeSetEntry = 10, TAG_EpochChange = 11,
     TAG_EpochChangeAck = 12, TAG_NewEpochConfig = 13,
@@ -511,12 +529,25 @@ struct Wire {
         enc_bytes(buf, c.mask);
     }
 
-    void net_state(string &buf, const NetConfigS &cfg, const NetStateS &s) const {
+    void net_state(string &buf, const NetStateS &s) const {
         enc_uv(buf, TAG_NetworkState);
-        net_config(buf, cfg);
+        net_config(buf, *s.config);
         enc_uv(buf, s.clients.size());
         for (const auto &c : s.clients) client_state(buf, c);
-        enc_uv(buf, 0);  // pending_reconfigurations: always empty here
+        enc_uv(buf, s.pending.size());
+        for (const auto &r : s.pending) {
+            if (r.t == ReconfigS::NewClient) {
+                enc_uv(buf, TAG_ReconfigNewClient);
+                enc_uv(buf, (u64)r.id);
+                enc_uv(buf, (u64)r.width);
+            } else if (r.t == ReconfigS::RemoveClient) {
+                enc_uv(buf, TAG_ReconfigRemoveClient);
+                enc_uv(buf, (u64)r.id);
+            } else {
+                enc_uv(buf, TAG_ReconfigNewConfig);
+                net_config(buf, *r.config);
+            }
+        }
     }
 
     void ack(string &buf, const AckS &a) const {
@@ -693,8 +724,9 @@ struct ActionS {
     AT t;
     AckS ack{0, 0, 0};          // CorrectRequest / ForwardRequest
     i64 a = 0;                  // Persist/Truncate index; Checkpoint/StateApplied seq; AllocatedRequest client
-    i64 b = 0;                  // AllocatedRequest reqno
+    i64 b = 0;                  // AllocatedRequest reqno; StateTransfer value id
     Targets targets;            // Send / ForwardRequest
+    NetCfgP cfg;                // Checkpoint: the post-checkpoint config
     shared_ptr<const void> payload;  // per-kind (see accessors)
 
     // kind-checked accessors (type safety rests on the AT tag)
@@ -1122,7 +1154,8 @@ struct AckLedger;  // defined below (cluster-shared ack-wave canon)
 struct Ctx {
     Interner intern;
     Wire wire{nullptr};
-    NetConfigS cfg;
+    NetConfigS cfg;   // the INITIAL network config
+    NetCfgP cfg_p;    // shared pointer to the same (for NetState linkage)
     vector<ClientStateS> init_clients;
     i64 iq, wq;
     // Shared broadcast target set: most sends address every node, and the
@@ -1132,6 +1165,7 @@ struct Ctx {
 
     void finish_init() {
         wire.in = &intern;
+        cfg_p = std::make_shared<const NetConfigS>(cfg);
         Quorums q{(i64)cfg.nodes.size(), cfg.f};
         iq = q.iq();
         wq = q.wq();
@@ -1164,8 +1198,14 @@ ActionS act_truncate(i64 index) {
 ActionS act_commit(QEntryP q) {
     ActionS a; a.t = AT::Commit; a.payload = std::move(q); return a;
 }
-ActionS act_checkpoint(i64 seq, shared_ptr<const vector<ClientStateS>> cs) {
-    ActionS a; a.t = AT::Checkpoint; a.a = seq; a.payload = std::move(cs); return a;
+ActionS act_checkpoint(i64 seq, NetCfgP cfg,
+                       shared_ptr<const vector<ClientStateS>> cs) {
+    ActionS a;
+    a.t = AT::Checkpoint;
+    a.a = seq;
+    a.cfg = std::move(cfg);
+    a.payload = std::move(cs);
+    return a;
 }
 ActionS act_allocate(i64 client, i64 reqno) {
     ActionS a; a.t = AT::AllocatedRequest; a.a = client; a.b = reqno; return a;
@@ -1560,6 +1600,7 @@ struct CheckpointTracker {
     vector<shared_ptr<Checkpoint>> active_checkpoints;
     std::map<i32, MsgBuffer> msg_buffers;
     bool have_config = false;
+    NetCfgP net_cfg;  // from the first CEntry's network state (Python twin)
 
     shared_ptr<Checkpoint> checkpoint(i64 seq_no) {
         auto it = checkpoint_map.find(seq_no);
@@ -1593,7 +1634,10 @@ struct CheckpointTracker {
 
         for (const auto &pr : persisted->entries) {
             if (pr.second->t != PET::C) continue;
-            have_config = true;  // network config fixed engine-wide
+            if (!have_config) {
+                have_config = true;
+                net_cfg = pr.second->netstate->config;
+            }
             auto cp = checkpoint(pr.second->seq);
             cp->apply_checkpoint_msg(my_config.id, pr.second->dig);
             active_checkpoints.push_back(cp);
@@ -1644,7 +1688,7 @@ struct CheckpointTracker {
                                      (std::ptrdiff_t)highest_stable_idx);
 
         while (active_checkpoints.size() < 3) {
-            i64 next_seq = high_watermark() + ctx->cfg.ci;
+            i64 next_seq = high_watermark() + net_cfg->ci;
             active_checkpoints.push_back(checkpoint(next_seq));
         }
 
@@ -2341,6 +2385,50 @@ struct ClientD {
     bool led_classic = false;
     i64 led_diverged = 0;
 
+    // Quorum bookkeeping used during a changed-config rebuild
+    // (disseminator.py:234-246 _apply_request_ack).
+    void apply_request_ack(ClientReqNoD &crn, i32 source, const AckS &a) {
+        if (a.dig != 0) crn.non_null_voters.set(source);
+        CRP req = crn.client_req(a);
+        req->agreements.set(source);
+        i64 count = req->agreements.count();
+        if (count < weak_quorum) return;
+        crn.weak_requests.put(a.dig, req);
+        if (count < strong_quorum) return;
+        crn.strong_requests.put(a.dig, req);
+    }
+
+    // disseminator.py:162-198 (ClientReqNo.reinitialize, config changed):
+    // re-derive quorum sets from remembered agreements, iterating old
+    // candidates in sorted-digest-bytes order (the rebuild both reorders
+    // the candidate maps and constructs fresh ClientRequests, dropping
+    // fetch state; `stored` carries over into fresh my_requests).
+    void crn_rebuild(ClientReqNoD &crn) {
+        auto old_items = std::move(crn.requests.items);
+        crn.requests.items.clear();
+        crn.non_null_voters = Mask();
+        crn.weak_requests.items.clear();
+        crn.strong_requests.items.clear();
+        crn.my_requests.items.clear();
+        std::stable_sort(old_items.begin(), old_items.end(),
+                         [this](const std::pair<i32, CRP> &a,
+                                const std::pair<i32, CRP> &b) {
+                             return ctx->intern.get(a.first) <
+                                    ctx->intern.get(b.first);
+                         });
+        for (const auto &pr : old_items) {
+            const CRP &old_req = pr.second;
+            for (i32 node : ctx->cfg.nodes)
+                if (old_req->agreements.test(node))
+                    apply_request_ack(crn, node, old_req->ack);
+            if (old_req->stored) {
+                CRP new_req = crn.client_req(old_req->ack);
+                new_req->stored = true;
+                crn.my_requests.put(pr.first, new_req);
+            }
+        }
+    }
+
     CRNP win_get(i64 req_no) const {
         i64 off = req_no - win_base;
         if (off < 0 || off >= (i64)win.size()) return nullptr;
@@ -2358,10 +2446,16 @@ struct ClientD {
     }
 
     Actions reinitialize(i64 seq_no, i64 client_id,
-                         const ClientStateS &state, bool reconfiguring) {
+                         const ClientStateS &state, bool reconfiguring,
+                         bool same_config, i64 ci) {
         Actions actions;
         weak_quorum = ctx->wq;
         strong_quorum = ctx->iq;
+        if (!same_config)
+            // A changed config invalidates the ledger's canonical view of
+            // this client (quorum-set rebuild reorders candidate maps):
+            // materialize private state and consume classically from here.
+            led_fallback_all_classic();
         led_classic = led_classic || my_config.led_classic;
         deque<CRNP> old_win = std::move(win);
         i64 old_base = win_base;
@@ -2391,15 +2485,20 @@ struct ClientD {
             if (old_off >= 0 && old_off < (i64)old_win.size() &&
                 !old_win.empty()) {
                 crn = old_win[(size_t)old_off];
-                // same_config reinitialize: reset per-candidate fetch state.
-                for (auto &pr : crn->requests.items) {
-                    pr.second->fetching = false;
-                    pr.second->ticks_fetching = 0;
-                    pr.second->ticks_correct = 0;
+                if (same_config) {
+                    // Graceful rotation under an unchanged config: identity
+                    // on vote state; only per-candidate fetch state resets.
+                    for (auto &pr : crn->requests.items) {
+                        pr.second->fetching = false;
+                        pr.second->ticks_fetching = 0;
+                        pr.second->ticks_correct = 0;
+                    }
+                } else {
+                    crn_rebuild(*crn);
                 }
             } else {
                 i64 valid_after =
-                    rn > intermediate_high ? seq_no + ctx->cfg.ci : seq_no;
+                    rn > intermediate_high ? seq_no + ci : seq_no;
                 crn = std::make_shared<ClientReqNoD>();
                 crn->client_id = client_id;
                 crn->req_no = rn;
@@ -2417,7 +2516,8 @@ struct ClientD {
         return actions;
     }
 
-    Actions allocate(i64 seq_no, const ClientStateS &state, bool reconfiguring) {
+    Actions allocate(i64 seq_no, const ClientStateS &state, bool reconfiguring,
+                     i64 ci) {
         Actions actions;
         i64 intermediate_high = state.lw + state.width - state.wclc - 1;
         if (intermediate_high != high_watermark)
@@ -2440,7 +2540,7 @@ struct ClientD {
 
         client_state = state;
 
-        i64 valid_after = seq_no + ctx->cfg.ci;
+        i64 valid_after = seq_no + ci;
         for (i64 rn = intermediate_high + 1; rn <= new_high; rn++) {
             actions.push_back(act_allocate(state.id, rn));
             auto crn = std::make_shared<ClientReqNoD>();
@@ -2908,6 +3008,7 @@ struct Disseminator {
     InitParms my_config;
     NodeBuffers *node_buffers = nullptr;
     ClientTracker *client_tracker = nullptr;
+    NetCfgP network_config;  // the active consensused config
     i64 allocated_through = 0;
     bool initialized = false;
     vector<ClientStateS> client_states;
@@ -2954,8 +3055,10 @@ struct Disseminator {
 
     Actions reinitialize(i64 seq_no, const NetStateS &network_state) {
         Actions actions;
-        // Envelope: no pending reconfigurations ever.
-        bool reconfiguring = false;
+        bool reconfiguring = !network_state.pending.empty();
+        bool same_config =
+            network_config && *network_config == *network_state.config;
+        network_config = network_state.config;
         allocated_through = seq_no;
 
         auto old_clients = std::move(clients);
@@ -2976,7 +3079,9 @@ struct Disseminator {
                 c->led_classic_count = &led_classic_count;
             }
             clients.emplace(cs.id, c);
-            concat(actions, c->reinitialize(seq_no, cs.id, cs, reconfiguring));
+            concat(actions,
+                   c->reinitialize(seq_no, cs.id, cs, reconfiguring,
+                                   same_config, network_config->ci));
         }
         led_refresh_bounds();
         auto old_msg_buffers = std::move(msg_buffers);
@@ -3205,14 +3310,15 @@ struct Disseminator {
     }
 
     Actions allocate(i64 seq_no, const NetStateS &network_state) {
-        if (seq_no != ctx->cfg.ci + allocated_through)
+        if (seq_no != network_state.config->ci + allocated_through)
             throw EngineError("unexpected skip in allocate");
         Actions actions;
         allocated_through = seq_no;
-        bool reconfiguring = false;  // envelope
+        bool reconfiguring = !network_state.pending.empty();
         for (const auto &cs : network_state.clients) {
             ClientD *c = client(cs.id);
-            concat(actions, c->allocate(seq_no, cs, reconfiguring));
+            concat(actions,
+                   c->allocate(seq_no, cs, reconfiguring, network_config->ci));
         }
         led_refresh_bounds();
         for (i32 node : ctx->cfg.nodes) {
@@ -3313,13 +3419,14 @@ struct ProposalBucket {
 struct Proposer {
     const Ctx *ctx;
     InitParms my_config;
+    i64 nb;  // TOTAL bucket count under the active config
     std::map<i64, ProposalBucket> proposal_buckets;
     shared_ptr<AppendList<CRNP>> ready_iterator;
 
     Proposer(const Ctx *c, i64 base_checkpoint, InitParms mc,
              shared_ptr<AppendList<CRNP>> ready_list,
              const std::map<i64, i32> &buckets)
-        : ctx(c), my_config(mc) {
+        : ctx(c), my_config(mc), nb((i64)buckets.size()) {
         for (const auto &pr : buckets) {
             if (pr.second != mc.id) continue;
             ProposalBucket b;
@@ -3337,8 +3444,7 @@ struct Proposer {
         while (ready_iterator->has_next()) {
             CRNP crn = ready_iterator->next();
             if (crn->committed) continue;
-            i64 bucket_id =
-                (crn->client_id + crn->req_no) % ctx->cfg.nb;
+            i64 bucket_id = (crn->client_id + crn->req_no) % nb;
             auto it = proposal_buckets.find(bucket_id);
             if (it == proposal_buckets.end()) continue;
             ProposalBucket &bucket = it->second;
@@ -3481,9 +3587,13 @@ struct CommitState {
         Actions actions;
         actions.push_back(act_state_applied(low_watermark, active_state));
 
-        i64 ci = ctx->cfg.ci;
-        // pending_reconfigurations: always empty in the envelope
-        stop_at_seq_no = last_c->seq + 2 * ci;
+        i64 ci = active_state->config->ci;
+        if (active_state->pending.empty())
+            stop_at_seq_no = last_c->seq + 2 * ci;
+        else
+            // Mid-reconfiguration: ordering halts at the next checkpoint,
+            // which is where the pending reconfiguration will apply.
+            stop_at_seq_no = low_watermark + ci;
         last_applied_commit = last_c->seq;
         highest_commit = last_c->seq;
         lower_half_commits.assign((size_t)ci, nullptr);
@@ -3543,11 +3653,16 @@ struct CommitState {
     }
 
     Actions apply_checkpoint_result(i64 seq_no, i32 value, NetStateP ns) {
-        i64 ci = ctx->cfg.ci;
+        i64 ci = active_state->config->ci;
         if (transferring) return Actions();
         if (seq_no != low_watermark + ci)
             throw EngineError("stale checkpoint result");
-        stop_at_seq_no = seq_no + 2 * ci;  // no reconfigurations in envelope
+        bool completing_reconfiguration = !active_state->pending.empty();
+        if (ns->pending.empty() && !completing_reconfiguration)
+            stop_at_seq_no = seq_no + 2 * ci;
+        // else: a reconfiguration is pending (don't order past the next
+        // checkpoint) or this checkpoint just applied one (the epoch ends
+        // here; the machine reinitializes under the new config).
         active_state = ns;
         lower_half_commits = std::move(upper_half_commits);
         upper_half_commits.assign((size_t)ci, nullptr);
@@ -3578,7 +3693,7 @@ struct CommitState {
                 throw EngineError("out-of-order commit");
             highest_commit = q_entry->seq;
         }
-        i64 ci = ctx->cfg.ci;
+        i64 ci = active_state->config->ci;
         auto [commits, offset] = slot(q_entry->seq, ci);
         QEntryP &existing = (*commits)[offset];
         if (existing) {
@@ -3593,10 +3708,12 @@ struct CommitState {
     Actions drain();
 };
 
-// next_network_config (commitstate.py:141-182) — no reconfigurations.
-shared_ptr<const vector<ClientStateS>> next_client_states(
-    const NetStateS &starting_state,
-    std::map<i64, CommittingClient> &committing_clients) {
+// next_network_config (commitstate.py:141-182): roll every client window
+// forward, then apply any pending reconfigurations.
+std::pair<NetCfgP, shared_ptr<const vector<ClientStateS>>>
+next_network_config(const NetStateS &starting_state,
+                    std::map<i64, CommittingClient> &committing_clients) {
+    NetCfgP next_config = starting_state.config;
     auto out = std::make_shared<vector<ClientStateS>>();
     for (const auto &old_client : starting_state.clients) {
         auto it = committing_clients.find(old_client.id);
@@ -3604,11 +3721,29 @@ shared_ptr<const vector<ClientStateS>> next_client_states(
             throw EngineError("no committing client instance");
         out->push_back(it->second.create_checkpoint_state());
     }
-    return out;
+    for (const auto &reconfig : starting_state.pending) {
+        if (reconfig.t == ReconfigS::NewClient) {
+            out->push_back(
+                ClientStateS{reconfig.id, reconfig.width, 0, 0, string()});
+        } else if (reconfig.t == ReconfigS::RemoveClient) {
+            bool found = false;
+            for (size_t i = 0; i < out->size(); i++)
+                if ((*out)[i].id == reconfig.id) {
+                    out->erase(out->begin() + (std::ptrdiff_t)i);
+                    found = true;
+                    break;
+                }
+            if (!found)
+                throw EngineError("asked to remove a client which doesn't exist");
+        } else {
+            next_config = reconfig.config;
+        }
+    }
+    return {std::move(next_config), std::move(out)};
 }
 
 Actions CommitState::drain() {
-    i64 ci = ctx->cfg.ci;
+    i64 ci = active_state->config->ci;
     // Fast path (commitstate.py:370-384).
     i64 lac = last_applied_commit;
     if (lac < low_watermark + 2 * ci &&
@@ -3620,10 +3755,11 @@ Actions CommitState::drain() {
     Actions actions;
     while (last_applied_commit < low_watermark + 2 * ci) {
         if (last_applied_commit == low_watermark + ci && !checkpoint_pending) {
-            auto client_configs =
-                next_client_states(*active_state, committing_clients);
-            actions.push_back(
-                act_checkpoint(last_applied_commit, client_configs));
+            auto [network_config, client_configs] =
+                next_network_config(*active_state, committing_clients);
+            actions.push_back(act_checkpoint(
+                last_applied_commit, std::move(network_config),
+                std::move(client_configs)));
             checkpoint_pending = true;
         }
         i64 next_commit = last_applied_commit + 1;
@@ -3850,10 +3986,10 @@ struct AllOutstandingReqs {
     std::map<i64, std::map<i64, ClientOutstandingReqs>> buckets;
 
     AllOutstandingReqs(shared_ptr<AppendList<AckS>> available_list,
-                       const NetStateS &network_state, const Ctx *ctx) {
+                       const NetStateS &network_state) {
         available_list->reset_iterator();
         available_iterator = std::move(available_list);
-        i64 num_buckets = ctx->cfg.nb;
+        i64 num_buckets = network_state.config->nb;
         for (i64 bucket = 0; bucket < num_buckets; bucket++) {
             auto &clients = buckets[bucket];
             for (const auto &client : network_state.clients) {
@@ -3969,11 +4105,12 @@ struct ActiveEpoch {
         : ctx(c), epoch_config(ecfg), my_config(mc), persisted(p),
           commit_state(cs) {
         i64 starting_seq_no = cs->highest_commit;
+        const NetConfigS &net_cfg = *cs->active_state->config;
         outstanding_reqs = std::make_shared<AllOutstandingReqs>(
-            client_tracker->available_list, *cs->active_state, c);
-        buckets = assign_buckets(ecfg, c->cfg);
+            client_tracker->available_list, *cs->active_state);
+        buckets = assign_buckets(ecfg, net_cfg);
         nb = (i64)buckets.size();
-        ci = c->cfg.ci;
+        ci = net_cfg.ci;
         for (i64 b = 0; b < nb; b++)
             if (buckets[b] == mc.id) owned_buckets.push_back(b);
         lowest_unallocated.assign((size_t)nb, 0);
@@ -4440,9 +4577,8 @@ struct EpochChangeVotes {
 
 // construct_new_epoch_config (statemachine/stateless.py:164-315).
 NewEpochCfgP construct_new_epoch_config(
-    const Ctx *ctx, const vector<i32> &new_leaders,
+    const Ctx *ctx, const NetConfigS &config, const vector<i32> &new_leaders,
     const std::map<i32, ParsedECP> &epoch_changes) {
-    const NetConfigS &config = ctx->cfg;
     // (seq, value) -> supporters, insertion-ordered.
     vector<std::pair<std::pair<i64, i32>, vector<i32>>> checkpoint_supporters;
     i64 new_epoch_number = 0;
@@ -4613,6 +4749,7 @@ struct EpochTarget {
     ClientTracker *client_tracker;
     Disseminator *client_hash_disseminator;
     BatchTracker *batch_tracker;
+    NetCfgP network_config;  // the active consensused config at creation
     InitParms my_config;
     // digest state per EC content: (digest | -1 pending | -2 fresh,
     // waiting (source, origin) pairs).  The content-keyed map is the
@@ -4640,10 +4777,11 @@ struct EpochTarget {
 
     EpochTarget(const Ctx *c, i64 num, PersistedLog *p, NodeBuffers *nbufs,
                 CommitState *cs, ClientTracker *ct, Disseminator *dis,
-                BatchTracker *bt, InitParms mc)
+                BatchTracker *bt, NetCfgP ncfg, InitParms mc)
         : ctx(c), commit_state(cs), number(num), persisted(p),
           node_buffers(nbufs), client_tracker(ct),
-          client_hash_disseminator(dis), batch_tracker(bt), my_config(mc) {
+          client_hash_disseminator(dis), batch_tracker(bt),
+          network_config(std::move(ncfg)), my_config(mc) {
         is_primary = num % (i64)c->cfg.nodes.size() == mc.id;
         for (i32 node : c->cfg.nodes) {
             MsgBuffer mb;
@@ -4665,8 +4803,8 @@ struct EpochTarget {
     MsgP construct_new_epoch(const vector<i32> &new_leaders) {
         if ((i64)strong_changes.size() < ctx->iq)
             throw EngineError("need more acked epoch changes");
-        NewEpochCfgP new_config =
-            construct_new_epoch_config(ctx, new_leaders, strong_changes);
+        NewEpochCfgP new_config = construct_new_epoch_config(
+            ctx, *network_config, new_leaders, strong_changes);
         if (!new_config) return nullptr;
         auto m = std::make_shared<MsgS>();
         m->t = MT::NewEpoch;
@@ -4690,7 +4828,8 @@ struct EpochTarget {
             epoch_changes.emplace(remote.first, parsed);
         }
         NewEpochCfgP reconstructed = construct_new_epoch_config(
-            ctx, leader_new_epoch->necfg->config.leaders, epoch_changes);
+            ctx, *network_config, leader_new_epoch->necfg->config.leaders,
+            epoch_changes);
         if (!reconstructed || !(*reconstructed == *leader_new_epoch->necfg))
             return;  // byzantine primary
         state = ETS::FETCHING;
@@ -4750,8 +4889,15 @@ struct EpochTarget {
         state = ETS::ECHOING;
         if (nec.cp_seq == commit_state->stop_at_seq_no &&
             !nec.final_preprepares.empty())
+            // Provably unreachable among correct nodes (see the proof in
+            // epoch_target.py fetch_new_epoch_state / docs/Divergences.md
+            // #9): window extension never passes stop_at, so A2 support
+            // for a batch past a halted boundary needs f+1 byzantine
+            // attestations, and verify_new_epoch_state's reconstruction
+            // rejects a fabricated carryover before FETCHING.
             throw EngineError(
-                "fastengine: new-epoch spanning a reconfiguration boundary");
+                "verified NewEpoch carries batches past a reconfiguration "
+                "boundary (impossible for <= f byzantine nodes)");
 
         concat(actions,
                persisted->append(pe_n(nec.cp_seq + 1, nec.config)));
@@ -4773,7 +4919,7 @@ struct EpochTarget {
             q->dig = digest;
             q->reqs = batch->request_acks;
             concat(actions, persisted->append(pe_q(q)));
-            if (seq_no % ctx->cfg.ci == 0 &&
+            if (seq_no % network_config->ci == 0 &&
                 seq_no < commit_state->stop_at_seq_no)
                 concat(actions,
                        persisted->append(pe_n(seq_no + 1, nec.config)));
@@ -5123,13 +5269,17 @@ struct EpochTracker {
     i64 ticks_out_of_correct_epoch = 0;
     bool needs_state_transfer = false;  // mirror of epoch_tracker.py's flag
 
+    NetCfgP network_config;  // refreshed from the commit state's active state
+
     shared_ptr<EpochTarget> new_target(i64 number) {
         return std::make_shared<EpochTarget>(
             ctx, number, persisted, node_buffers, commit_state, client_tracker,
-            client_hash_disseminator, batch_tracker, my_config);
+            client_hash_disseminator, batch_tracker, network_config,
+            my_config);
     }
 
     Actions reinitialize() {
+        network_config = commit_state->active_state->config;
         for (i32 node : ctx->cfg.nodes) {
             if (!future_msgs.count(node)) {
                 MsgBuffer mb;
@@ -5169,7 +5319,7 @@ struct EpochTracker {
             // epoch_tracker.py:163-181.
             current_epoch = new_target(last_n->epoch_config.number);
             i64 starting_seq_no = highest_preprepared + 1;
-            i64 ci = ctx->cfg.ci;
+            i64 ci = network_config->ci;
             while (starting_seq_no % ci != 1) {
                 // Advance to the first sequence after some checkpoint, so
                 // we never re-consent on sequences we already consented on.
@@ -5208,7 +5358,7 @@ struct EpochTracker {
         if (!parsed) throw EngineError("own epoch change failed to parse");
         current_epoch = new_target(last_ec_num);
         current_epoch->my_epoch_change = parsed;
-        current_epoch->my_leader_choice = ctx->cfg.nodes;  // all nodes lead
+        current_epoch->my_leader_choice = network_config->nodes;  // all lead
         current_epoch->have_leader_choice = true;
 
         for (i32 node : ctx->cfg.nodes) {
@@ -5444,8 +5594,34 @@ struct Machine {
     }
 
     Actions complete_pending_reconfiguration() {
-        // Envelope: no reconfigurations ever appear in the log.
-        return Actions();
+        // Close the epoch at a reconfiguration boundary (machine.py:151-194):
+        // when the checkpoint APPLYING a pending reconfiguration is
+        // persisted (its predecessor CEntry still carries the pending list)
+        // but no FEntry follows it yet, append the FEntry ending the
+        // current epoch config.
+        const PersistEntS *prev_c = nullptr, *last_c = nullptr;
+        const EpochCfgS *last_epoch_config = nullptr;
+        bool f_after_last_c = false;
+        for (const auto &pr : persisted->entries) {
+            const PersistEntS &e = *pr.second;
+            if (e.t == PET::C) {
+                prev_c = last_c;
+                last_c = &e;
+                f_after_last_c = false;
+            } else if (e.t == PET::F) {
+                f_after_last_c = true;
+                last_epoch_config = &e.epoch_config;
+            } else if (e.t == PET::N) {
+                last_epoch_config = &e.epoch_config;
+            }
+        }
+        if (!last_c || !prev_c || f_after_last_c ||
+            prev_c->netstate->pending.empty())
+            return Actions();
+        if (!last_epoch_config)
+            throw EngineError(
+                "reconfiguration completed with no epoch config in the log");
+        return persisted->append(pe_f(*last_epoch_config));
     }
 
     Actions recover_log() {
@@ -5494,12 +5670,22 @@ struct Machine {
         Actions actions;
         NetStateP ns = result.netstate();
         if (result.a < commit_state->low_watermark) return actions;
-        i64 expected = commit_state->low_watermark + ctx->cfg.ci;
+        i64 expected = commit_state->low_watermark +
+                       commit_state->active_state->config->ci;
         if (expected != result.a)
             throw EngineError("checkpoint results must be one interval after the last");
+        bool completing_reconfiguration =
+            !commit_state->active_state->pending.empty();
         i64 prev_stop = commit_state->stop_at_seq_no;
         concat(actions, commit_state->apply_checkpoint_result(
                             result.a, result.digest, ns));
+        if (completing_reconfiguration && !commit_state->transferring) {
+            // This checkpoint applied a reconfiguration: the epoch ends
+            // here; reinitialize under the new network state (the FEntry
+            // flow of reference docs/LogMovement.md).
+            concat(actions, reinitialize());
+            return actions;
+        }
         if (prev_stop < commit_state->stop_at_seq_no) {
             client_tracker->allocate(*ns);
             concat(actions, client_hash_disseminator->allocate(result.a, *ns));
@@ -5557,7 +5743,7 @@ struct Machine {
         if (checkpoint_tracker->state == CheckpointState_::GARBAGE_COLLECTABLE) {
             i64 new_low = checkpoint_tracker->garbage_collect();
             concat(actions, persisted->truncate(new_low));
-            i64 ci = ctx->cfg.ci;
+            i64 ci = checkpoint_tracker->net_cfg->ci;
             if (new_low > ci) batch_tracker->truncate(new_low - ci);
             concat(actions, epoch_tracker->move_low_watermark(new_low));
         }
@@ -5777,6 +5963,10 @@ struct AppState {
     vector<i64> state_transfers;
     vector<i64> transfer_failures;
     vector<i64> transfer_attempt_times;
+    // Reconfiguration points (engine-global) + this replica's accumulated
+    // pending reconfigurations since its last snap.
+    const vector<std::tuple<i64, i64, ReconfigS>> *reconfig_points = nullptr;
+    vector<ReconfigS> pending;
 
     const string &active_hash_digest() {
         AppChainNode &cur = chain->nodes[(size_t)chain_id];
@@ -5787,18 +5977,23 @@ struct AppState {
         return cur.digest;
     }
 
-    // snap() -> value interner id.
-    i32 snap(Interner &intern, const vector<ClientStateS> &client_states) {
+    // snap() -> value interner id.  Consumes pending reconfigurations into
+    // the checkpointed network state (testengine NodeState.snap).
+    i32 snap(Interner &intern, NetCfgP config,
+             const vector<ClientStateS> &client_states) {
         checkpoint_seq_no = last_seq_no;
         auto ns = std::make_shared<NetStateS>();
+        ns->config = std::move(config);
         ns->clients = client_states;
+        ns->pending = std::move(pending);
+        pending.clear();
         checkpoint_state = ns;
         checkpoint_hash = active_hash_digest();
         // The value embeds the (per-replica-encoded) network state, so the
         // snap transition is keyed by the value id: replicas snapping the
         // same state at the same position converge on one chain node.
         string value = checkpoint_hash;
-        ctx->wire.net_state(value, ctx->cfg, *ns);
+        ctx->wire.net_state(value, *ns);
         i32 vid = intern.put(value);
         AppChainNode &cur = chain->nodes[(size_t)chain_id];
         auto it = cur.snap_next.find(vid);
@@ -5846,6 +6041,11 @@ struct AppState {
         for (const auto &request : batch.reqs) {
             i64 &slot = committed_reqs[request.client];
             if (request.reqno + 1 > slot) slot = request.reqno + 1;
+            if (reconfig_points)
+                for (const auto &point : *reconfig_points)
+                    if (std::get<0>(point) == request.client &&
+                        std::get<1>(point) == request.reqno)
+                        pending.push_back(std::get<2>(point));
         }
         chain_id = nid;
     }
@@ -6167,6 +6367,9 @@ struct Engine {
     std::unordered_map<string, i32> wave_memo;
     // Cluster-shared app hash-chain DAG (see AppChain above).
     AppChain app_chain;
+    // Reconfiguration points: (client_id, req_no, reconfiguration) applied
+    // by every replica's app when that request commits.
+    vector<std::tuple<i64, i64, ReconfigS>> reconfig_points;
     // Cluster-shared ack-wave ledger (see AckLedger above); enabled when
     // link latency is uniform (so send order == arrival order).
     AckLedger ack_ledger;
@@ -6253,7 +6456,9 @@ struct Engine {
         node.state.ctx = &ctx;
         node.state.req_store = &node.req_store;
         node.state.chain = &app_chain;
-        i32 checkpoint_value = node.state.snap(ctx.intern, init_clients);
+        node.state.reconfig_points = &reconfig_points;
+        i32 checkpoint_value =
+            node.state.snap(ctx.intern, ctx.cfg_p, init_clients);
         register_snap(checkpoint_value, node.state);
         auto ns = node.state.checkpoint_state;
         node.wal.entries.clear();
@@ -6439,7 +6644,8 @@ struct Engine {
                 committed_ops += (i64)q->reqs.size();
                 note_commits(node, *q);
             } else if (action.t == AT::Checkpoint) {
-                i32 value = node.state.snap(ctx.intern, *action.cstates());
+                i32 value =
+                    node.state.snap(ctx.intern, action.cfg, *action.cstates());
                 register_snap(value, node.state);
                 refresh_node_ready(node);
                 EventS e;
@@ -6882,8 +7088,10 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
     PyObject *net_tuple, *client_states, *client_specs, *node_specs;
     PyObject *mangler = Py_None;
     long long random_seed = 0;
-    if (!PyArg_ParseTuple(args, "OOOO|OL", &net_tuple, &client_states,
-                          &client_specs, &node_specs, &mangler, &random_seed))
+    PyObject *reconfig_points = Py_None;
+    if (!PyArg_ParseTuple(args, "OOOO|OLO", &net_tuple, &client_states,
+                          &client_specs, &node_specs, &mangler, &random_seed,
+                          &reconfig_points))
         return nullptr;
     auto *engine = new Engine();
     try {
@@ -7142,6 +7350,59 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
             }
         }
 
+        // Reconfiguration points: (client_id, req_no, desc) where desc is
+        // ("new_client", id, width) | ("remove_client", id) |
+        // ("new_config", (nodes...), ci, mel, nb, f).  Envelope: a new
+        // config must keep the node set, f, and checkpoint interval.
+        if (reconfig_points != Py_None) {
+            Py_ssize_t nr = PySequence_Size(reconfig_points);
+            if (nr < 0) throw EngineError("bad reconfig points");
+            for (Py_ssize_t i = 0; i < nr; i++) {
+                PyRef pt(PySequence_GetItem(reconfig_points, i));
+                if (!pt) throw EngineError("bad reconfig point");
+                i64 client_id = get_i64(pt.p, 0);
+                i64 req_no = get_i64(pt.p, 1);
+                PyRef desc(PySequence_GetItem(pt.p, 2));
+                if (!desc) throw EngineError("bad reconfig descriptor");
+                PyRef kind_obj(PySequence_GetItem(desc.p, 0));
+                const char *kind_s =
+                    kind_obj ? PyUnicode_AsUTF8(kind_obj.p) : nullptr;
+                if (!kind_s) throw EngineError("bad reconfig kind");
+                string rk(kind_s);
+                ReconfigS r{};
+                if (rk == "new_client") {
+                    r.t = ReconfigS::NewClient;
+                    r.id = get_i64(desc.p, 1);
+                    r.width = get_i64(desc.p, 2);
+                } else if (rk == "remove_client") {
+                    r.t = ReconfigS::RemoveClient;
+                    r.id = get_i64(desc.p, 1);
+                } else if (rk == "new_config") {
+                    r.t = ReconfigS::NewConfig;
+                    auto cfg = std::make_shared<NetConfigS>();
+                    PyRef nodes_obj(PySequence_GetItem(desc.p, 1));
+                    if (!nodes_obj) throw EngineError("bad new-config nodes");
+                    Py_ssize_t nn = PySequence_Size(nodes_obj.p);
+                    for (Py_ssize_t j = 0; j < nn; j++)
+                        cfg->nodes.push_back((i32)get_i64(nodes_obj.p, j));
+                    cfg->ci = get_i64(desc.p, 2);
+                    cfg->mel = get_i64(desc.p, 3);
+                    cfg->nb = get_i64(desc.p, 4);
+                    cfg->f = get_i64(desc.p, 5);
+                    if (cfg->nodes != engine->ctx.cfg.nodes ||
+                        cfg->f != engine->ctx.cfg.f ||
+                        cfg->ci != engine->ctx.cfg.ci)
+                        throw EngineError(
+                            "reconfig changing nodes/f/ci outside envelope");
+                    r.config = std::move(cfg);
+                } else {
+                    throw EngineError("unknown reconfiguration kind");
+                }
+                engine->reconfig_points.emplace_back(client_id, req_no,
+                                                     std::move(r));
+            }
+        }
+
         // Seed node worlds + initialize events (Recorder.recording()).
         for (i64 i = 0; i < n_nodes; i++) {
             engine->init_node_world((i32)i, engine->ctx.init_clients);
@@ -7213,6 +7474,14 @@ PyObject *engine_stats(PyObject *self, PyObject *) {
                          (long long)e->queue.fake_time,
                          (long long)e->committed_ops,
                          (double)e->crypto_ns / 1e9);
+}
+
+// drain_state() -> (nodes_not_ready, clients_unsatisfied): the two halves
+// of the drain predicate, for condition-bounded runs (bench config 5).
+PyObject *engine_drain_state(PyObject *self, PyObject *) {
+    Engine *e = ((PyEngine *)self)->engine;
+    return Py_BuildValue("LL", (long long)e->nodes_not_ready,
+                         (long long)e->clients_unsatisfied);
 }
 
 // node_summary(i) -> (checkpoint_seq_no, checkpoint_hash, epoch,
@@ -7482,6 +7751,7 @@ PyMethodDef engine_methods[] = {
     {"supply_verdicts", engine_supply_verdicts, METH_VARARGS, nullptr},
     {"set_device_modes", engine_set_device_modes, METH_VARARGS, nullptr},
     {"stats", engine_stats, METH_NOARGS, nullptr},
+    {"drain_state", engine_drain_state, METH_NOARGS, nullptr},
     {"node_summary", engine_node_summary, METH_VARARGS, nullptr},
     {"set_fail_transfers", engine_set_fail_transfers, METH_VARARGS, nullptr},
     {"node_transfers", engine_node_transfers, METH_VARARGS, nullptr},

@@ -301,12 +301,32 @@ class EpochTarget:
             new_epoch_config.starting_checkpoint.seq_no == self.commit_state.stop_at_seq_no
             and new_epoch_config.final_preprepares
         ):
-            # Reconfiguration boundary: the checkpoint is necessarily stable
-            # and we must reinitialize under the new network config before
-            # processing further.  The reference leaves this unresolved
-            # (panic "deal with this", epoch_target.go:333).
-            raise NotImplementedError(
-                "new-epoch spanning a reconfiguration boundary"
+            # A verified NewEpoch carrying batches past a halted boundary is
+            # unreachable for this machine; the reference leaves the spot
+            # unresolved (panic "deal with this", epoch_target.go:333), but
+            # the condition is provably vacuous among correct nodes:
+            #
+            # 1. Window extension is capped at stop_at_seq_no
+            #    (epoch_active.advance), so no correct node ever persists a
+            #    P/QEntry for a sequence past a halted checkpoint — halting
+            #    only happens at a reconfiguration's applying checkpoint.
+            # 2. construct_new_epoch_config emits a non-empty
+            #    final_preprepares only if some digest past the starting
+            #    checkpoint satisfies condition A2 — a weak quorum (f+1) of
+            #    epoch changes carrying that Q-entry.  By (1) at most the f
+            #    byzantine nodes can attest such entries: A2 cannot pass.
+            # 3. A byzantine primary cannot fabricate the carryover either:
+            #    verify_new_epoch_state re-runs construct_new_epoch_config
+            #    over our locally-acked epoch changes, so a NewEpoch that
+            #    violates (2) never reaches FETCHING.
+            #
+            # Reaching this point therefore means local state corruption —
+            # fail loudly rather than order past a reconfiguration boundary
+            # under the old configuration.  docs/Divergences.md #9.
+            raise AssertionError(
+                "verified NewEpoch carries batches past a reconfiguration "
+                "boundary: impossible for <= f byzantine nodes (see proof "
+                "in epoch_target.fetch_new_epoch_state)"
             )
 
         actions.concat(

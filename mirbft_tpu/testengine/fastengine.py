@@ -109,6 +109,43 @@ def _compile_mangler(mangler):
     return ("generic", mangler.wrap, tuple(preds), action, value, restart_parms)
 
 
+def _compile_reconfig_points(points, net):
+    """Compile ReconfigPoints into native descriptors.
+
+    Envelope: NewClient/RemoveClient freely; NewConfig may change
+    number_of_buckets / max_epoch_length but must keep the node set, f,
+    and checkpoint interval (the engine fixes those engine-wide)."""
+    from ..messages import (
+        ReconfigNewClient,
+        ReconfigNewConfig,
+        ReconfigRemoveClient,
+    )
+
+    out = []
+    for point in points:
+        r = point.reconfiguration
+        if isinstance(r, ReconfigNewClient):
+            desc = ("new_client", r.id, r.width)
+        elif isinstance(r, ReconfigRemoveClient):
+            desc = ("remove_client", r.id)
+        elif isinstance(r, ReconfigNewConfig):
+            c = r.config
+            _require(
+                tuple(c.nodes) == tuple(net.nodes)
+                and c.f == net.f
+                and c.checkpoint_interval == net.checkpoint_interval,
+                "reconfiguration changing nodes/f/checkpoint-interval",
+            )
+            desc = (
+                "new_config", tuple(c.nodes), c.checkpoint_interval,
+                c.max_epoch_length, c.number_of_buckets, c.f,
+            )
+        else:
+            _require(False, f"reconfiguration kind {type(r).__name__}")
+        out.append((point.client_id, point.req_no, desc))
+    return tuple(out)
+
+
 class _NodeFinal:
     """Final-state view of one node (mirrors the attributes asserts use)."""
 
@@ -163,7 +200,6 @@ class FastRecording:
                 mangler_desc is None or mangler_desc[0] == "drop",
                 "generic manglers with device-paced modes",
             )
-        _require(not recorder.reconfig_points, "reconfiguration")
         _require(recorder.event_log_writer is None, "event log interception")
         # defer_unready makes the Python engine's step counts wall-clock
         # dependent (extra re-scheduled hash events); the fast engine hashes
@@ -177,6 +213,7 @@ class FastRecording:
             tuple(net.nodes) == tuple(range(spec.node_count)),
             "non-dense node ids",
         )
+        reconfig_desc = _compile_reconfig_points(recorder.reconfig_points, net)
 
         self.spec = spec
         self.device = device
@@ -256,7 +293,7 @@ class FastRecording:
             (spec.node_count, net.checkpoint_interval, net.max_epoch_length,
              net.number_of_buckets, net.f),
             client_states, client_specs, node_specs, mangler_desc,
-            recorder.random_seed,
+            recorder.random_seed, reconfig_desc or None,
         )
         if device_authoritative or streaming_auth:
             self._engine.set_device_modes(
@@ -555,6 +592,46 @@ class FastRecording:
             pub, payloads, have = self._stream_clients[cid]
             self._stream_clients[cid] = (pub, payloads, have + count)
 
+    def run_slice(self, max_steps: int, timeout: int = 10**15) -> bool:
+        """Run up to ``max_steps`` simulation steps (servicing device pauses
+        as needed); returns True once the full drain predicate holds.  For
+        condition-bounded runs that stop on weaker conditions than a full
+        drain (bench config 5)."""
+        executed = 0
+        while executed < max_steps:
+            try:
+                ran, done, timed_out, need_device = self._engine.run(
+                    max_steps - executed, timeout
+                )
+            except RuntimeError as exc:
+                raise FastEngineUnsupported(str(exc)) from exc
+            executed += ran
+            self._drain_hash_log()
+            if timed_out:
+                self._collect_inflight()
+                raise TimeoutError(
+                    f"fast engine timed out after {self.stats()[0]} steps"
+                )
+            if done:
+                self._finalize()
+                return True
+            if need_device:
+                self._serve_device_work()
+        return False
+
+    def clients_unsatisfied(self) -> int:
+        """Clients whose full request set has not committed anywhere yet
+        (corrupt clients have a zero target and never count)."""
+        return self._engine.drain_state()[1]
+
+    def _finalize(self) -> None:
+        self._collect_inflight()
+        self.steps = self._engine.stats()[0]
+        self.nodes = [
+            _NodeFinal(self._engine.node_summary(i))
+            for i in range(self.spec.node_count)
+        ]
+
     def drain_clients(self, timeout: int, slice_steps: int = 200_000) -> int:
         """Run until every client's requests commit on every node; returns
         the step count (bit-identical to the Python engine's)."""
@@ -578,12 +655,7 @@ class FastRecording:
                 )
             if need_device:
                 self._serve_device_work()
-        self._collect_inflight()
-        self.steps = self._engine.stats()[0]
-        self.nodes = [
-            _NodeFinal(self._engine.node_summary(i))
-            for i in range(self.spec.node_count)
-        ]
+        self._finalize()
         return self.steps
 
     def stats(self) -> Tuple[int, int, int]:

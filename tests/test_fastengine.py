@@ -288,22 +288,27 @@ def test_unsupported_configs_raise():
     with pytest.raises(FastEngineUnsupported):
         FastRecording(spec)
 
-    # Reconfiguration is still outside the envelope.
-    from mirbft_tpu.messages import ReconfigNewClient
+    # A reconfiguration changing the node set stays outside the envelope.
+    import dataclasses
+
+    from mirbft_tpu.messages import ReconfigNewConfig
     from mirbft_tpu.testengine.recorder import ReconfigPoint
 
     spec = Spec(node_count=4, client_count=1, reqs_per_client=1)
 
-    def add_reconfig(recorder):
+    def add_node_reconfig(recorder):
+        cfg = dataclasses.replace(
+            recorder.network_state.config, nodes=(0, 1, 2, 3, 4)
+        )
         recorder.reconfig_points = [
             ReconfigPoint(
                 client_id=0,
                 req_no=0,
-                reconfiguration=ReconfigNewClient(id=4, width=100),
+                reconfiguration=ReconfigNewConfig(config=cfg),
             )
         ]
 
-    spec.tweak_recorder = add_reconfig
+    spec.tweak_recorder = add_node_reconfig
     with pytest.raises(FastEngineUnsupported):
         FastRecording(spec)
 
@@ -451,6 +456,135 @@ def test_late_start_transfer_differential():
     fr = FastRecording(spec)
     fr.drain_clients(timeout=100_000_000)
     assert fr.node_transfers(3)[0], "late-started node should transfer"
+
+
+def test_reconfig_add_client_differential():
+    from mirbft_tpu.messages import ReconfigNewClient
+    from mirbft_tpu.testengine.recorder import ClientConfig, ReconfigPoint
+
+    def tweak(r):
+        r.reconfig_points = [
+            ReconfigPoint(
+                client_id=0, req_no=5,
+                reconfiguration=ReconfigNewClient(id=4, width=100),
+            )
+        ]
+        r.client_configs.append(ClientConfig(id=4, total=10))
+
+    spec = Spec(node_count=4, client_count=4, reqs_per_client=20,
+                tweak_recorder=tweak)
+    _differential(spec)
+    fr = FastRecording(spec)
+    fr.drain_clients(timeout=30_000_000)
+    assert fr.nodes[0].client_low_watermarks.get(4) == 10
+
+
+def test_reconfig_remove_client_differential():
+    from mirbft_tpu.messages import ReconfigRemoveClient
+    from mirbft_tpu.testengine.recorder import ReconfigPoint
+
+    def tweak(r):
+        r.reconfig_points = [
+            ReconfigPoint(
+                client_id=3, req_no=4,
+                reconfiguration=ReconfigRemoveClient(id=3),
+            )
+        ]
+        r.client_configs[3].total = 5
+
+    spec = Spec(node_count=4, client_count=4, reqs_per_client=20,
+                tweak_recorder=tweak)
+    _differential(spec)
+
+
+def test_reconfig_new_config_differential():
+    """Changing number_of_buckets mid-run: exercises the full
+    changed-config ClientReqNo rebuild (sorted-digest quorum re-derivation)
+    and per-state config threading through the active epoch."""
+    import dataclasses
+
+    from mirbft_tpu.messages import ReconfigNewConfig
+    from mirbft_tpu.testengine.recorder import ReconfigPoint
+
+    def tweak(r):
+        cfg = dataclasses.replace(r.network_state.config, number_of_buckets=2)
+        r.reconfig_points = [
+            ReconfigPoint(
+                client_id=1, req_no=5,
+                reconfiguration=ReconfigNewConfig(config=cfg),
+            )
+        ]
+
+    spec = Spec(node_count=4, client_count=4, reqs_per_client=20,
+                tweak_recorder=tweak)
+    _differential(spec)
+
+
+def test_reconfig_with_crash_differential():
+    """A node crashes around the reconfiguration checkpoint and recovers
+    across the FEntry boundary from its WAL — both engines agree on the
+    whole evolution."""
+    from mirbft_tpu.messages import Commit, ReconfigNewClient
+    from mirbft_tpu.testengine.recorder import ClientConfig, ReconfigPoint
+
+    def tweak(r):
+        r.reconfig_points = [
+            ReconfigPoint(
+                client_id=0, req_no=5,
+                reconfiguration=ReconfigNewClient(id=4, width=100),
+            )
+        ]
+        r.client_configs.append(ClientConfig(id=4, total=10))
+        r.mangler = For(
+            matching.msgs().to_node(2).of_type(Commit).with_sequence(40)
+        ).crash_and_restart_after(500, r.node_configs[2].init_parms)
+
+    spec = Spec(node_count=4, client_count=4, reqs_per_client=20,
+                tweak_recorder=tweak)
+    _differential(spec, timeout=60_000_000)
+
+
+def test_c5_shape_differential():
+    """BASELINE config 5's scenario shape at reduced scale: 16 nodes,
+    signed requests with a byzantine signer, a mid-run reconfiguration
+    adding a signed client, and a late-started replica that must
+    state-transfer — all on one run, bit-identical across engines."""
+    import dataclasses
+
+    from mirbft_tpu.messages import ReconfigNewClient
+    from mirbft_tpu.testengine.recorder import ClientConfig, ReconfigPoint
+
+    def tweak(r):
+        cfg = dataclasses.replace(
+            r.network_state.config,
+            number_of_buckets=4,
+            checkpoint_interval=16,
+            max_epoch_length=100_000,
+        )
+        r.network_state = dataclasses.replace(r.network_state, config=cfg)
+        for nc in r.node_configs:
+            nc.init_parms = dataclasses.replace(
+                nc.init_parms, suspect_ticks=16, new_epoch_timeout_ticks=32
+            )
+        r.client_configs[3].corrupt = True
+        r.reconfig_points = [
+            ReconfigPoint(
+                client_id=0, req_no=2,
+                reconfiguration=ReconfigNewClient(id=4, width=100),
+            )
+        ]
+        r.client_configs.append(ClientConfig(id=4, total=3, signed=True))
+        r.node_configs[15].start_delay = 12_000
+
+    spec = Spec(node_count=16, client_count=4, reqs_per_client=4,
+                batch_size=4, signed_requests=True, tweak_recorder=tweak)
+    state = _differential(spec, timeout=100_000_000)
+    # byzantine client 3 never commits; added client 4 commits everywhere
+    for node in state:
+        assert node[5].get(3, 0) == 0
+    fr = FastRecording(spec)
+    fr.drain_clients(timeout=100_000_000)
+    assert fr.node_transfers(15)[0], "late replica should state-transfer"
 
 
 def test_transfer_failure_retry_differential():
