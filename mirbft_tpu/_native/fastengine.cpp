@@ -28,8 +28,13 @@
 //     state transfer (incl. app-level failure injection + retry backoff)
 //   * signed-request mode via precomputed verdicts (the device auth plane
 //     verifies envelopes; the engine consumes the verdict bitmap)
-//   * still outside: reconfiguration; device-paced modes combined with a
-//     consume-time (generic) mangler; defer_unready crypto
+//   * reconfiguration at checkpoint boundaries (add/remove client, new
+//     config changing bucket count / max epoch length), incl. crashes
+//     across the FEntry boundary — nodes, f, and checkpoint interval
+//     must stay unchanged
+//   * still outside: reconfiguration changing nodes/f/checkpoint-interval;
+//     device-paced modes combined with a consume-time (generic) mangler;
+//     defer_unready crypto
 //
 // Device crypto: protocol digests are SHA-256 over the same bytes either
 // way, so the engine hashes inline (host) and mirrors every wave-eligible

@@ -322,7 +322,7 @@ class EpochTarget:
             #
             # Reaching this point therefore means local state corruption —
             # fail loudly rather than order past a reconfiguration boundary
-            # under the old configuration.  docs/Divergences.md #9.
+            # under the old configuration.  docs/Divergences.md #12.
             raise AssertionError(
                 "verified NewEpoch carries batches past a reconfiguration "
                 "boundary: impossible for <= f byzantine nodes (see proof "

@@ -6,8 +6,11 @@ simulation in C++.  It is a bit-identical twin of the Python engine on
 supported configs (see the equivalence contract in fastengine.cpp and
 tests/test_fastengine.py), including the failure paths: DSL manglers
 (compiled to a native descriptor driving a CPython-compatible MT19937
-stream), crash-and-restart recovery, and state transfer.  Configs outside
-the envelope (reconfiguration, custom mangler actions, >256 nodes,
+stream), crash-and-restart recovery, state transfer, and reconfiguration
+at checkpoint boundaries (add/remove client, new-config changes to
+bucket count / max epoch length — nodes, f, and checkpoint interval
+unchanged).  Configs outside the envelope (reconfiguration changing
+nodes/f/checkpoint-interval, custom mangler actions, >256 nodes,
 device-paced modes combined with a consume-time mangler) raise
 ``FastEngineUnsupported`` at construction so callers can fall back.
 

@@ -390,3 +390,124 @@ def test_silenced_node_forces_epoch_change():
         n.state_machine.epoch_tracker.current_epoch.number > 0
         for n in recording.nodes[1:]
     )
+
+
+def test_epoch_change_onto_reconfig_boundary():
+    """View changes racing a pending reconfiguration (Divergences.md #12).
+
+    Integration half: Checkpoint messages for the reconfiguration's applying
+    checkpoint are heavily jittered, so the cluster suspects and runs epoch
+    changes WHILE the reconfiguration is pending (stop_at halted at the
+    applying checkpoint) — the run must complete, apply the reconfiguration,
+    and never trip the reconfiguration-boundary AssertionError in
+    ``fetch_new_epoch_state``.
+
+    Unit half: the guarded branch itself is pinned both ways on a live
+    target — a NewEpoch whose starting checkpoint IS the halted
+    ``stop_at_seq_no`` takes the echo/resume path when it carries no
+    batches, and trips the AssertionError (local-state-corruption detector,
+    replacing the reference's ``panic("deal with this")``,
+    epoch_target.go:333) when it fabricates carryover batches past the
+    halted boundary.
+    """
+    from mirbft_tpu.messages import (
+        CheckpointMsg,
+        EpochConfig,
+        NewEpoch,
+        NewEpochConfig,
+        ReconfigNewClient,
+    )
+    from mirbft_tpu.statemachine.epoch_target import EpochTargetState
+    from mirbft_tpu.testengine.recorder import ClientConfig, ReconfigPoint
+
+    spec = Spec(node_count=4, client_count=4, reqs_per_client=20)
+    recorder = spec.recorder()
+    recorder.reconfig_points = [
+        ReconfigPoint(
+            client_id=0,
+            req_no=2,
+            reconfiguration=ReconfigNewClient(id=4, width=100),
+        )
+    ]
+    recorder.client_configs.append(ClientConfig(id=4, total=10))
+    # The reconfiguration (committed before seq 20) applies at the NEXT
+    # checkpoint boundary past the already-extended watermark window — seq
+    # 40 — and stays pending until that checkpoint's result lands.
+    # Jittering the Commit attestations for seq 40 by up to 60 ticks
+    # stalls ordering at the applying boundary long enough for suspicion
+    # to fire with the reconfiguration still pending.
+    recorder.mangler = For(
+        matching.msgs().of_type(Commit).with_sequence(40)
+    ).jitter(30000)
+    recording = recorder.recording()
+    # Step manually so the race itself can be pinned: at some point an
+    # epoch change must be underway (current target not yet IN_PROGRESS)
+    # while the reconfiguration is still pending (stop_at extension
+    # halted, FEntry not yet landed).
+    raced = False
+    for _ in range(600000):
+        recording.step()
+        for n in recording.nodes:
+            sm = n.state_machine
+            tracker = sm.epoch_tracker if sm is not None else None
+            if tracker is None or tracker.current_epoch is None:
+                continue
+            target = tracker.current_epoch
+            active_state = target.commit_state.active_state
+            if (
+                target.number > 0
+                and target.state < EpochTargetState.IN_PROGRESS
+                and active_state is not None
+                and active_state.pending_reconfigurations
+            ):
+                raced = True
+        if raced:
+            break
+    recording.drain_clients(timeout=600000)
+    assert raced, (
+        "scenario lost its coverage: no epoch change was in flight while "
+        "stop_at was halted at the reconfiguration checkpoint"
+    )
+    assert_all_nodes_agree(recording)
+    for node in recording.nodes:
+        states = {
+            c.id: c.low_watermark for c in node.state.checkpoint_state.clients
+        }
+        assert states.get(4) == 10, "reconfiguration must still apply"
+
+    # --- unit pin of the boundary branch, on a live node's components ---
+    target = recording.nodes[0].state_machine.epoch_tracker.current_epoch
+    commit_state = target.commit_state
+    boundary = commit_state.low_watermark  # a stable, fully-applied checkpoint
+    commit_state.stop_at_seq_no = boundary  # the halted-reconfig shape
+    ckpt = CheckpointMsg(seq_no=boundary, value=b"\x00" * 32)
+    cfg = EpochConfig(
+        number=target.number + 1,
+        leaders=target.network_config.nodes,
+        planned_expiration=boundary + 200,
+    )
+
+    # Healthy: no carryover past the halted boundary -> echo/resume path.
+    target.state = EpochTargetState.FETCHING
+    target.leader_new_epoch = NewEpoch(
+        new_config=NewEpochConfig(
+            config=cfg, starting_checkpoint=ckpt, final_preprepares=()
+        ),
+        epoch_changes=(),
+    )
+    target.fetch_new_epoch_state()
+    assert target.state == EpochTargetState.ECHOING
+
+    # Corrupt: fabricated batches past the halted boundary -> fail loudly.
+    commit_state.highest_commit = boundary + 2  # mark them "committed"
+    target.state = EpochTargetState.FETCHING
+    target.leader_new_epoch = NewEpoch(
+        new_config=NewEpochConfig(
+            config=cfg,
+            starting_checkpoint=ckpt,
+            final_preprepares=(b"\x01" * 32, b"\x02" * 32),
+        ),
+        epoch_changes=(),
+    )
+    with pytest.raises(AssertionError, match="reconfiguration"):
+        target.fetch_new_epoch_state()
