@@ -36,7 +36,7 @@ def _build(src: str, so: str) -> bool:
     include = sysconfig.get_paths()["include"]
     tmp = so + ".tmp"
     cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
         "-I", include, src, "-o", tmp,
     ]
     try:

@@ -53,16 +53,20 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <deque>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <optional>
 #include <queue>
 #include <set>
+#include <shared_mutex>
 #include <stdexcept>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
@@ -307,12 +311,27 @@ string sha256(const string &data) {
 // ---------------------------------------------------------------------------
 
 struct Interner {
-    vector<string> vals;
+    // deque: element addresses are stable under growth, so get() may hand
+    // out references that stay valid across later put()s — including, in
+    // threaded PDES runs, references taken under the shared lock and used
+    // after it is released.
+    std::deque<string> vals;
     std::unordered_map<string, i32> ids;
+    // Set only for the duration of a THREADED PdES run; serial runs (and
+    // the sequential engine) stay lock-free.
+    std::shared_mutex *mu = nullptr;
 
     Interner() { vals.push_back(string()); ids.emplace(string(), 0); }
 
     i32 put(const string &s) {
+        if (mu) {
+            std::unique_lock<std::shared_mutex> lk(*mu);
+            return put_unlocked(s);
+        }
+        return put_unlocked(s);
+    }
+
+    i32 put_unlocked(const string &s) {
         auto it = ids.find(s);
         if (it != ids.end()) return it->second;
         i32 id = (i32)vals.size();
@@ -321,7 +340,13 @@ struct Interner {
         return id;
     }
 
-    const string &get(i32 id) const { return vals[(size_t)id]; }
+    const string &get(i32 id) const {
+        if (mu) {
+            std::shared_lock<std::shared_mutex> lk(*mu);
+            return vals[(size_t)id];
+        }
+        return vals[(size_t)id];
+    }
 };
 
 // ---------------------------------------------------------------------------
@@ -811,6 +836,19 @@ enum class SK : u8 {
 struct SimEv {
     i64 time;
     i64 ctr;
+    // Birth time (PDES runs only; docs/PERFORMANCE.md §7.1).  The
+    // sequential engine orders same-time events by a global insertion
+    // counter; a partitioned run cannot assign that counter online, but
+    // the SAME total order is reproduced by the key (time, bt, ctr) where
+    // ``bt`` is the simulated time the event was INSERTED and ``ctr`` is
+    // its rank in the global insertion sequence at that birth time
+    // (insertions happen in global processing order, which is
+    // time-monotone, so (bt, rank-at-bt) increases exactly like the
+    // sequential counter).  Ranks are provisional (partition-local,
+    // order-preserving) during a window and finalized at the barrier
+    // replay.  Sequential runs keep bt == 0 and ctr == counter++, which
+    // is the identical order.
+    i64 bt = 0;
     SK kind;
     i32 target;
     i32 src = 0;
@@ -830,6 +868,7 @@ struct SimEv {
 struct SimEvCmp {
     bool operator()(const SimEv &a, const SimEv &b) const {
         if (a.time != b.time) return a.time > b.time;
+        if (a.bt != b.bt) return a.bt > b.bt;
         return a.ctr > b.ctr;
     }
 };
@@ -1006,12 +1045,37 @@ struct EventQueue {
     i64 counter = 0;
     i64 fake_time = 0;
     std::unique_ptr<ManglerG> mangler;  // null = no consume-time mangler
+    // Birth-key stamping mode (see SimEv::bt): SEQ is the classic global
+    // counter (bt pinned to 0 — today's order, zero change); PDES stamps
+    // (bt = insertion fake_time, ctr = *prov++) with a partition-local
+    // provisional rank finalized at the window barrier; TAIL stamps
+    // (bt = fake_time, ctr = counter++) for the exact-stop sequential
+    // tail, whose births never share a bt with window-born events.
+    enum Stamp : u8 { SEQ = 0, PDES = 1, TAIL = 2 };
+    u8 stamp_mode = SEQ;
+    i64 *prov = nullptr;  // PDES provisional rank source (partition-owned)
 
     size_t size() const { return heap.size(); }
 
     void insert(SimEv ev) {
         if (ev.time < fake_time) throw EngineError("attempted to modify the past");
-        ev.ctr = counter++;
+        if (stamp_mode == SEQ) {
+            ev.bt = 0;
+            ev.ctr = counter++;
+        } else if (stamp_mode == PDES) {
+            ev.bt = fake_time;
+            ev.ctr = (*prov)++;
+        } else {
+            ev.bt = fake_time;
+            ev.ctr = counter++;
+        }
+        heap.push_back(std::move(ev));
+        std::push_heap(heap.begin(), heap.end(), SimEvCmp());
+    }
+
+    // Insert an event whose (bt, ctr) birth key is already final (barrier
+    // delivery of cross-partition messages; heap-merge for the tail).
+    void insert_stamped(SimEv ev) {
         heap.push_back(std::move(ev));
         std::push_heap(heap.begin(), heap.end(), SimEvCmp());
     }
@@ -1096,6 +1160,74 @@ struct EventQueue {
 // ---------------------------------------------------------------------------
 // Quorums / bucket math (statemachine/stateless.py).
 // ---------------------------------------------------------------------------
+
+// ---------------------------------------------------------------------------
+// PDES partition (docs/PERFORMANCE.md §4/§7.1): conservative parallel
+// discrete-event simulation over the link-latency lookahead.  Replicas are
+// partitioned across workers; each window [T, T+L) is processed partition-
+// locally (cross-partition messages cannot arrive inside it, because every
+// inter-node delivery pays link_latency >= L), and the barrier replays the
+// window's processing order to (a) finalize birth-key ranks, (b) deliver
+// cross-partition sends, (c) fold stats and drain-predicate flips in exact
+// global order.  Bit-identity contract: identical steps, fake-time, and
+// per-node state to the sequential engine (tests/test_fastengine.py).
+// ---------------------------------------------------------------------------
+
+struct Partition {
+    i32 id = 0;
+    EventQueue q;
+    i64 prov_counter = 0;  // provisional birth ranks (monotone, never reset)
+    i64 window_start = 0;  // sim-time start of the current window
+    i64 prov_base = 0;     // prov_counter at window start (resolve-map base)
+    vector<SimEv> outbox;  // cross-partition sends made this window
+
+    // One entry per event processed this window, in partition-local order
+    // (which equals global order restricted to this partition).
+    struct PLogE {
+        i64 time;        // processing time
+        i64 bt;          // processed event's birth time
+        i64 rank;        // its rank (provisional iff prov)
+        i64 prov_start;  // prov counter before processing: the event's
+                         // births are prov ids [prov_start, prov_start+births)
+        u32 births;
+        u8 prov;
+    };
+    vector<PLogE> plog;
+
+    // Drain-predicate transition candidates (kind 0 = client satisfied,
+    // kind 1 = node became drain-ready), resolved/deduped at the barrier.
+    struct Flip {
+        u32 at;  // plog index of the causing event
+        u8 kind;
+        i64 id;
+    };
+    vector<Flip> flips;
+
+    // Window stats, folded into the engine at each barrier.
+    i64 steps = 0;
+    i64 committed_ops = 0;
+    u64 crypto_ns = 0;
+    u64 work_cycles = 0;
+    // Partition-local hash memos (content-keyed; results content-equal
+    // across partitions, so locality only costs duplicate hashing).
+    std::unordered_map<string, i32> host_memo;
+    std::unordered_map<string, i32> wave_memo;
+    string error;  // threaded-mode exception capture
+};
+
+struct PdesResult {
+    i64 steps = 0;      // exact global step count (flip step or stop_steps)
+    i64 fake_time = 0;  // exact simulated time at that step
+    i64 flip_step = -1;
+    i64 flip_time = -1;
+    bool done = false;
+    bool timed_out = false;
+    i64 windows = 0;
+    u64 barrier_cycles = 0;
+    u64 sum_part_cycles = 0;
+    u64 max_part_cycles = 0;
+    i64 tail_steps = 0;
+};
 
 struct Quorums {
     i64 n, f;
@@ -6339,6 +6471,12 @@ struct EngineNode {
 struct Engine {
     Ctx ctx;
     EventQueue queue;
+    // PDES state (empty for sequential runs; see struct Partition).
+    vector<std::unique_ptr<Partition>> parts;
+    vector<i32> part_of;  // node id -> partition id
+    bool pdes_threaded = false;
+    std::shared_mutex intern_mu;  // installed on ctx.intern when threaded
+    std::mutex chain_mu, snap_mu;  // shared chain / snap registry guards
     vector<std::unique_ptr<EngineNode>> nodes;
     vector<ClientSpec> client_specs;  // in config order
     i64 steps = 0;
@@ -6413,19 +6551,24 @@ struct Engine {
     // Engine-wide hashing service (the hash plane): identical digests to
     // hashlib; wave-eligible content (multi-part or >= 512 B single part —
     // the complement of crypto.py::_host_fast) is mirrored for the device.
-    i32 hash_parts(const vector<string> &parts) {
+    // PDES runs (part != null) use partition-local memos/meters and skip
+    // the device mirror (device modes are outside the PDES envelope).
+    i32 hash_parts(const vector<string> &parts, Partition *part = nullptr) {
+        auto &h_memo = part ? part->host_memo : host_memo;
+        auto &w_memo = part ? part->wave_memo : wave_memo;
+        u64 &c_ns = part ? part->crypto_ns : crypto_ns;
         if (hash_is_host_floor(parts)) {
             // Below the wave floor (host-only content).  Memo lookup keys
             // on the part itself — no copy on the hit path.
-            auto hit = host_memo.find(parts[0]);
-            if (hit != host_memo.end()) return hit->second;
+            auto hit = h_memo.find(parts[0]);
+            if (hit != h_memo.end()) return hit->second;
             auto t0 = std::chrono::steady_clock::now();
             i32 r = ctx.intern.put(sha256(parts[0]));
-            crypto_ns += (u64)std::chrono::duration_cast<std::chrono::nanoseconds>(
+            c_ns += (u64)std::chrono::duration_cast<std::chrono::nanoseconds>(
                              std::chrono::steady_clock::now() - t0)
                              .count();
-            if (host_memo.size() > (1u << 17)) host_memo.clear();  // bounded
-            host_memo.emplace(parts[0], r);
+            if (h_memo.size() > (1u << 17)) h_memo.clear();  // bounded
+            h_memo.emplace(parts[0], r);
             return r;
         }
         string joined;
@@ -6439,20 +6582,20 @@ struct Engine {
                 throw EngineError("device digest missing at hash time");
             return dit->second;
         }
-        auto hit = wave_memo.find(joined);
-        if (hit != wave_memo.end()) return hit->second;
+        auto hit = w_memo.find(joined);
+        if (hit != w_memo.end()) return hit->second;
         auto t0 = std::chrono::steady_clock::now();
         string digest = sha256(joined);
         i32 did = ctx.intern.put(digest);
-        crypto_ns += (u64)std::chrono::duration_cast<std::chrono::nanoseconds>(
+        c_ns += (u64)std::chrono::duration_cast<std::chrono::nanoseconds>(
                          std::chrono::steady_clock::now() - t0)
                          .count();
         // First sight of this wave content: mirror it for the device.  A
         // bounded-clear re-sight re-logs, which the Python side verifies
-        // again harmlessly.
-        wave_log.emplace_back(ctx.intern.put(joined), did);
-        if (wave_memo.size() > (1u << 17)) wave_memo.clear();  // bounded
-        wave_memo.emplace(std::move(joined), did);
+        // again harmlessly.  (PDES: no device plane, no mirror.)
+        if (!part) wave_log.emplace_back(ctx.intern.put(joined), did);
+        if (w_memo.size() > (1u << 17)) w_memo.clear();  // bounded
+        w_memo.emplace(std::move(joined), did);
         return did;
     }
 
@@ -6505,14 +6648,15 @@ struct Engine {
     }
 
     void schedule_proposal(i32 node_id, i64 client_id, i64 req_no,
-                           i64 delay) {
+                           i64 delay, Partition *part = nullptr) {
+        EventQueue &q = part ? part->q : queue;
         SimEv ev;
-        ev.time = queue.fake_time + delay;
+        ev.time = q.fake_time + delay;
         ev.kind = SK::ClientProposal;
         ev.target = node_id;
         ev.client = client_id;
         ev.reqno = req_no;
-        queue.insert(std::move(ev));
+        q.insert(std::move(ev));
     }
 
     Actions process_wal_actions(EngineNode &node, Actions &&actions) {
@@ -6580,7 +6724,9 @@ struct Engine {
         ctx.ack_ledger->prune(minv, min_lw);
     }
 
-    Events process_net_actions(EngineNode &node, Actions &&actions) {
+    Events process_net_actions(EngineNode &node, Actions &&actions,
+                               Partition *part = nullptr) {
+        EventQueue &q = part ? part->q : queue;
         Events events;
         u64 t0 = __rdtsc();
         auto coalesced = coalesce_sends(std::move(actions));
@@ -6590,6 +6736,7 @@ struct Engine {
             // Register broadcast ack waves in the cluster ledger at send
             // time (send order == arrival order under uniform latency), so
             // receivers consume them as cursor bumps + crossing replays.
+            // (PDES runs require the ledger disabled.)
             if (ctx.ack_ledger != nullptr &&
                 (action.targets == ctx.bcast || *action.targets == *ctx.bcast)) {
                 if (m->t == MT::AckBatch || m->t == MT::AckMsg) {
@@ -6612,25 +6759,35 @@ struct Engine {
                     if (drop_mangler && drop_matches(node.id, replica))
                         continue;  // mangled away (DropMessages)
                     SimEv ev;
-                    ev.time = queue.fake_time + node.runtime.link_latency;
+                    ev.time = q.fake_time + node.runtime.link_latency;
                     ev.kind = SK::MsgReceived;
                     ev.target = replica;
                     ev.src = node.id;
                     ev.msg = m;
-                    queue.insert(std::move(ev));
+                    if (part && part_of[(size_t)replica] != part->id) {
+                        // Cross-partition send: stamp the provisional
+                        // birth key from the same monotone source as heap
+                        // inserts (the interleaved insertion order is the
+                        // global one) and hold it for barrier delivery.
+                        ev.bt = q.fake_time;
+                        ev.ctr = part->prov_counter++;
+                        part->outbox.push_back(std::move(ev));
+                    } else {
+                        q.insert(std::move(ev));
+                    }
                 }
             }
         }
         return events;
     }
 
-    Events process_hash_actions(Actions &&actions) {
+    Events process_hash_actions(Actions &&actions, Partition *part = nullptr) {
         Events events;
         for (auto &action : actions) {
             if (action.t != AT::Hash)
                 throw EngineError("unexpected Hash action type");
             HashReqP hr = action.hash();
-            i32 digest = hash_parts(hr->parts);
+            i32 digest = hash_parts(hr->parts, part);
             EventS e;
             e.t = ET::HashResult;
             e.digest = digest;
@@ -6640,19 +6797,33 @@ struct Engine {
         return events;
     }
 
-    Events process_app_actions(EngineNode &node, Actions &&actions) {
+    Events process_app_actions(EngineNode &node, Actions &&actions,
+                               Partition *part = nullptr) {
         Events events;
         for (auto &action : actions) {
             if (action.t == AT::Commit) {
                 QEntryP q = action.qentry();
-                node.state.apply(*q, ctx.intern);
-                committed_ops += (i64)q->reqs.size();
-                note_commits(node, *q);
+                if (pdes_threaded) {
+                    std::lock_guard<std::mutex> lk(chain_mu);
+                    node.state.apply(*q, ctx.intern);
+                } else {
+                    node.state.apply(*q, ctx.intern);
+                }
+                (part ? part->committed_ops : committed_ops) +=
+                    (i64)q->reqs.size();
+                note_commits(node, *q, part);
             } else if (action.t == AT::Checkpoint) {
-                i32 value =
-                    node.state.snap(ctx.intern, action.cfg, *action.cstates());
+                i32 value;
+                if (pdes_threaded) {
+                    std::lock_guard<std::mutex> lk(chain_mu);
+                    value = node.state.snap(ctx.intern, action.cfg,
+                                            *action.cstates());
+                } else {
+                    value = node.state.snap(ctx.intern, action.cfg,
+                                            *action.cstates());
+                }
                 register_snap(value, node.state);
-                refresh_node_ready(node);
+                refresh_node_ready(node, part);
                 EventS e;
                 e.t = ET::CheckpointResult;
                 e.a = action.a;
@@ -6661,8 +6832,11 @@ struct Engine {
                 events.push_back(std::move(e));
             } else if (action.t == AT::StateTransfer) {
                 // NodeState.transfer_to (testengine/recorder.py:189-206)
-                // with the engine's app-level failure injection.
-                node.state.transfer_attempt_times.push_back(queue.fake_time);
+                // with the engine's app-level failure injection.  (Reachable
+                // in PDES runs too: a lagging replica may transfer even on
+                // the green path, hence the snap-registry lock below.)
+                node.state.transfer_attempt_times.push_back(
+                    (part ? part->q : queue).fake_time);
                 i64 seq = action.a;
                 i32 value = (i32)action.b;
                 if (node.state.fail_transfers > 0) {
@@ -6675,6 +6849,9 @@ struct Engine {
                     events.push_back(std::move(e));
                     continue;
                 }
+                std::unique_lock<std::mutex> snap_lk(snap_mu,
+                                                     std::defer_lock);
+                if (pdes_threaded) snap_lk.lock();
                 auto sit = snap_registry.find(value);
                 if (sit == snap_registry.end())
                     throw EngineError(
@@ -6686,7 +6863,7 @@ struct Engine {
                 node.state.checkpoint_hash =
                     ctx.intern.get(value).substr(0, 32);
                 node.state.chain_id = sit->second.first;
-                refresh_node_ready(node);
+                refresh_node_ready(node, part);
                 EventS e;
                 e.t = ET::StateTransferComplete;
                 e.a = seq;
@@ -6706,16 +6883,27 @@ struct Engine {
     std::unordered_map<i32, std::pair<i32, NetStateP>> snap_registry;
 
     void register_snap(i32 value, const AppState &state) {
+        if (pdes_threaded) {
+            std::lock_guard<std::mutex> lk(snap_mu);
+            snap_registry.emplace(
+                value, std::make_pair(state.chain_id, state.checkpoint_state));
+            return;
+        }
         snap_registry.emplace(value,
                               std::make_pair(state.chain_id,
                                              state.checkpoint_state));
     }
 
-    Actions process_state_machine_events(EngineNode &node, Events &&events) {
+    Actions process_state_machine_events(EngineNode &node, Events &&events,
+                                         Partition *part = nullptr) {
         Actions actions;
         for (const auto &event : events) {
             if (event.t == ET::InitialParameters) {
                 node.machine->initialize(node.init_parms);
+                continue;
+            }
+            if (part) {
+                concat(actions, node.machine->apply_event(event));
                 continue;
             }
             u64 t0 = __rdtsc();
@@ -6729,9 +6917,19 @@ struct Engine {
         return actions;
     }
 
-    void step();
+    void step(Partition *part = nullptr);
     i64 run(i64 max_steps, i64 timeout, bool *done, bool *timed_out,
             bool *need_device);
+    PdesResult run_pdes(i64 partitions, bool threaded, i64 timeout,
+                        i64 stop_time, i64 stop_steps);
+    void pdes_setup(i64 partitions, bool threaded);
+    void pdes_window(Partition &part, i64 window_start, i64 window_end,
+                     i64 step_cap);
+    // Barrier replay: finalize birth-key ranks, deliver cross-partition
+    // sends, fold stats and drain flips in exact global order.  Returns
+    // the global step index (1-based) at which the drain predicate first
+    // held, or -1.
+    i64 pdes_barrier(i64 window_start, i64 *flip_time);
 
     // Inspect the queue head: does the next event need device results the
     // wrapper has not supplied yet?  Fills need_hash_content /
@@ -6787,14 +6985,25 @@ struct Engine {
         }
         return true;
     }
-    void refresh_node_ready(EngineNode &node) {
+    void refresh_node_ready(EngineNode &node, Partition *part = nullptr) {
         bool ready = node_lws_ready(node);
         if (ready != node.drain_ready) {
             node.drain_ready = ready;
-            nodes_not_ready += ready ? -1 : 1;
+            if (part) {
+                // PDES: the global counter is folded at the barrier, in
+                // exact merged order (a node lives in one partition, so
+                // its flag itself is safe to flip here).  Kind 1 = became
+                // ready, kind 2 = regressed (e.g. a state transfer
+                // installing a snapshot short of the targets).
+                part->flips.push_back({(u32)part->plog.size() - 1,
+                                       (u8)(ready ? 1 : 2), (i64)node.id});
+            } else {
+                nodes_not_ready += ready ? -1 : 1;
+            }
         }
     }
-    void note_commits(const EngineNode &node, const QEntryS &batch) {
+    void note_commits(const EngineNode &node, const QEntryS &batch,
+                      Partition *part = nullptr) {
         for (const auto &req : batch.reqs) {
             auto sit = client_satisfied.find(req.client);
             if (sit == client_satisfied.end() || sit->second) continue;
@@ -6802,16 +7011,40 @@ struct Engine {
             auto cit = node.state.committed_reqs.find(req.client);
             if (cit != node.state.committed_reqs.end() &&
                 cit->second >= tit->second) {
-                sit->second = true;
-                clients_unsatisfied -= 1;
+                if (part) {
+                    // Candidate only: client_satisfied stays untouched
+                    // until the barrier (two partitions may both cross a
+                    // client's threshold in one window; the replay keeps
+                    // the globally-first and drops the rest).
+                    part->flips.push_back(
+                        {(u32)part->plog.size() - 1, 0, req.client});
+                } else {
+                    sit->second = true;
+                    clients_unsatisfied -= 1;
+                }
             }
         }
     }
 };
 
-void Engine::step() {
+void Engine::step(Partition *part) {
     u64 t_start = __rdtsc();
+    EventQueue &queue = part ? part->q : this->queue;
+    i64 plog_prov_start = part ? part->prov_counter : 0;
     SimEv event = queue.consume();
+    if (part) {
+        // Log the processed event's identity for the barrier replay.  The
+        // key is provisional iff the event was born inside the current
+        // window; births are the prov-id range consumed while processing.
+        Partition::PLogE e;
+        e.time = event.time;
+        e.bt = event.bt;
+        e.rank = event.ctr;
+        e.prov_start = plog_prov_start;
+        e.births = 0;  // patched below
+        e.prov = event.bt >= part->window_start ? 1 : 0;
+        part->plog.push_back(e);
+    }
     EngineNode &node = *nodes[(size_t)event.target];
     const RuntimeParms &parms = node.runtime;
 
@@ -6844,7 +7077,7 @@ void Engine::step() {
                 i64 start_req = it != state_clients.end() ? it->second->lw : 0;
                 if (start_req < client.total)
                     schedule_proposal(node.id, client.id, start_req,
-                                      parms.client_latency);
+                                      parms.client_latency, part);
             }
             break;
         }
@@ -6869,7 +7102,7 @@ void Engine::step() {
                 if (client->empty()) {
                     // ClientNotExistError: retry later.
                     schedule_proposal(node.id, event.client, req_no,
-                                      parms.client_latency * 100);
+                                      parms.client_latency * 100, part);
                     broke = true;
                     break;
                 }
@@ -6877,7 +7110,7 @@ void Engine::step() {
                 if (next_req_no != req_no) {
                     if (next_req_no < sim_client->total)
                         schedule_proposal(node.id, event.client, next_req_no,
-                                          parms.client_latency);
+                                          parms.client_latency, part);
                     broke = true;
                     break;
                 }
@@ -6905,7 +7138,7 @@ void Engine::step() {
             }
             if (!broke)
                 schedule_proposal(node.id, event.client, req_no,
-                                  parms.client_latency);
+                                  parms.client_latency, part);
             break;
         }
         case SK::Tick: {
@@ -6928,7 +7161,7 @@ void Engine::step() {
         }
         case SK::ProcessResult: {
             Actions actions =
-                process_state_machine_events(node, std::move(*event.events));
+                process_state_machine_events(node, std::move(*event.events), part);
             node.work_items->add_state_machine_results(std::move(actions));
             node.pending[6] = false;
             break;
@@ -6943,14 +7176,14 @@ void Engine::step() {
         }
         case SK::ProcessNet: {
             Events events =
-                process_net_actions(node, std::move(*event.actions));
+                process_net_actions(node, std::move(*event.actions), part);
             for (auto &e : events)
                 node.work_items->result_events.push_back(std::move(e));
             node.pending[1] = false;
             break;
         }
         case SK::ProcessHash: {
-            Events events = process_hash_actions(std::move(*event.actions));
+            Events events = process_hash_actions(std::move(*event.actions), part);
             for (auto &e : events)
                 node.work_items->result_events.push_back(std::move(e));
             node.pending[3] = false;
@@ -6966,7 +7199,7 @@ void Engine::step() {
         }
         case SK::ProcessApp: {
             Events events =
-                process_app_actions(node, std::move(*event.actions));
+                process_app_actions(node, std::move(*event.actions), part);
             for (auto &e : events)
                 node.work_items->result_events.push_back(std::move(e));
             node.pending[4] = false;
@@ -6974,10 +7207,19 @@ void Engine::step() {
         }
     }
 
-    kind_cycles[(int)event.kind] += __rdtsc() - t_start;
-    kind_counts[(int)event.kind] += 1;
+    if (part) {
+        part->work_cycles += __rdtsc() - t_start;
+    } else {
+        kind_cycles[(int)event.kind] += __rdtsc() - t_start;
+        kind_counts[(int)event.kind] += 1;
+    }
 
-    if (!node.work_items) return;
+    if (!node.work_items) {
+        if (part)
+            part->plog.back().births =
+                (u32)(part->prov_counter - plog_prov_start);
+        return;
+    }
 
     // Schedule processing for non-empty categories with no batch in flight
     // (same order as recorder.py:742-749).
@@ -7027,6 +7269,9 @@ void Engine::step() {
             queue.insert(std::move(ev));
         }
     }
+    if (part)
+        part->plog.back().births =
+            (u32)(part->prov_counter - plog_prov_start);
 }
 
 i64 Engine::run(i64 max_steps, i64 timeout, bool *done, bool *timed_out,
@@ -7053,6 +7298,353 @@ i64 Engine::run(i64 max_steps, i64 timeout, bool *done, bool *timed_out,
         }
     }
     return executed;
+}
+
+// ---------------------------------------------------------------------------
+// PDES run modes (docs/PERFORMANCE.md §7.1).  The simulation is bit-
+// identical to the sequential engine: each window is processed partition-
+// locally under provisional birth keys, and the barrier replay reconstructs
+// the exact global order (see struct Partition above).  Two modes:
+//
+// * measurement (stop_steps < 0): run until the drain predicate first
+//   holds, detected at the following barrier.  The returned step count and
+//   fake-time are EXACT (computed from the replay); the engine state
+//   overshoots by at most one window, so node summaries are not the
+//   drain-step state.  This is the bench mode.
+// * exact (stop_time/stop_steps from a prior run): process full windows
+//   strictly before stop_time, then merge every partition queue into the
+//   sequential queue and finish single-threaded to exactly stop_steps.
+//   Node summaries then match the sequential engine bit-for-bit.
+// ---------------------------------------------------------------------------
+
+void Engine::pdes_setup(i64 partitions, bool threaded) {
+    if (!parts.empty()) throw EngineError("pdes already initialized");
+    if (steps != 0 || queue.fake_time != 0)
+        throw EngineError("pdes requires a fresh engine");
+    if (queue.mangler || drop_mangler)
+        throw EngineError("pdes envelope: no manglers");
+    if (ctx.ack_ledger != nullptr)
+        throw EngineError(
+            "pdes requires the ack ledger disabled (MIRBFT_FAST_LEDGER=0): "
+            "the ledger is cluster-shared state; the classic per-receiver "
+            "ack path partitions cleanly");
+    if (device_hash_mode || streaming_auth_mode)
+        throw EngineError("pdes envelope: no device-paced modes");
+    if (!reconfig_points.empty())
+        throw EngineError("pdes envelope: no reconfiguration");
+    for (const auto &np : nodes) {
+        if (np->start_delay > 0)
+            throw EngineError("pdes envelope: no start delays");
+        if (np->state.fail_transfers > 0)
+            throw EngineError("pdes envelope: no transfer-failure injection");
+        if (np->runtime.link_latency != nodes[0]->runtime.link_latency)
+            throw EngineError("pdes envelope: uniform link latency required");
+        if (np->runtime.link_latency < 1)
+            throw EngineError("pdes: link latency must be positive");
+    }
+    for (const auto &cs : client_specs)
+        if (!cs.ignore_nodes.empty())
+            throw EngineError("pdes envelope: no ignored nodes");
+    if (partitions < 1 || partitions > (i64)nodes.size())
+        throw EngineError("pdes: partitions must be in [1, node count]");
+    pdes_threaded = threaded;
+    if (threaded) ctx.intern.mu = &intern_mu;
+    i64 N = (i64)nodes.size();
+    part_of.assign((size_t)N, 0);
+    for (i64 p = 0; p < partitions; p++) {
+        auto part = std::make_unique<Partition>();
+        part->id = (i32)p;
+        part->q.stamp_mode = EventQueue::PDES;
+        part->q.prov = &part->prov_counter;
+        parts.push_back(std::move(part));
+    }
+    for (i64 i = 0; i < N; i++)
+        part_of[(size_t)i] = (i32)(i * partitions / N);
+    // Distribute genesis events, restamped to birth time -1 (before any
+    // in-run birth, so window-0 births cannot collide with their keys).
+    for (auto &ev : queue.heap) {
+        ev.bt = -1;
+        Partition &pp = *parts[(size_t)part_of[(size_t)ev.target]];
+        pp.q.heap.push_back(std::move(ev));
+    }
+    queue.heap.clear();
+    for (auto &pp : parts)
+        std::make_heap(pp->q.heap.begin(), pp->q.heap.end(), SimEvCmp());
+}
+
+void Engine::pdes_window(Partition &part, i64 window_start, i64 window_end,
+                         i64 step_cap) {
+    part.window_start = window_start;
+    part.prov_base = part.prov_counter;
+    EventQueue &q = part.q;
+    while (!q.heap.empty() && q.heap.front().time < window_end) {
+        step(&part);
+        part.steps += 1;
+        if (part.steps > step_cap)
+            throw EngineError("pdes: window step runaway (timeout)");
+    }
+}
+
+i64 Engine::pdes_barrier(i64 window_start, i64 *flip_time) {
+    const size_t P = parts.size();
+    // prov id -> final rank, per partition (dense, window-scoped).
+    vector<vector<i64>> fin(P);
+    vector<size_t> logi(P, 0), flipi(P, 0);
+    for (size_t p = 0; p < P; p++)
+        fin[p].assign(
+            (size_t)(parts[p]->prov_counter - parts[p]->prov_base), -1);
+    auto resolved = [&](size_t p, const Partition::PLogE &e) -> i64 {
+        if (!e.prov) return e.rank;
+        i64 r = fin[p][(size_t)(e.rank - parts[p]->prov_base)];
+        if (r < 0) throw EngineError("pdes: unresolved rank in merge");
+        return r;
+    };
+    i64 cur_bt = INT64_MIN, bt_rank = 0, flip_step = -1;
+    while (true) {
+        // Pop the globally-least processed event by (time, bt, rank).
+        size_t best = P;
+        i64 b_time = 0, b_bt = 0, b_rk = 0;
+        for (size_t p = 0; p < P; p++) {
+            if (logi[p] >= parts[p]->plog.size()) continue;
+            const auto &e = parts[p]->plog[logi[p]];
+            i64 rk = resolved(p, e);
+            if (best == P || e.time < b_time ||
+                (e.time == b_time &&
+                 (e.bt < b_bt || (e.bt == b_bt && rk < b_rk)))) {
+                best = p;
+                b_time = e.time;
+                b_bt = e.bt;
+                b_rk = rk;
+            }
+        }
+        if (best == P) break;
+        Partition &pp = *parts[best];
+        const auto &e = pp.plog[logi[best]];
+        // Its births get the next ranks of the insertion sequence at this
+        // timestamp (the merged order IS the sequential processing order).
+        if (e.time != cur_bt) {
+            cur_bt = e.time;
+            bt_rank = 0;
+        }
+        for (u32 k = 0; k < e.births; k++)
+            fin[best][(size_t)(e.prov_start - pp.prov_base) + k] = bt_rank++;
+        steps += 1;
+        // Drain-predicate flips caused by this event, in global order.
+        while (flipi[best] < pp.flips.size() &&
+               pp.flips[flipi[best]].at == logi[best]) {
+            const auto &f = pp.flips[flipi[best]++];
+            if (f.kind == 0) {
+                auto sit = client_satisfied.find(f.id);
+                if (sit != client_satisfied.end() && !sit->second) {
+                    sit->second = true;
+                    clients_unsatisfied -= 1;
+                }
+            } else if (f.kind == 1) {
+                nodes_not_ready -= 1;
+            } else {
+                nodes_not_ready += 1;
+            }
+            if (flip_step < 0 && drained()) {
+                flip_step = steps;
+                *flip_time = e.time;
+            }
+        }
+        logi[best] += 1;
+    }
+    // Re-stamp window-born events still pending, and the cross sends.
+    for (size_t p = 0; p < P; p++) {
+        Partition &pp = *parts[p];
+        for (auto &ev : pp.q.heap) {
+            if (ev.bt < window_start) continue;
+            i64 r = fin[p][(size_t)(ev.ctr - pp.prov_base)];
+            if (r < 0) throw EngineError("pdes: pending event unresolved");
+            ev.ctr = r;
+            // Relative order within every same-(time, bt) group is
+            // preserved by construction, so the heap stays a heap.
+        }
+        for (auto &ev : pp.outbox) {
+            i64 r = fin[p][(size_t)(ev.ctr - pp.prov_base)];
+            if (r < 0) throw EngineError("pdes: outbox event unresolved");
+            ev.ctr = r;
+        }
+    }
+    // Deliver cross-partition sends (keys final; plain heap insert).
+    for (size_t p = 0; p < P; p++) {
+        for (auto &ev : parts[p]->outbox) {
+            Partition &tgt = *parts[(size_t)part_of[(size_t)ev.target]];
+            tgt.q.insert_stamped(std::move(ev));
+        }
+        parts[p]->outbox.clear();
+    }
+    // Fold window stats.
+    for (size_t p = 0; p < P; p++) {
+        Partition &pp = *parts[p];
+        committed_ops += pp.committed_ops;
+        pp.committed_ops = 0;
+        crypto_ns += pp.crypto_ns;
+        pp.crypto_ns = 0;
+        pp.steps = 0;
+        pp.plog.clear();
+        pp.flips.clear();
+    }
+    return flip_step;
+}
+
+PdesResult Engine::run_pdes(i64 partitions, bool threaded, i64 timeout,
+                            i64 stop_time, i64 stop_steps) {
+    if (parts.empty()) pdes_setup(partitions, threaded);
+    const size_t P = parts.size();
+    const i64 L = nodes[0]->runtime.link_latency;
+    const bool exact = stop_steps >= 0;
+    const i64 step_cap = timeout + 1000;
+    PdesResult res;
+
+    // Persistent worker pool (threaded mode): generation-counter barrier.
+    std::vector<std::thread> workers;
+    std::mutex mu;
+    std::condition_variable cv_go, cv_done;
+    i64 gen = 0;
+    size_t done_count = 0;
+    bool shutdown = false;
+    i64 cur_T = 0, cur_end = 0;
+    const bool pool = threaded && P > 1;
+    if (pool) {
+        for (size_t p = 0; p < P; p++) {
+            workers.emplace_back([&, p] {
+                i64 seen = 0;
+                while (true) {
+                    i64 a, b;
+                    {
+                        std::unique_lock<std::mutex> lk(mu);
+                        cv_go.wait(lk,
+                                   [&] { return shutdown || gen > seen; });
+                        if (shutdown) return;
+                        seen = gen;
+                        a = cur_T;
+                        b = cur_end;
+                    }
+                    try {
+                        pdes_window(*parts[p], a, b, step_cap);
+                    } catch (const std::exception &ex) {
+                        parts[p]->error = ex.what();
+                    }
+                    {
+                        std::lock_guard<std::mutex> lk(mu);
+                        done_count += 1;
+                    }
+                    cv_done.notify_all();
+                }
+            });
+        }
+    }
+    auto stop_pool = [&] {
+        if (!pool) return;
+        {
+            std::lock_guard<std::mutex> lk(mu);
+            shutdown = true;
+        }
+        cv_go.notify_all();
+        for (auto &w : workers) w.join();
+        workers.clear();
+    };
+
+    i64 T = 0;
+    try {
+        while (true) {
+            // Jump over empty stretches (no events in [T, next_t)).
+            i64 next_t = INT64_MAX;
+            for (auto &pp : parts)
+                if (!pp->q.heap.empty())
+                    next_t = std::min(next_t, pp->q.heap.front().time);
+            if (next_t == INT64_MAX) break;  // queues fully drained
+            if (next_t > T) T = next_t;
+            i64 window_end = T + L;
+            if (exact && window_end > stop_time) break;  // tail takes over
+
+            u64 t0 = __rdtsc();
+            if (pool) {
+                {
+                    std::lock_guard<std::mutex> lk(mu);
+                    cur_T = T;
+                    cur_end = window_end;
+                    done_count = 0;
+                    gen += 1;
+                }
+                cv_go.notify_all();
+                {
+                    std::unique_lock<std::mutex> lk(mu);
+                    cv_done.wait(lk, [&] { return done_count == P; });
+                }
+                for (auto &pp : parts)
+                    if (!pp->error.empty()) throw EngineError(pp->error);
+            } else {
+                for (auto &pp : parts)
+                    pdes_window(*pp, T, window_end, step_cap);
+            }
+            u64 t1 = __rdtsc();
+            u64 win_max = 0, win_sum = 0;
+            for (auto &pp : parts) {
+                win_sum += pp->work_cycles;
+                if (pp->work_cycles > win_max) win_max = pp->work_cycles;
+                pp->work_cycles = 0;
+            }
+            res.sum_part_cycles += win_sum;
+            res.max_part_cycles += win_max;
+
+            i64 ft = -1;
+            i64 flip = pdes_barrier(T, &ft);
+            res.barrier_cycles += __rdtsc() - t1;
+            (void)t0;
+            res.windows += 1;
+            if (flip >= 0 && res.flip_step < 0) {
+                res.flip_step = flip;
+                res.flip_time = ft;
+            }
+            if (!exact && res.flip_step >= 0) break;
+            if (steps > timeout) {
+                res.timed_out = true;
+                break;
+            }
+            T = window_end;
+        }
+        stop_pool();
+    } catch (...) {
+        stop_pool();
+        throw;
+    }
+
+    if (exact && !res.timed_out) {
+        // Sequential tail: merge every partition queue into the main one
+        // (all keys final after the last barrier) and finish exactly.
+        queue.fake_time = T;
+        queue.stamp_mode = EventQueue::TAIL;
+        for (auto &pp : parts) {
+            for (auto &ev : pp->q.heap)
+                queue.heap.push_back(std::move(ev));
+            pp->q.heap.clear();
+        }
+        std::make_heap(queue.heap.begin(), queue.heap.end(), SimEvCmp());
+        while (steps < stop_steps) {
+            if (queue.heap.empty())
+                throw EngineError("pdes exact: queue drained before stop");
+            step(nullptr);
+            steps += 1;
+            res.tail_steps += 1;
+        }
+        res.done = true;
+        res.steps = steps;
+        res.fake_time = queue.fake_time;
+    } else if (!exact) {
+        res.done = res.flip_step >= 0;
+        res.steps = res.done ? res.flip_step : steps;
+        res.fake_time = res.done ? res.flip_time : 0;
+        // Surface the exact drain point through stats(): the engine state
+        // has overshot by up to one window (measurement mode), but the
+        // reported step count and fake-time are the sequential ones.
+        steps = res.steps;
+        queue.fake_time = res.fake_time;
+    }
+    return res;
 }
 
 // ---------------------------------------------------------------------------
@@ -7094,9 +7686,10 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
     PyObject *mangler = Py_None;
     long long random_seed = 0;
     PyObject *reconfig_points = Py_None;
-    if (!PyArg_ParseTuple(args, "OOOO|OLO", &net_tuple, &client_states,
+    long long flags = 0;  // bit 0: disable the ack ledger (PDES runs)
+    if (!PyArg_ParseTuple(args, "OOOO|OLOL", &net_tuple, &client_states,
                           &client_specs, &node_specs, &mangler, &random_seed,
-                          &reconfig_points))
+                          &reconfig_points, &flags))
         return nullptr;
     auto *engine = new Engine();
     try {
@@ -7344,7 +7937,8 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
                     engine->nodes[0]->runtime.link_latency)
                     uniform = false;
             const char *env = std::getenv("MIRBFT_FAST_LEDGER");
-            bool enabled = uniform && !(env && env[0] == '0');
+            bool enabled =
+                uniform && !(env && env[0] == '0') && !(flags & 1);
             if (enabled) {
                 engine->ack_ledger.wq = engine->ctx.wq;
                 engine->ack_ledger.sq = engine->ctx.iq;
@@ -7749,8 +8343,48 @@ PyObject *engine_set_device_modes(PyObject *self, PyObject *args) {
     Py_RETURN_NONE;
 }
 
+// run_pdes(partitions, threaded, timeout, stop_time, stop_steps) -> dict.
+// Measurement mode (stop_steps < 0) runs to the drain flip and returns the
+// exact step count / fake-time; exact mode replays to the given stop and
+// leaves the engine state bit-identical to the sequential run there.
+PyObject *engine_run_pdes(PyObject *self, PyObject *args) {
+    long long partitions, threaded, timeout, stop_time, stop_steps;
+    if (!PyArg_ParseTuple(args, "LLLLL", &partitions, &threaded, &timeout,
+                          &stop_time, &stop_steps))
+        return nullptr;
+    Engine *e = ((PyEngine *)self)->engine;
+    PdesResult r;
+    string error;
+    {
+        PyThreadState *save = PyEval_SaveThread();
+        try {
+            r = e->run_pdes(partitions, threaded != 0, timeout, stop_time,
+                            stop_steps);
+        } catch (const std::exception &ex) {
+            error = ex.what();
+            if (error.empty()) error = "fastengine error";
+        }
+        PyEval_RestoreThread(save);
+    }
+    if (!error.empty()) {
+        PyErr_SetString(PyExc_RuntimeError, error.c_str());
+        return nullptr;
+    }
+    return Py_BuildValue(
+        "{s:L,s:L,s:L,s:L,s:i,s:i,s:L,s:K,s:K,s:K,s:L}", "steps",
+        (long long)r.steps, "fake_time", (long long)r.fake_time, "flip_step",
+        (long long)r.flip_step, "flip_time", (long long)r.flip_time, "done",
+        r.done ? 1 : 0, "timed_out", r.timed_out ? 1 : 0, "windows",
+        (long long)r.windows, "barrier_cycles",
+        (unsigned long long)r.barrier_cycles, "sum_part_cycles",
+        (unsigned long long)r.sum_part_cycles, "max_part_cycles",
+        (unsigned long long)r.max_part_cycles, "tail_steps",
+        (long long)r.tail_steps);
+}
+
 PyMethodDef engine_methods[] = {
     {"run", engine_run, METH_VARARGS, nullptr},
+    {"run_pdes", engine_run_pdes, METH_VARARGS, nullptr},
     {"pending_device_work", engine_pending_device_work, METH_NOARGS, nullptr},
     {"supply_digests", engine_supply_digests, METH_VARARGS, nullptr},
     {"supply_verdicts", engine_supply_verdicts, METH_VARARGS, nullptr},

@@ -178,6 +178,8 @@ class FastRecording:
         auth_wave: int = 1024,
         device_authoritative: bool = False,
         streaming_auth: bool = False,
+        pdes_partitions: int = 0,
+        pdes_threaded: bool = False,
     ):
         """``device_authoritative``: the TPU is the producer of every
         wave-eligible protocol digest — the engine pauses (wall-clock only;
@@ -185,7 +187,18 @@ class FastRecording:
         mode) until the wrapper collects the digests from the device.
         ``streaming_auth``: signed-request verdicts are produced by device
         lookahead waves DURING the run (multiple dispatches overlapping
-        consensus) instead of one pre-run pass."""
+        consensus) instead of one pre-run pass.
+
+        ``pdes_partitions`` > 0 selects the conservative-PDES partitioned
+        run mode (docs/PERFORMANCE.md §7.1): replicas are partitioned
+        across ``pdes_partitions`` workers synchronized at link-latency
+        lookahead barriers, bit-identical to the sequential engine.
+        ``pdes_threaded`` executes partitions on real threads (correctness
+        identical; speedup requires cores).  The PDES envelope is the
+        mangler-free green path: no device modes, no reconfiguration, no
+        start delays / ignored nodes, uniform link latency; the ack ledger
+        is disabled at construction (the classic per-receiver ack path
+        partitions cleanly; the ledger is cluster-shared state)."""
         _require(_native.load_fast() is not None, "native engine unavailable")
         _require(1 <= spec.node_count <= 256, ">256 nodes")
         if device_authoritative or streaming_auth:
@@ -292,12 +305,23 @@ class FastRecording:
                  ip.new_epoch_timeout_ticks, ip.buffer_size)
             )
 
-        self._engine = _native.fast.FastEngine(
+        self.pdes_partitions = int(pdes_partitions)
+        self.pdes_threaded = bool(pdes_threaded)
+        self.pdes_stats: Optional[dict] = None
+        if self.pdes_partitions:
+            _require(not device, "pdes with device modes")
+            _require(
+                1 <= self.pdes_partitions <= spec.node_count,
+                "pdes partitions out of range",
+            )
+        self._ctor_args = (
             (spec.node_count, net.checkpoint_interval, net.max_epoch_length,
              net.number_of_buckets, net.f),
             client_states, client_specs, node_specs, mangler_desc,
             recorder.random_seed, reconfig_desc or None,
+            1 if self.pdes_partitions else 0,  # bit 0: ledger off (PDES)
         )
+        self._engine = _native.fast.FastEngine(*self._ctor_args)
         if device_authoritative or streaming_auth:
             self._engine.set_device_modes(
                 int(device_authoritative), int(streaming_auth)
@@ -635,9 +659,58 @@ class FastRecording:
             for i in range(self.spec.node_count)
         ]
 
+    def drain_clients_pdes(self, timeout: int, exact: bool = True) -> int:
+        """Partitioned (conservative-PDES) drain, bit-identical to the
+        sequential engine.  Measurement pass: run to the drain flip (its
+        step count and fake-time are computed exactly at the barrier
+        replay; the engine state overshoots by up to one lookahead
+        window).  With ``exact`` (the differential-test mode), a second
+        fresh engine replays to the flip point and stops on the exact
+        step, so node summaries match the sequential engine bit-for-bit;
+        single-pass mode is the bench's (state past the drain point only
+        ever adds post-drain commits)."""
+        try:
+            res = self._engine.run_pdes(
+                self.pdes_partitions, int(self.pdes_threaded), timeout,
+                -1, -1,
+            )
+        except RuntimeError as exc:
+            msg = str(exc)
+            # Only envelope rejections map to the fallback signal; internal
+            # invariant failures and the window runaway stay loud.
+            if "runaway" in msg:
+                raise TimeoutError(msg) from exc
+            if msg.startswith(("pdes envelope", "pdes requires",
+                               "pdes: partitions")):
+                raise FastEngineUnsupported(msg) from exc
+            raise
+        if res["timed_out"]:
+            raise TimeoutError(
+                f"pdes engine timed out after {res['steps']} steps"
+            )
+        if not res["done"]:
+            raise RuntimeError("pdes: queues drained before clients")
+        self.pdes_stats = res
+        if exact:
+            engine2 = _native.fast.FastEngine(*self._ctor_args)
+            res2 = engine2.run_pdes(
+                self.pdes_partitions, int(self.pdes_threaded), timeout,
+                res["flip_time"], res["steps"],
+            )
+            assert res2["done"], "pdes exact replay did not complete"
+            assert res2["steps"] == res["steps"], (
+                "pdes exact replay step mismatch"
+            )
+            self.pdes_stats = dict(res, tail_steps=res2["tail_steps"])
+            self._engine = engine2
+        self._finalize()
+        return self.steps
+
     def drain_clients(self, timeout: int, slice_steps: int = 200_000) -> int:
         """Run until every client's requests commit on every node; returns
         the step count (bit-identical to the Python engine's)."""
+        if self.pdes_partitions:
+            return self.drain_clients_pdes(timeout)
         done = False
         while not done:
             try:

@@ -1,0 +1,159 @@
+"""Conservative-PDES partitioned engine: bit-identity differentials.
+
+The partitioned run mode (docs/PERFORMANCE.md §7.1; `run_pdes` in
+mirbft_tpu/_native/fastengine.cpp) partitions replicas across workers with
+the link-latency lookahead as the synchronization window, and reconstructs
+the sequential engine's exact event order (birth-key ranks) at each
+barrier.  The contract is the same bit-identity the fast engine owes the
+Python engine: identical step counts, fake-time, and per-node final state
+— for every partition count, serial or threaded.
+
+Both sides of these differentials are native (sequential engine vs PDES
+engine), so the whole matrix is fast; nothing here needs the slow tier.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from mirbft_tpu import _native
+from mirbft_tpu.testengine import Spec
+from mirbft_tpu.testengine.fastengine import (
+    FastEngineUnsupported,
+    FastRecording,
+)
+
+pytestmark = pytest.mark.skipif(
+    _native.load_fast() is None, reason="native fast engine unavailable"
+)
+
+
+def _run_seq(spec, timeout=100_000_000):
+    rec = FastRecording(spec)
+    steps = rec.drain_clients(timeout=timeout)
+    return steps, rec.stats()[1], _state(rec)
+
+
+def _state(rec):
+    return [
+        (
+            n.checkpoint_seq_no,
+            n.checkpoint_hash,
+            n.epoch,
+            n.last_seq_no,
+            n.active_hash_digest,
+            dict(n.committed_reqs),
+        )
+        for n in rec.nodes
+    ]
+
+
+PDES_SPECS = [
+    Spec(node_count=1, client_count=1, reqs_per_client=3, batch_size=1),
+    Spec(node_count=4, client_count=4, reqs_per_client=20, batch_size=5),
+    # Graceful epoch rotations included (this config ends in epoch 4).
+    Spec(node_count=4, client_count=4, reqs_per_client=200, batch_size=1),
+    Spec(node_count=7, client_count=3, reqs_per_client=50, batch_size=10),
+    Spec(
+        node_count=16,
+        client_count=16,
+        reqs_per_client=10,
+        batch_size=100,
+        signed_requests=True,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "spec",
+    PDES_SPECS,
+    ids=lambda s: f"n{s.node_count}c{s.client_count}r{s.reqs_per_client}"
+    f"b{s.batch_size}{'s' if s.signed_requests else ''}",
+)
+@pytest.mark.parametrize("partitions", [2, 4, 8])
+def test_pdes_bit_identical(spec, partitions):
+    if partitions > spec.node_count:
+        pytest.skip("more partitions than nodes")
+    steps, fake_time, state = _run_seq(spec)
+    pdes = FastRecording(spec, pdes_partitions=partitions)
+    steps_p = pdes.drain_clients(timeout=100_000_000)
+    assert steps_p == steps
+    assert pdes.stats()[1] == fake_time
+    assert _state(pdes) == state
+
+
+@pytest.mark.parametrize("partitions", [2, 8])
+def test_pdes_threaded_bit_identical(partitions):
+    """Real threads: same contract (the barrier replay makes the global
+    order independent of thread scheduling)."""
+    spec = Spec(node_count=16, client_count=8, reqs_per_client=20,
+                batch_size=10)
+    steps, fake_time, state = _run_seq(spec)
+    pdes = FastRecording(
+        spec, pdes_partitions=partitions, pdes_threaded=True
+    )
+    steps_p = pdes.drain_clients(timeout=100_000_000)
+    assert steps_p == steps
+    assert pdes.stats()[1] == fake_time
+    assert _state(pdes) == state
+
+
+def test_pdes_threaded_matches_serial_64n():
+    """The headline shape at reduced request count: serial and threaded
+    partitioned runs agree with the sequential engine."""
+    spec = Spec(node_count=64, client_count=64, reqs_per_client=5,
+                batch_size=100)
+    steps, fake_time, state = _run_seq(spec)
+    for threaded in (False, True):
+        pdes = FastRecording(
+            spec, pdes_partitions=8, pdes_threaded=threaded
+        )
+        assert pdes.drain_clients(timeout=100_000_000) == steps
+        assert pdes.stats()[1] == fake_time
+        assert _state(pdes) == state
+
+
+def test_pdes_measurement_mode_reports_exact_drain_point():
+    """Single-pass (bench) mode: the flip step/fake-time computed at the
+    barrier replay equal the exact two-pass run's."""
+    spec = Spec(node_count=8, client_count=4, reqs_per_client=30,
+                batch_size=5)
+    exact = FastRecording(spec, pdes_partitions=4)
+    steps = exact.drain_clients_pdes(timeout=100_000_000, exact=True)
+    measure = FastRecording(spec, pdes_partitions=4)
+    steps_m = measure.drain_clients_pdes(timeout=100_000_000, exact=False)
+    assert steps_m == steps
+    assert measure.stats()[:2] == exact.stats()[:2]
+    # Post-drain overshoot only ever ADDS commits past the drain point.
+    for a, b in zip(measure.nodes, exact.nodes):
+        for cid, done in b.committed_reqs.items():
+            assert a.committed_reqs.get(cid, 0) >= done
+
+
+def test_pdes_envelope_rejections():
+    from mirbft_tpu.testengine import For, matching
+
+    spec = Spec(
+        node_count=4, client_count=1, reqs_per_client=1,
+        tweak_recorder=lambda r: setattr(
+            r, "mangler", For(matching.msgs()).drop()
+        ),
+    )
+    with pytest.raises((FastEngineUnsupported, RuntimeError)):
+        FastRecording(spec, pdes_partitions=2).drain_clients(10_000_000)
+
+    spec = Spec(
+        node_count=4, client_count=1, reqs_per_client=1,
+        tweak_recorder=lambda r: setattr(
+            r.node_configs[2], "start_delay", 5000
+        ),
+    )
+    with pytest.raises((FastEngineUnsupported, RuntimeError)):
+        FastRecording(spec, pdes_partitions=2).drain_clients(10_000_000)
+
+    with pytest.raises(FastEngineUnsupported):
+        FastRecording(
+            Spec(node_count=4, client_count=1, reqs_per_client=1),
+            device=True,
+            pdes_partitions=2,
+        )
