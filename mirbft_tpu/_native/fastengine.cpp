@@ -1738,6 +1738,10 @@ struct CheckpointTracker {
     std::map<i32, MsgBuffer> msg_buffers;
     bool have_config = false;
     NetCfgP net_cfg;  // from the first CEntry's network state (Python twin)
+    // Mid-epoch catch-up trigger (checkpoints.py catch_up_target,
+    // docs/Divergences.md #13): seq < 0 = unset.
+    i64 catch_up_seq = -1;
+    i32 catch_up_value = -1;
 
     shared_ptr<Checkpoint> checkpoint(i64 seq_no) {
         auto it = checkpoint_map.find(seq_no);
@@ -1768,6 +1772,8 @@ struct CheckpointTracker {
         active_checkpoints.clear();
         msg_buffers.clear();
         have_config = false;
+        catch_up_seq = -1;
+        catch_up_value = -1;
 
         for (const auto &pr : persisted->entries) {
             if (pr.second->t != PET::C) continue;
@@ -1850,6 +1856,16 @@ struct CheckpointTracker {
         }
         auto cp = checkpoint(seq_no);
         cp->apply_checkpoint_msg(source, value);
+
+        if (above_high && cp->committed_value >= 0) {
+            // Weak quorum attests a checkpoint beyond every tracked
+            // window: arm the mid-epoch catch-up transfer
+            // (docs/Divergences.md #13; checkpoints.py twin).
+            if (catch_up_seq < 0 || seq_no > catch_up_seq) {
+                catch_up_seq = seq_no;
+                catch_up_value = cp->committed_value;
+            }
+        }
 
         if (cp->stable && seq_no > low_watermark() && !above_high) {
             state = CheckpointState_::GARBAGE_COLLECTABLE;
@@ -5885,6 +5901,22 @@ struct Machine {
             concat(actions, epoch_tracker->move_low_watermark(new_low));
         }
 
+        // Mid-epoch catch-up (docs/Divergences.md #13; machine.py twin).
+        // The target stays armed while a transfer is in flight: checkpoint
+        // messages are sent once, so dropping it could strand the replica.
+        if (checkpoint_tracker->catch_up_seq >= 0) {
+            i64 seq_no = checkpoint_tracker->catch_up_seq;
+            i32 value = checkpoint_tracker->catch_up_value;
+            if (seq_no <= commit_state->highest_commit) {
+                checkpoint_tracker->catch_up_seq = -1;  // stale
+                checkpoint_tracker->catch_up_value = -1;
+            } else if (!commit_state->transferring) {
+                checkpoint_tracker->catch_up_seq = -1;
+                checkpoint_tracker->catch_up_value = -1;
+                concat(actions, commit_state->transfer_to(seq_no, value));
+            }
+        }
+
         u64 t0 = __rdtsc();
         while (true) {
             concat(actions, commit_state->drain());
@@ -6077,6 +6109,12 @@ struct AppChainNode {
     std::unordered_map<i32, i32> snap_next;  // checkpoint value id -> node
     string digest;  // memoized hash_state.digest()
     bool digest_done = false;
+    // Committed-floor delta memo: absolute (client, floor) assignments a
+    // replica with CANONICAL floors applies at this position, one entry
+    // per client the batch raised.  Consumers apply it with MAX, so a
+    // delta created by a floor-lagging (state-transferred) replica — a
+    // superset with never-higher values — stays correct for everyone.
+    vector<std::pair<i64, i64>> delta;
 };
 
 struct AppChain {
@@ -6097,6 +6135,10 @@ struct AppState {
     // State-transfer bookkeeping + app-level failure injection
     // (testengine/recorder.py NodeState).
     i64 fail_transfers = 0;
+    // False once this node state-transfers: its committed_reqs floors lag
+    // the chain's canonical floors (skipped batches are never applied), so
+    // it leaves the shared-delta fast path for the per-request one.
+    bool floors_canonical = true;
     vector<i64> state_transfers;
     vector<i64> transfer_failures;
     vector<i64> transfer_attempt_times;
@@ -6161,28 +6203,55 @@ struct AppState {
             nid = it != cur.next.end() ? it->second : -1;
         }
         if (nid < 0) {
-            // First replica at this position: compute the hash transition.
+            // First replica at this position: compute the hash transition
+            // and the committed-floor delta (from OUR floors; a lagging
+            // creator emits a superset with never-higher values — see the
+            // delta comment on AppChainNode).
             AppChainNode nxt;
             nxt.hash_state = chain->nodes[(size_t)chain_id].hash_state;
-            for (const auto &request : batch.reqs)
+            for (const auto &request : batch.reqs) {
                 nxt.hash_state.update(intern.get(request.dig));
+                auto cit = committed_reqs.find(request.client);
+                i64 prev = cit == committed_reqs.end() ? 0 : cit->second;
+                if (request.reqno + 1 > prev) {
+                    bool found = false;
+                    for (auto &pr : nxt.delta)
+                        if (pr.first == request.client) {
+                            if (request.reqno + 1 > pr.second)
+                                pr.second = request.reqno + 1;
+                            found = true;
+                            break;
+                        }
+                    if (!found)
+                        nxt.delta.emplace_back(request.client,
+                                               request.reqno + 1);
+                }
+            }
             nid = (i32)chain->nodes.size();
             chain->nodes.push_back(std::move(nxt));
             chain->nodes[(size_t)chain_id].next.emplace(key, nid);
         }
-        // Committed-reqs is per-replica (NOT chain-memoized): a replica
-        // that state-transferred past some commits has lower floors than
-        // one that applied the whole history, so the chain's view of "new
-        // highest" differs per replica around a transfer.  Python computes
-        // this per replica too (NodeState.apply).
-        for (const auto &request : batch.reqs) {
-            i64 &slot = committed_reqs[request.client];
-            if (request.reqno + 1 > slot) slot = request.reqno + 1;
-            if (reconfig_points)
-                for (const auto &point : *reconfig_points)
-                    if (std::get<0>(point) == request.client &&
-                        std::get<1>(point) == request.reqno)
-                        pending.push_back(std::get<2>(point));
+        if (floors_canonical && (!reconfig_points || reconfig_points->empty())) {
+            // Fast path (the common one: never-transferred replica, no
+            // reconfiguration points): the memoized delta applied with
+            // MAX is exactly the per-request floor update.
+            for (const auto &pr : chain->nodes[(size_t)nid].delta) {
+                i64 &slot = committed_reqs[pr.first];
+                if (pr.second > slot) slot = pr.second;
+            }
+        } else {
+            // Per-request path: a transferred replica's floors lag the
+            // chain (Python computes per replica too, NodeState.apply),
+            // and reconfiguration points must see every request.
+            for (const auto &request : batch.reqs) {
+                i64 &slot = committed_reqs[request.client];
+                if (request.reqno + 1 > slot) slot = request.reqno + 1;
+                if (reconfig_points)
+                    for (const auto &point : *reconfig_points)
+                        if (std::get<0>(point) == request.client &&
+                            std::get<1>(point) == request.reqno)
+                            pending.push_back(std::get<2>(point));
+            }
         }
         chain_id = nid;
     }
@@ -6863,6 +6932,9 @@ struct Engine {
                 node.state.checkpoint_hash =
                     ctx.intern.get(value).substr(0, 32);
                 node.state.chain_id = sit->second.first;
+                // Skipped batches are never applied: this node's floors
+                // now lag the chain's canonical ones for good.
+                node.state.floors_canonical = false;
                 refresh_node_ready(node, part);
                 EventS e;
                 e.t = ET::StateTransferComplete;

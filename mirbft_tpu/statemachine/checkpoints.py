@@ -94,6 +94,7 @@ class CheckpointTracker:
         "node_buffers",
         "my_config",
         "logger",
+        "catch_up_target",
     )
 
     def __init__(
@@ -113,6 +114,10 @@ class CheckpointTracker:
         self.active_checkpoints: List[Checkpoint] = []
         self.msg_buffers: Dict[int, MsgBuffer] = {}
         self.network_config: Optional[NetworkConfig] = None
+        # (seq_no, value) of a weak-quorum-attested checkpoint beyond our
+        # windows — the mid-epoch catch-up trigger (docs/Divergences.md
+        # #13).  Consumed by the machine's post-event hook.
+        self.catch_up_target: Optional[Tuple[int, bytes]] = None
 
     # --- (re)initialization (reference checkpoints.go:56-112) ---
 
@@ -125,6 +130,7 @@ class CheckpointTracker:
         self.active_checkpoints = []
         self.msg_buffers = {}
         self.network_config = None
+        self.catch_up_target = None
 
         for _, entry in self.persisted.entries:
             if not isinstance(entry, CEntry):
@@ -240,6 +246,18 @@ class CheckpointTracker:
 
         cp = self.checkpoint(seq_no)
         cp.apply_checkpoint_msg(source, value)
+
+        if above_high and cp.committed_value is not None:
+            # A weak quorum attests a checkpoint beyond every window we
+            # track: the network has provably moved past anything our
+            # commit window can reach.  Arm the mid-epoch catch-up
+            # transfer (docs/Divergences.md #13) — the reference has no
+            # such path and strands a replica that falls this far behind
+            # inside one epoch (its harness only exercises catch-up
+            # against a quiescent cluster).
+            cur = self.catch_up_target
+            if cur is None or seq_no > cur[0]:
+                self.catch_up_target = (seq_no, cp.committed_value)
 
         if cp.stable and seq_no > self.low_watermark() and not above_high:
             self.state = CheckpointState.GARBAGE_COLLECTABLE

@@ -92,6 +92,7 @@ class ActiveEpoch:
         "_ci",
         "_owned_buckets",
         "_buffered",
+        "_drain_memo",
         "seq_plane",
     )
 
@@ -111,6 +112,13 @@ class ActiveEpoch:
         self.epoch_config = epoch_config
         self.network_config = network_config
         self.my_config = my_config
+        # Per-buffer no-op-scan memo: (filter fingerprint, buffer version)
+        # recorded when a drain scan applied nothing, so unchanged buffers
+        # are not re-filtered every fixpoint iteration (observably pure —
+        # a no-op iterate leaves buffer and state untouched).  Keys:
+        # ("pp", bucket) for in-order preprepare buffers, node id for the
+        # per-peer other-message buffers.
+        self._drain_memo = {}
         self.logger = logger
         self.persisted = persisted
         self.commit_state = commit_state
@@ -461,30 +469,55 @@ class ActiveEpoch:
             self.seq_plane.set_window(self.low_watermark(), self.high_watermark())
         return actions, False
 
+    def _drain_fp(self):
+        """Everything ``filter`` verdicts depend on (watermarks + per-bucket
+        in-order cursors; bucket map and expiration are epoch-static)."""
+        return (
+            self.low_watermark(),
+            self.high_watermark(),
+            tuple(b.next_seq_no for b in self.preprepare_buffers),
+        )
+
     def drain_buffers(self) -> Actions:
         """Reference epoch_active.go:339-366."""
         actions = Actions()
         if not self._buffered[0]:
             return actions  # nothing parked anywhere in this epoch
+        memo = self._drain_memo
+        fp = self._drain_fp()
         for bucket in range(len(self.buckets)):
             buffer = self.preprepare_buffers[bucket]
             if not buffer.buffer:
                 continue
+            key = ("pp", bucket)
+            if memo.get(key) == (fp, buffer.buffer.version):
+                continue  # provably the same all-FUTURE scan as last time
             source = self.buckets[bucket]
             next_msg = buffer.buffer.next(self.filter)
             if next_msg is None:
+                memo[key] = (fp, buffer.buffer.version)
                 continue
             # apply() loops over consecutive preprepares internally
             actions.concat(self.apply(source, next_msg))
+            fp = self._drain_fp()  # cursors/watermarks may have moved
 
         for node in self.network_config.nodes:
             other = self.other_buffers[node]
             if not other.buffer:
                 continue
-            other.iterate(
-                self.filter,
-                lambda nid, msg: actions.concat(self.apply(nid, msg)),
-            )
+            if memo.get(node) == (fp, other.version):
+                continue
+            hit = [False]
+
+            def apply_msg(nid, msg, _hit=hit):
+                _hit[0] = True
+                actions.concat(self.apply(nid, msg))
+
+            other.iterate(self.filter, apply_msg)
+            if hit[0]:
+                fp = self._drain_fp()
+            else:
+                memo[node] = (fp, other.version)
         return actions
 
     def needs_advance(self) -> bool:

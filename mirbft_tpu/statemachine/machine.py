@@ -300,6 +300,25 @@ class StateMachine:
                 self.batch_tracker.truncate(new_low - ci)
             actions.concat(self.epoch_tracker.move_low_watermark(new_low))
 
+        # Mid-epoch catch-up (docs/Divergences.md #13): when a weak quorum
+        # attests a checkpoint beyond our tracker windows, transfer to it.
+        # The reference strands a replica the cluster outruns within one
+        # epoch (state transfer only arms via epoch changes); this
+        # completes that path the same way Divergences #8 completed
+        # transfer failure.
+        target = self.checkpoint_tracker.catch_up_target
+        if target is not None:
+            seq_no, value = target
+            if seq_no <= self.commit_state.highest_commit:
+                self.checkpoint_tracker.catch_up_target = None  # stale
+            elif not self.commit_state.transferring:
+                self.checkpoint_tracker.catch_up_target = None
+                actions.concat(self.commit_state.transfer_to(seq_no, value))
+            # else: a transfer is in flight — keep the target armed
+            # (checkpoint messages are sent once; dropping it here could
+            # strand the replica if the cluster quiesces before anything
+            # else re-arms it).
+
         # Fixpoint: drain commits and advance the epoch until quiescent.
         while True:
             actions.concat(self.commit_state.drain())

@@ -79,7 +79,7 @@ class MsgBuffer:
     """One component's buffer of not-yet-applyable messages from one peer
     (reference msgbuffers.go:121-226)."""
 
-    __slots__ = ("component", "buffer", "node_buffer", "group")
+    __slots__ = ("component", "buffer", "node_buffer", "group", "version")
 
     def __init__(self, component: str, node_buffer: NodeBuffer, group=None):
         self.component = component
@@ -89,8 +89,14 @@ class MsgBuffer:
         # Optional shared one-element counter cell: the owner's live message
         # count across a group of buffers (lets it skip drain scans cheaply).
         self.group = group
+        # Monotone store counter: lets drain loops skip a re-scan when
+        # neither the buffer nor the filter-relevant state has changed
+        # since a scan that applied and dropped nothing (a no-op iterate
+        # is observably pure, so skipping it preserves bit-identity).
+        self.version = 0
 
     def store(self, msg: Msg) -> None:
+        self.version += 1
         # Over budget: drop our own oldest first (see reference's fairness
         # note, msgbuffers.go:146-151).
         while self.node_buffer.over_capacity() and self.buffer:

@@ -319,8 +319,11 @@ class FastRecording:
              net.number_of_buckets, net.f),
             client_states, client_specs, node_specs, mangler_desc,
             recorder.random_seed, reconfig_desc or None,
-            1 if self.pdes_partitions else 0,  # bit 0: ledger off (PDES)
         )
+        if self.pdes_partitions:
+            # Trailing flags arg, bit 0: ack ledger off (cluster-shared
+            # state; the classic ack path partitions cleanly).
+            self._ctor_args += (1,)
         self._engine = _native.fast.FastEngine(*self._ctor_args)
         if device_authoritative or streaming_auth:
             self._engine.set_device_modes(

@@ -116,6 +116,7 @@ def test_epoch_change_bit_identical():
     )
 
 
+@pytest.mark.slow
 def test_64_replica_bit_identical():
     """The headline config's shape at reduced request count (the full c3 run
     is the bench's job; the scheduling/protocol paths are identical)."""
@@ -171,11 +172,10 @@ def test_byzantine_signer_rejected():
 
 
 def test_drop_mangler_silenced_node_bit_identical():
-    """The structured DropMessages mangler is the one mangler inside the
-    fast envelope (BASELINE config 4's silenced-leader shape): all messages
-    FROM node 0 are dropped, the network suspects it and changes epochs,
-    and the engines must stay bit-identical through the whole failure
-    path — including a 128-node shape at reduced request count."""
+    """The structured DropMessages mangler (BASELINE config 4's
+    silenced-leader shape): all messages FROM node 0 are dropped, the
+    network suspects it and changes epochs, and the engines must stay
+    bit-identical through the whole failure path."""
     from mirbft_tpu.testengine.manglers import DropMessages
 
     def silence(r):
@@ -188,6 +188,13 @@ def test_drop_mangler_silenced_node_bit_identical():
     assert (steps_fast, time_fast) == (steps_py, time_py)
     assert state_fast == state_py
     assert any(node[2] > 0 for node in state_fast), "expected an epoch change"
+
+
+@pytest.mark.slow
+def test_drop_mangler_silenced_wan_128n_bit_identical():
+    """The silenced-leader scenario at the 128-node WAN shape (reduced
+    request count)."""
+    from mirbft_tpu.testengine.manglers import DropMessages
 
     def silence_wan(r):
         for nc in r.node_configs:
@@ -202,6 +209,7 @@ def test_drop_mangler_silenced_node_bit_identical():
     assert state_fast == state_py
 
 
+@pytest.mark.slow
 def test_multiword_mask_bit_identical():
     """Beyond the one-word (64-replica) mask range: 96 nodes exercise mask
     word 1, and 132 nodes exercise word 2 (replica ids above 128 — the
@@ -245,6 +253,7 @@ def test_device_authoritative_hashing_bit_identical():
     assert auth._engine.stats()[3] <= mirror._engine.stats()[3]
 
 
+@pytest.mark.slow
 def test_streaming_auth_matches_bitmap_mode():
     """Streaming Ed25519: verdicts arrive in device lookahead waves during
     the run (>1 dispatch), the schedule stays bit-identical to the pre-run
@@ -544,6 +553,7 @@ def test_reconfig_with_crash_differential():
     _differential(spec, timeout=60_000_000)
 
 
+@pytest.mark.slow
 def test_c5_shape_differential():
     """BASELINE config 5's scenario shape at reduced scale: 16 nodes,
     signed requests with a byzantine signer, a mid-run reconfiguration
@@ -587,6 +597,7 @@ def test_c5_shape_differential():
     assert fr.node_transfers(15)[0], "late replica should state-transfer"
 
 
+@pytest.mark.slow
 def test_transfer_failure_retry_differential():
     """App-level transfer-failure injection: three failed attempts, then
     success after a doubling tick backoff — attempt times, failures, and
@@ -617,6 +628,7 @@ def test_transfer_failure_retry_differential():
     assert gaps[0] < gaps[1] < gaps[2], gaps
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [0, 3, 9, 17])
 def test_randomized_small_width_differential(seed):
     """Tiny client windows force the ack ledger's edge paths — FUTURE
@@ -640,6 +652,7 @@ def test_randomized_small_width_differential(seed):
     assert state_fast == state_py, spec
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5, 6])
 def test_randomized_differential(seed):
     """Seeded random in-envelope configs: node count, client count, request
