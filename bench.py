@@ -247,6 +247,7 @@ def config3_pdes(detail):
 
     spec = Spec(node_count=64, client_count=64, reqs_per_client=100,
                 batch_size=100)
+    unique = spec.client_count * spec.reqs_per_client
     # The PDES envelope runs the classic (per-receiver) ack path — the
     # cluster-shared ledger does not partition.  Record that cost next to
     # the ledger row so the decomposition is honest: a ledger-off
@@ -259,7 +260,7 @@ def config3_pdes(detail):
     classic_wall = _time.perf_counter() - start
     detail["c3classic_64n_wall_s"] = round(classic_wall, 2)
     detail["c3classic_64n_unique_req_per_s"] = round(
-        6400 / classic_wall, 1
+        unique / classic_wall, 1
     )
     detail["c3_pdes_steps"] = classic_steps
     best_projection = None
@@ -282,7 +283,7 @@ def config3_pdes(detail):
         frac = (crit + barrier) / max(work + barrier, 1)
         detail[f"c3pdes{parts}_critical_path_frac"] = round(frac, 3)
         projected_wall = wall * frac
-        projected = 6400 / projected_wall
+        projected = unique / projected_wall
         detail[f"c3pdes{parts}_projected_unique_per_s"] = round(projected, 1)
         if best_projection is None or projected > best_projection[1]:
             best_projection = (parts, projected, frac)
@@ -642,18 +643,34 @@ def bench_device_resident(detail, hash_batch=4096, msg_len=640,
     dev_blocks = jax.device_put(blocks)
     dev_n = jax.device_put(n_blocks)
     np.asarray(sha256_batch_kernel(dev_blocks, dev_n))  # compile + warm
-    start = time.perf_counter()
-    out = None
-    for _ in range(reps):
-        out = sha256_batch_kernel(dev_blocks, dev_n)
-    np.asarray(out)
-    hash_ms = (time.perf_counter() - start) / reps * 1e3
+
+    def timed_depth(n):
+        start = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = sha256_batch_kernel(dev_blocks, dev_n)
+        out.block_until_ready()
+        return time.perf_counter() - start
+
+    # Per-dispatch time is a function of pipeline depth on this rig: each
+    # window pays ~one tunnel RTT regardless of depth, so shallow
+    # pipelines report mostly tunnel (round-3/4's 15-21 ms at depth 8 vs
+    # round-2's 4.3 ms at a deeper one — the "regression" that wasn't;
+    # docs/PERFORMANCE.md §3).  Record the depth-8 number for continuity
+    # AND the slope between depths 8 and 64, which cancels the constant
+    # RTT and is the honest device-kernel time.
+    t8 = timed_depth(reps)
+    t64 = timed_depth(64)
+    hash_ms = t8 / reps * 1e3
+    kernel_ms = max((t64 - t8) / (64 - reps) * 1e3, 1e-3)
     detail["hash_device_resident_4096_ms"] = round(hash_ms, 2)
     detail["hash_device_resident_per_s"] = round(hash_batch / (hash_ms / 1e3), 1)
+    detail["hash_device_kernel_4096_ms"] = round(kernel_ms, 2)
+    detail["hash_device_kernel_per_s"] = round(hash_batch / (kernel_ms / 1e3), 1)
     hash_int_ops = hash_batch * n_blocks_each * 2500
-    detail["hash_device_int_ops_per_s"] = round(hash_int_ops / (hash_ms / 1e3))
+    detail["hash_device_int_ops_per_s"] = round(hash_int_ops / (kernel_ms / 1e3))
     detail["hash_pct_of_chip_int8_peak"] = round(
-        100 * hash_int_ops / (hash_ms / 1e3) / 394e12, 3
+        100 * hash_int_ops / (kernel_ms / 1e3) / 394e12, 3
     )
 
     from cryptography.hazmat.primitives import serialization
@@ -679,20 +696,32 @@ def bench_device_resident(detail, hash_batch=4096, msg_len=640,
     )
     dev = [jax.device_put(a) for a in (ax, ay, r_bytes, s_bits, h_bits)]
     np.asarray(ed25519_verify_kernel(*dev, backend="vpu"))  # warm
-    start = time.perf_counter()
-    out = None
-    for _ in range(reps):
-        out = ed25519_verify_kernel(*dev, backend="vpu")
-    np.asarray(out)
-    ver_ms = (time.perf_counter() - start) / reps * 1e3
+
+    def timed_vdepth(n):
+        start = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = ed25519_verify_kernel(*dev, backend="vpu")
+        out.block_until_ready()
+        return time.perf_counter() - start
+
+    # Same depth-slope treatment as the hash kernel above.
+    vt8 = timed_vdepth(reps)
+    vt24 = timed_vdepth(24)
+    ver_ms = vt8 / reps * 1e3
+    vkernel_ms = max((vt24 - vt8) / (24 - reps) * 1e3, 1e-3)
     detail["verify_device_resident_1024_ms"] = round(ver_ms, 2)
     detail["verify_device_resident_per_s"] = round(
         verify_batch / (ver_ms / 1e3), 1
     )
+    detail["verify_device_kernel_1024_ms"] = round(vkernel_ms, 2)
+    detail["verify_device_kernel_per_s"] = round(
+        verify_batch / (vkernel_ms / 1e3), 1
+    )
     ed_int_ops = 280e9  # int-MACs per 1024-batch (docs/PERFORMANCE.md S2)
-    detail["verify_device_int_ops_per_s"] = round(ed_int_ops / (ver_ms / 1e3))
+    detail["verify_device_int_ops_per_s"] = round(ed_int_ops / (vkernel_ms / 1e3))
     detail["verify_pct_of_chip_int8_peak"] = round(
-        100 * ed_int_ops / (ver_ms / 1e3) / 394e12, 3
+        100 * ed_int_ops / (vkernel_ms / 1e3) / 394e12, 3
     )
 
 

@@ -1850,9 +1850,12 @@ struct CheckpointTracker {
         bool above_high = seq_no > high_watermark();
         if (above_high) {
             auto it = highest_checkpoints.find(source);
-            if (it != highest_checkpoints.end() && it->second <= seq_no)
-                return;  // mirrors the reference's replace-only-if-greater rule
-            highest_checkpoints[source] = seq_no;
+            if (it == highest_checkpoints.end() || seq_no > it->second)
+                highest_checkpoints[source] = seq_no;
+            // No early return: above-window agreements keep accumulating
+            // so the catch-up trigger can reach f+1 on a value even when
+            // sources' first reports straddle different seq_nos
+            // (checkpoints.py twin; Divergences.md #13).
         }
         auto cp = checkpoint(seq_no);
         cp->apply_checkpoint_msg(source, value);

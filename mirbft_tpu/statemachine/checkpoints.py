@@ -237,12 +237,17 @@ class CheckpointTracker:
         above_high = seq_no > self.high_watermark()
         if above_high:
             highest = self.highest_checkpoints.get(source)
-            if highest is not None and highest <= seq_no:
-                # Note (mirrors reference behavior): a strictly newer
-                # above-window checkpoint replaces the remembered one only if
-                # the remembered one is *greater*; equal-or-lower is ignored.
-                return
-            self.highest_checkpoints[source] = seq_no
+            if highest is None or seq_no > highest:
+                self.highest_checkpoints[source] = seq_no
+            # Deliberate divergence from the reference (part of
+            # Divergences.md #13): the reference drops a source's LATER
+            # above-window checkpoints outright (checkpoints.go:199-241,
+            # replace-only-if-greater with an early return), which was
+            # harmless when the tracking only fed far-future GC — but the
+            # catch-up trigger needs f+1 agreement on a VALUE, and
+            # staggered first-reports (e.g. under drop manglers) would
+            # otherwise never converge on any single seq_no.  Agreements
+            # keep accumulating; the per-checkpoint dedup handles repeats.
 
         cp = self.checkpoint(seq_no)
         cp.apply_checkpoint_msg(source, value)

@@ -553,7 +553,6 @@ def test_reconfig_with_crash_differential():
     _differential(spec, timeout=60_000_000)
 
 
-@pytest.mark.slow
 def test_c5_shape_differential():
     """BASELINE config 5's scenario shape at reduced scale: 16 nodes,
     signed requests with a byzantine signer, a mid-run reconfiguration
@@ -597,7 +596,6 @@ def test_c5_shape_differential():
     assert fr.node_transfers(15)[0], "late replica should state-transfer"
 
 
-@pytest.mark.slow
 def test_transfer_failure_retry_differential():
     """App-level transfer-failure injection: three failed attempts, then
     success after a doubling tick backoff — attempt times, failures, and
@@ -628,7 +626,6 @@ def test_transfer_failure_retry_differential():
     assert gaps[0] < gaps[1] < gaps[2], gaps
 
 
-@pytest.mark.slow
 @pytest.mark.parametrize("seed", [0, 3, 9, 17])
 def test_randomized_small_width_differential(seed):
     """Tiny client windows force the ack ledger's edge paths — FUTURE
@@ -652,7 +649,6 @@ def test_randomized_small_width_differential(seed):
     assert state_fast == state_py, spec
 
 
-@pytest.mark.slow
 @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5, 6])
 def test_randomized_differential(seed):
     """Seeded random in-envelope configs: node count, client count, request
