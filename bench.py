@@ -659,10 +659,11 @@ def bench_device_resident(detail, hash_batch=4096, msg_len=640,
     # docs/PERFORMANCE.md §3).  Record the depth-8 number for continuity
     # AND the slope between depths 8 and 64, which cancels the constant
     # RTT and is the honest device-kernel time.
+    deep = reps * 8
     t8 = timed_depth(reps)
-    t64 = timed_depth(64)
+    t64 = timed_depth(deep)
     hash_ms = t8 / reps * 1e3
-    kernel_ms = max((t64 - t8) / (64 - reps) * 1e3, 1e-3)
+    kernel_ms = max((t64 - t8) / (deep - reps) * 1e3, 1e-3)
     detail["hash_device_resident_4096_ms"] = round(hash_ms, 2)
     detail["hash_device_resident_per_s"] = round(hash_batch / (hash_ms / 1e3), 1)
     detail["hash_device_kernel_4096_ms"] = round(kernel_ms, 2)
@@ -706,10 +707,11 @@ def bench_device_resident(detail, hash_batch=4096, msg_len=640,
         return time.perf_counter() - start
 
     # Same depth-slope treatment as the hash kernel above.
+    vdeep = reps * 3
     vt8 = timed_vdepth(reps)
-    vt24 = timed_vdepth(24)
+    vt24 = timed_vdepth(vdeep)
     ver_ms = vt8 / reps * 1e3
-    vkernel_ms = max((vt24 - vt8) / (24 - reps) * 1e3, 1e-3)
+    vkernel_ms = max((vt24 - vt8) / (vdeep - reps) * 1e3, 1e-3)
     detail["verify_device_resident_1024_ms"] = round(ver_ms, 2)
     detail["verify_device_resident_per_s"] = round(
         verify_batch / (ver_ms / 1e3), 1

@@ -7012,6 +7012,34 @@ struct Engine {
     // independent of the pause.
     i64 ready_head_ctr = -1;  // head already vetted (pause/resume path)
 
+    // Deep device-work scan (device-authoritative stall collapse,
+    // docs/PERFORMANCE.md §9): every unsupplied wave-eligible content in
+    // ANY queued ProcessHash event — not just the head's — so one pause
+    // serves a whole generation of future waves in one dispatch+collect.
+    // Batch contents carry no dependency on earlier device digests, so
+    // everything visible is dispatchable immediately.
+    void collect_pending_hash_deep(deque<string> &out) {
+        // string_views over stable storage (need_hash_content is untouched
+        // here; out is a deque, so grown elements keep their addresses).
+        std::set<std::string_view> seen;
+        for (const auto &c : need_hash_content) seen.insert(c);
+        for (const auto &ev : queue.heap) {
+            if (ev.kind != SK::ProcessHash || !ev.actions) continue;
+            for (const auto &action : *ev.actions) {
+                if (action.t != AT::Hash) continue;
+                HashReqP hr = action.hash();
+                if (hash_is_host_floor(hr->parts)) continue;
+                string joined;
+                for (const auto &p : hr->parts) joined.append(p);
+                if (device_digests.find(joined) != device_digests.end())
+                    continue;
+                if (seen.count(joined)) continue;
+                out.push_back(std::move(joined));
+                seen.insert(out.back());
+            }
+        }
+    }
+
     bool check_ready() {
         if (!device_hash_mode && !streaming_auth_mode) return true;
         if (queue.heap.empty()) return true;
@@ -8342,7 +8370,18 @@ PyObject *engine_pending_device_work(PyObject *self, PyObject *) {
     Engine *e = ((PyEngine *)self)->engine;
     PyObject *contents = PyList_New(0);
     if (!contents) return nullptr;
-    for (const auto &c : e->need_hash_content) {
+    // Head needs first (these gate the pause), then every other
+    // unsupplied content visible in the queue (served in the same
+    // dispatch so later pauses usually find digests present).  Skip the
+    // deep scan on verdict-only pauses — nothing hash-related changed.
+    deque<string> deep;
+    if (e->device_hash_mode && !e->need_hash_content.empty())
+        e->collect_pending_hash_deep(deep);
+    vector<const string *> all;
+    for (const auto &c : e->need_hash_content) all.push_back(&c);
+    for (const auto &c : deep) all.push_back(&c);
+    for (const string *cp : all) {
+        const string &c = *cp;
         PyObject *b = PyBytes_FromStringAndSize(c.data(), (Py_ssize_t)c.size());
         if (!b || PyList_Append(contents, b) < 0) {
             Py_XDECREF(b);
