@@ -736,6 +736,11 @@ struct HashOriginS {
 struct HashReqS {
     vector<string> parts;
     HashOriginS origin;
+    // Deep-scan memo (device-authoritative pauses): joined key + state so
+    // repeated pauses never re-join or re-probe an action
+    // (0 = unjoined, 1 = joined, 2 = settled: host-floor or supplied).
+    mutable string scan_join;
+    mutable u8 scan_state = 0;
 };
 using HashReqP = shared_ptr<const HashReqS>;
 
@@ -7028,13 +7033,25 @@ struct Engine {
             for (const auto &action : *ev.actions) {
                 if (action.t != AT::Hash) continue;
                 HashReqP hr = action.hash();
-                if (hash_is_host_floor(hr->parts)) continue;
-                string joined;
-                for (const auto &p : hr->parts) joined.append(p);
-                if (device_digests.find(joined) != device_digests.end())
+                if (hr->scan_state == 2) continue;
+                if (hr->scan_state == 0) {
+                    if (hash_is_host_floor(hr->parts)) {
+                        hr->scan_state = 2;
+                        continue;
+                    }
+                    for (const auto &p : hr->parts)
+                        hr->scan_join.append(p);
+                    hr->scan_state = 1;
+                }
+                if (device_digests.find(hr->scan_join) !=
+                    device_digests.end()) {
+                    hr->scan_state = 2;
+                    hr->scan_join.clear();
+                    hr->scan_join.shrink_to_fit();
                     continue;
-                if (seen.count(joined)) continue;
-                out.push_back(std::move(joined));
+                }
+                if (seen.count(hr->scan_join)) continue;
+                out.push_back(hr->scan_join);
                 seen.insert(out.back());
             }
         }

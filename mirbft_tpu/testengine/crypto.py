@@ -332,6 +332,7 @@ class DeviceAuthPlane:
         wave_size: int = 128,
         device_floor: int = 16,
         lookahead: int = 128,
+        mesh_devices: int = 0,
     ):
         from ..ops.ed25519 import Ed25519BatchVerifier
 
@@ -340,7 +341,14 @@ class DeviceAuthPlane:
         self.wave_size = wave_size
         self.device_floor = device_floor
         self.lookahead = lookahead
-        self.verifier = Ed25519BatchVerifier(min_device_batch=device_floor)
+        mesh = None
+        if mesh_devices:
+            from ..parallel.mesh import make_mesh
+
+            mesh = make_mesh(mesh_devices)
+        self.verifier = Ed25519BatchVerifier(
+            min_device_batch=device_floor, mesh=mesh
+        )
         self.keys: Dict[int, bytes] = {}
         # (client_id, req_no, id(envelope)) -> (envelope ref, verdict);
         # bounded like the hash memo (entries pin their envelope objects)
@@ -418,7 +426,7 @@ class DeviceAuthPlane:
                     time.perf_counter() - pack_start
                 )
                 dispatch_start = time.perf_counter()
-                handle = self.verifier.dispatch(*packed)
+                handle = self.verifier.dispatch(*packed, n_real=len(items))
                 metrics.counter("device_dispatch_seconds").inc(
                     time.perf_counter() - dispatch_start
                 )

@@ -602,6 +602,8 @@ class FastRecording:
                     pubs[off:off + self.auth_wave],
                     msgs[off:off + self.auth_wave],
                     sigs[off:off + self.auth_wave],
+                    # Only the final chunk can contain wave-shape padding.
+                    n_real=max(0, min(self.auth_wave, total - off)),
                 )
             )
             metrics.counter("device_verify_dispatches").inc()

@@ -307,6 +307,11 @@ class CryptoConfig:
     auth_floor: int = 16
     lookahead: int = 128
     kernel: str = "scan"  # sha256 backend: "scan" | "pallas"
+    # > 0: build a jax.sharding.Mesh over this many devices and route the
+    # auth plane's verify waves through the batch-sharded multi-chip
+    # kernel (parallel.sharded_ed25519_verify) — consensus traffic then
+    # transits the mesh.  Verdicts stay bit-identical to single-device.
+    mesh_devices: int = 0
     # Re-schedule (in sim time) hash events whose device dispatch is still
     # in flight rather than blocking the host loop.  Step counts become
     # wall-clock-dependent, and on a single-core host the re-scheduled
@@ -483,6 +488,7 @@ class Recorder:
                 wave_size=crypto.auth_wave,
                 device_floor=crypto.auth_floor,
                 lookahead=crypto.lookahead,
+                mesh_devices=crypto.mesh_devices,
             )
             for client_id, pub in signed_pubs.items():
                 auth_plane.register(client_id, pub)
