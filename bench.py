@@ -875,6 +875,15 @@ def main():
             else {}
         )
         runs = [run_fast_engine(64, 64, 100, 100, device=True) for _ in range(2)]
+        # Snapshot the global part counters HERE: any engine run between
+        # the snapshots (c3dev, PDES rows) pollutes the ack-share delta —
+        # round 4's reported ack-share doubling was exactly this artifact
+        # (c3dev ran inside the window).
+        parts_after_runs = (
+            _native.load_fast().profile_globals()
+            if _native.load_fast() is not None
+            else {}
+        )
         for r in runs:
             assert r["steps"] == detail["c3py_64n_sim_steps"], "engine divergence"
         detail["c3_64n_wall_runs_s"] = [round(r["wall_s"], 2) for r in runs]
@@ -906,10 +915,6 @@ def main():
         detail["c3dev_64n_stall_s"] = round(res_dev["device_stall_s"], 2)
     except Exception as exc:
         detail["c3dev_error"] = f"{type(exc).__name__}: {exc}"[:160]
-    try:
-        config3_pdes(detail)
-    except Exception as exc:
-        detail["c3pdes_error"] = f"{type(exc).__name__}: {exc}"[:160]
     if res is not res_py:
         # Mean fast wall vs the single Python run: comparing best-of-2
         # against a single sample would bias the ratio upward.
@@ -922,10 +927,9 @@ def main():
             # against both runs' per-engine cycle totals.  The ack-
             # dissemination share backs the O(N^2) ceiling analysis in
             # docs/PERFORMANCE.md §6.
-            parts_after = _native.load_fast().profile_globals()
-            ack_delta = parts_after.get("p_ackbatch", 0) - parts_before.get(
+            ack_delta = parts_after_runs.get(
                 "p_ackbatch", 0
-            )
+            ) - parts_before.get("p_ackbatch", 0)
             total = 0
             for engine in engines:
                 prof = engine.profile()
@@ -940,6 +944,13 @@ def main():
         for r in runs:
             r.pop("recording", None)
         del engines  # release the retired native clusters
+
+    # PDES rows (the ack-share delta above is already insulated by the
+    # parts_after_runs snapshot; this ordering just groups the rows).
+    try:
+        config3_pdes(detail)
+    except Exception as exc:
+        detail["c3pdes_error"] = f"{type(exc).__name__}: {exc}"[:160]
 
     # Configs 4 and 5 (BASELINE configs[3..4]).
     try:

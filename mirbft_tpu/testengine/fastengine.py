@@ -334,6 +334,21 @@ class FastRecording:
 
     # -- device planes -----------------------------------------------------
 
+    def _make_verifier(self):
+        """Ed25519 verifier for the wrapper's device paths, honoring
+        ``spec.crypto.mesh_devices`` (verify waves then run the
+        batch-sharded multi-chip kernel, as on the Python engine)."""
+        from ..ops.ed25519 import Ed25519BatchVerifier
+
+        mesh = None
+        crypto = self.spec.crypto
+        if crypto is not None and getattr(crypto, "mesh_devices", 0):
+            from ..parallel.mesh import make_mesh
+
+            mesh = make_mesh(crypto.mesh_devices)
+        return Ed25519BatchVerifier(min_device_batch=1, mesh=mesh)
+
+
     def _device_verdicts(
         self, signed_rows, sim_clients, payloads_by_client, auth_wave
     ) -> Dict[int, bytes]:
@@ -366,9 +381,7 @@ class FastRecording:
             sigs.append(signature)
 
         if self.device:
-            from ..ops.ed25519 import Ed25519BatchVerifier
-
-            verifier = Ed25519BatchVerifier(min_device_batch=1)
+            verifier = self._make_verifier()
             handles = []
             for start in range(0, len(pubs), auth_wave):
                 handles.append(
@@ -538,9 +551,7 @@ class FastRecording:
         from ..processor.verify import signing_payload, unseal
 
         if self._verifier is None:
-            from ..ops.ed25519 import Ed25519BatchVerifier
-
-            self._verifier = Ed25519BatchVerifier(min_device_batch=1)
+            self._verifier = self._make_verifier()
         need_by_client = {cid: need_to for cid, need_to in verdict_needs}
         plan: List[Tuple[int, int, int]] = []  # (client, start, stop)
         for cid, (pub, payloads, have) in self._stream_clients.items():
