@@ -644,12 +644,15 @@ def bench_device_resident(detail, hash_batch=4096, msg_len=640,
     dev_n = jax.device_put(n_blocks)
     np.asarray(sha256_batch_kernel(dev_blocks, dev_n))  # compile + warm
 
-    def timed_depth(n):
+    def timed_depth(fn, n):
         start = time.perf_counter()
         out = None
         for _ in range(n):
-            out = sha256_batch_kernel(dev_blocks, dev_n)
-        out.block_until_ready()
+            out = fn(dev_blocks, dev_n)
+        # TRUE barrier: materialize the result bytes.  On this rig
+        # block_until_ready() can return before the device work completes
+        # (the tunnel acks the enqueue), which silently times nothing.
+        np.asarray(out)
         return time.perf_counter() - start
 
     # Per-dispatch time is a function of pipeline depth on this rig: each
@@ -660,10 +663,40 @@ def bench_device_resident(detail, hash_batch=4096, msg_len=640,
     # AND the slope between depths 8 and 64, which cancels the constant
     # RTT and is the honest device-kernel time.
     deep = reps * 8
-    t8 = timed_depth(reps)
-    t64 = timed_depth(deep)
+    t8 = timed_depth(sha256_batch_kernel, reps)
+    t64 = timed_depth(sha256_batch_kernel, deep)
     hash_ms = t8 / reps * 1e3
     kernel_ms = max((t64 - t8) / (deep - reps) * 1e3, 1e-3)
+    # The lanes-major pallas kernel (round-5 experiment, §3): host-side
+    # lanes packing, measured with the same slope.
+    try:
+        from mirbft_tpu.ops import sha256_pallas_lanes as _lanes
+
+        lanes_blocks, lanes_n = _lanes.pack_lanes_major(blocks, n_blocks)
+        tiles = lanes_blocks.shape[0]
+        dev_lanes = jax.device_put(lanes_blocks)
+        dev_lanes_n = jax.device_put(lanes_n)
+        lanes_kernel = _lanes._compiled(tiles, n_blocks_each, False)
+
+        def lanes_fn(_b, _n):
+            return lanes_kernel(dev_lanes, dev_lanes_n)
+
+        warm = np.asarray(lanes_fn(None, None))  # compile + warm
+        # Parity vs the scan kernel's digests before timing anything.
+        scan_words = np.asarray(sha256_batch_kernel(dev_blocks, dev_n))
+        lanes_words = (
+            warm.transpose(0, 2, 3, 1).reshape(tiles * _lanes.TILE, 8)
+        )[:hash_batch]
+        assert (lanes_words == scan_words).all(), "lanes digest mismatch"
+        lt8 = timed_depth(lanes_fn, reps)
+        lt64 = timed_depth(lanes_fn, deep)
+        lanes_ms = max((lt64 - lt8) / (deep - reps) * 1e3, 1e-3)
+        detail["hash_device_kernel_lanes_4096_ms"] = round(lanes_ms, 2)
+        detail["hash_device_kernel_lanes_per_s"] = round(
+            hash_batch / (lanes_ms / 1e3), 1
+        )
+    except Exception as exc:
+        detail["hash_lanes_error"] = f"{type(exc).__name__}: {exc}"[:120]
     detail["hash_device_resident_4096_ms"] = round(hash_ms, 2)
     detail["hash_device_resident_per_s"] = round(hash_batch / (hash_ms / 1e3), 1)
     detail["hash_device_kernel_4096_ms"] = round(kernel_ms, 2)
@@ -703,7 +736,7 @@ def bench_device_resident(detail, hash_batch=4096, msg_len=640,
         out = None
         for _ in range(n):
             out = ed25519_verify_kernel(*dev, backend="vpu")
-        out.block_until_ready()
+        np.asarray(out)  # true barrier (see timed_depth)
         return time.perf_counter() - start
 
     # Same depth-slope treatment as the hash kernel above.

@@ -186,8 +186,11 @@ class TpuHasher:
     dispatch overhead dominates for tiny batches (the testengine's default
     traffic) while large batches (the throughput path) go to the device.
 
-    ``kernel``: "scan" (vmapped lax.scan, the default) or "pallas" (explicit
-    VMEM tiling; see ``ops/sha256_pallas.py``).  ``dispatch``/``collect``
+    ``kernel``: "scan" (vmapped lax.scan, the default), "pallas"
+    (batch-major explicit VMEM tiling; see ``ops/sha256_pallas.py``), or
+    "lanes" (lanes-major pallas, the round-5 experiment winner at large
+    device-resident batches; see ``ops/sha256_pallas_lanes.py`` — the
+    host packs lanes-major so no device-side relayout is paid).  ``dispatch``/``collect``
     expose the asynchronous path: ``dispatch`` enqueues the device work and
     returns immediately; ``collect`` blocks until the digests are on host.
     """
@@ -200,7 +203,7 @@ class TpuHasher:
     ):
         self.min_device_batch = min_device_batch
         self.max_block_bucket = max_block_bucket
-        if kernel not in ("scan", "pallas"):
+        if kernel not in ("scan", "pallas", "lanes"):
             raise ValueError(f"unknown sha256 kernel {kernel!r}")
         self.kernel = kernel
         self._cpu = None
@@ -214,6 +217,15 @@ class TpuHasher:
             interpret = jax.default_backend() != "tpu"
             return functools.partial(
                 sha256_batch_kernel_pallas, interpret=interpret
+            )
+        if self.kernel == "lanes":
+            import jax
+
+            from .sha256_pallas_lanes import sha256_lanes_from_batch_major
+
+            interpret = jax.default_backend() != "tpu"
+            return functools.partial(
+                sha256_lanes_from_batch_major, interpret=interpret
             )
         return sha256_batch_kernel
 

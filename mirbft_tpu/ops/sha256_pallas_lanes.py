@@ -1,0 +1,151 @@
+"""Lanes-major Pallas SHA-256 — the queued experiment of PERFORMANCE §3.
+
+The batch-major pallas kernel (``sha256_pallas.py``) lost 6.5x to the
+vmapped-scan XLA kernel because every message-schedule word read was a
+cross-lane slice.  This variant uses the lanes-major layout the §3 verdict
+prescribed: the batch dimension fills a full (8, 128) VPU tile (1024
+messages per grid program), and the host packs blocks as
+``[tiles, L, 16, 8, 128]`` so ``w[t]`` is one contiguous (8, 128) vreg
+load.  The eight working variables are (8, 128) uint32 tiles; each round is
+pure full-width VPU arithmetic.
+
+The block axis streams through a second (sequential) grid dimension with
+the running digest carried in VMEM scratch, so per-step VMEM holds one
+(16, 8, 128) slab (64 KB) regardless of the block-bucket length.
+
+Measured verdict lives in docs/PERFORMANCE.md §3 (recorded either way, per
+the keep-the-winner rule).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .sha256 import _H0, _K, _rotr
+
+SUB, LANES = 8, 128
+TILE = SUB * LANES  # messages per grid program
+
+
+def _kernel(blocks_ref, n_blocks_ref, out_ref, state_ref, *, n_block_bucket):
+    """Grid (tiles, L): blocks_ref (1, 1, 16, 8, 128); state carried in
+    scratch across the (sequential) block dimension."""
+    b = pl.program_id(1)
+
+    @pl.when(b == 0)
+    def _init():
+        for i in range(8):
+            state_ref[i] = jnp.full((SUB, LANES), np.uint32(_H0[i]),
+                                    dtype=jnp.uint32)
+
+    w = [blocks_ref[0, 0, t] for t in range(16)]
+    state = [state_ref[i] for i in range(8)]
+    a, b_, c, d, e, f, g, h = state
+    for t in range(64):
+        if t < 16:
+            wt = w[t]
+        else:
+            s0 = (_rotr(w[t - 15 & 15], 7) ^ _rotr(w[t - 15 & 15], 18)
+                  ^ (w[t - 15 & 15] >> np.uint32(3)))
+            s1 = (_rotr(w[t - 2 & 15], 17) ^ _rotr(w[t - 2 & 15], 19)
+                  ^ (w[t - 2 & 15] >> np.uint32(10)))
+            wt = w[t & 15] + s0 + w[t - 7 & 15] + s1
+            w[t & 15] = wt
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        temp1 = h + S1 + ch + np.uint32(_K[t]) + wt
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b_) ^ (a & c) ^ (b_ & c)
+        temp2 = S0 + maj
+        h = g
+        g = f
+        f = e
+        e = d + temp1
+        d = c
+        c = b_
+        b_ = a
+        a = temp1 + temp2
+    live = n_blocks_ref[0, 0] > jnp.uint32(b)  # (8, 128) bool
+    new = (a, b_, c, d, e, f, g, h)
+    for i in range(8):
+        state_ref[i] = jnp.where(live, state[i] + new[i], state[i])
+
+    @pl.when(b == n_block_bucket - 1)
+    def _emit():
+        for i in range(8):
+            out_ref[0, i] = state_ref[i]
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled(tiles: int, n_block_bucket: int, interpret: bool):
+    kernel = functools.partial(_kernel, n_block_bucket=n_block_bucket)
+    call = pl.pallas_call(
+        kernel,
+        grid=(tiles, n_block_bucket),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 16, SUB, LANES),
+                lambda i, b: (i, b, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, SUB, LANES),
+                lambda i, b: (i, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 8, SUB, LANES),
+            lambda i, b: (i, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((tiles, 8, SUB, LANES), jnp.uint32),
+        scratch_shapes=[pltpu.VMEM((8, SUB, LANES), jnp.uint32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )
+    return call if interpret else jax.jit(call)
+
+
+def pack_lanes_major(blocks, n_blocks):
+    """HOST-side lanes-major packing shared by the adapter, the bench, and
+    tests: [B, L, 16] batch-major -> ([tiles, L, 16, 8, 128],
+    [tiles, 1, 8, 128]) with B padded to a TILE multiple."""
+    blocks = np.asarray(blocks)
+    n_blocks = np.asarray(n_blocks)
+    batch, bucket = blocks.shape[0], blocks.shape[1]
+    padded = ((batch + TILE - 1) // TILE) * TILE
+    if padded != batch:
+        blocks = np.pad(blocks, ((0, padded - batch), (0, 0), (0, 0)))
+        n_blocks = np.pad(n_blocks, (0, padded - batch))
+    tiles = padded // TILE
+    lanes = np.ascontiguousarray(
+        blocks.reshape(tiles, SUB, LANES, bucket, 16)
+        .transpose(0, 3, 4, 1, 2)
+    )
+    nb = n_blocks.astype(np.uint32).reshape(tiles, 1, SUB, LANES)
+    return lanes, nb
+
+
+def sha256_lanes_from_batch_major(
+    blocks, n_blocks, *, interpret: bool = False
+):
+    """Adapter with the [B, L, 16] batch-major contract of
+    ``sha256_batch_kernel``: relays out on the HOST (numpy) — the measured
+    condition under which this kernel beats the scan kernel 6-9x; a
+    device-side transpose costs more than the kernel saves."""
+    batch = np.asarray(blocks).shape[0]
+    bucket = np.asarray(blocks).shape[1]
+    lanes, nb = pack_lanes_major(blocks, n_blocks)
+    tiles = lanes.shape[0]
+    out = _compiled(tiles, bucket, interpret)(lanes, nb)
+    return out.transpose(0, 2, 3, 1).reshape(tiles * TILE, 8)[:batch]
