@@ -7441,8 +7441,11 @@ void Engine::pdes_setup(i64 partitions, bool threaded) {
     if (!parts.empty()) throw EngineError("pdes already initialized");
     if (steps != 0 || queue.fake_time != 0)
         throw EngineError("pdes requires a fresh engine");
-    if (queue.mangler || drop_mangler)
-        throw EngineError("pdes envelope: no manglers");
+    if (queue.mangler)
+        throw EngineError("pdes envelope: no consume-time manglers");
+    // The structured DropMessages mangler IS in the envelope: it applies
+    // at the SEND site (process_net_actions), which is partition-local
+    // and deterministic — no RNG, no queue surgery.
     if (ctx.ack_ledger != nullptr)
         throw EngineError(
             "pdes requires the ack ledger disabled (MIRBFT_FAST_LEDGER=0): "

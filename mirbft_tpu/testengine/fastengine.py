@@ -194,11 +194,14 @@ class FastRecording:
         across ``pdes_partitions`` workers synchronized at link-latency
         lookahead barriers, bit-identical to the sequential engine.
         ``pdes_threaded`` executes partitions on real threads (correctness
-        identical; speedup requires cores).  The PDES envelope is the
-        mangler-free green path: no device modes, no reconfiguration, no
-        start delays / ignored nodes, uniform link latency; the ack ledger
-        is disabled at construction (the classic per-receiver ack path
-        partitions cleanly; the ledger is cluster-shared state)."""
+        identical; speedup requires cores).  The PDES envelope: the green
+        path plus the structured ``DropMessages`` mangler (applied at the
+        partition-local send site — BASELINE config 4's silenced-leader
+        scenario partitions cleanly); no consume-time manglers, no device
+        modes, no reconfiguration, no start delays / ignored nodes,
+        uniform link latency.  The ack ledger is disabled at construction
+        (the classic per-receiver ack path partitions cleanly; the ledger
+        is cluster-shared state)."""
         _require(_native.load_fast() is not None, "native engine unavailable")
         _require(1 <= spec.node_count <= 256, ">256 nodes")
         if device_authoritative or streaming_auth:

@@ -157,3 +157,27 @@ def test_pdes_envelope_rejections():
             device=True,
             pdes_partitions=2,
         )
+
+
+def test_pdes_drop_messages_silenced_leader():
+    """The structured DropMessages mangler is inside the PDES envelope
+    (applied at the partition-local send site, no RNG): BASELINE config
+    4's silenced-leader shape stays bit-identical under partitioning —
+    epoch changes included."""
+    from mirbft_tpu.testengine.manglers import DropMessages
+
+    spec = Spec(
+        node_count=16, client_count=4, reqs_per_client=10, batch_size=2,
+        tweak_recorder=lambda r: setattr(
+            r, "mangler", DropMessages(from_nodes=(0,))
+        ),
+    )
+    steps, fake_time, state = _run_seq(spec, timeout=30_000_000)
+    assert any(n[2] > 0 for n in state), "scenario must force an epoch change"
+    for partitions, threaded in [(4, False), (8, True)]:
+        pdes = FastRecording(
+            spec, pdes_partitions=partitions, pdes_threaded=threaded
+        )
+        assert pdes.drain_clients(timeout=30_000_000) == steps
+        assert pdes.stats()[1] == fake_time
+        assert _state(pdes) == state
