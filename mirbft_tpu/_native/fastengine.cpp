@@ -6571,6 +6571,10 @@ struct Engine {
     u64 kind_counts[11] = {0};
     u64 ev_cycles[12] = {0};
     u64 ev_counts[12] = {0};
+    // Per-message-kind attribution of Step-event application (the c4
+    // profile's dominant bucket): indexed by MT.
+    u64 msg_cycles[16] = {0};
+    u64 msg_counts[16] = {0};
     u64 fix_cycles = 0;  // post-event GC+fixpoint share (inside apply_event)
     u64 crypto_ns = 0;  // host CPU spent hashing (SHA-256) in-engine
     // Wave mirror log: (joined message id, digest id) for wave-eligible
@@ -6988,8 +6992,14 @@ struct Engine {
             }
             u64 t0 = __rdtsc();
             concat(actions, node.machine->apply_event(event));
-            ev_cycles[(int)event.t] += __rdtsc() - t0;
+            u64 dt = __rdtsc() - t0;
+            ev_cycles[(int)event.t] += dt;
             ev_counts[(int)event.t] += 1;
+            if (event.t == ET::Step && event.payload) {
+                const MsgS *m = (const MsgS *)event.payload.get();
+                msg_cycles[(int)m->t] += dt;
+                msg_counts[(int)m->t] += 1;
+            }
         }
         EventS marker;
         marker.t = ET::ActionsReceived;
@@ -8380,6 +8390,17 @@ PyObject *engine_profile(PyObject *self, PyObject *) {
         PyObject *v = Py_BuildValue("KK", (unsigned long long)e->ev_cycles[i],
                                     (unsigned long long)e->ev_counts[i]);
         if (PyDictSetItemStringSteal(out, ev_names[i], v) < 0) return nullptr;
+    }
+    static const char *mt_names[16] = {
+        "mt_preprepare", "mt_prepare", "mt_commit", "mt_checkpoint",
+        "mt_suspect", "mt_epoch_change", "mt_epoch_change_ack",
+        "mt_new_epoch", "mt_new_epoch_echo", "mt_new_epoch_ready",
+        "mt_fetch_batch", "mt_forward_batch", "mt_fetch_request",
+        "mt_ack", "mt_ack_batch", "mt_msg_batch"};
+    for (int i = 0; i < 16; i++) {
+        PyObject *v = Py_BuildValue("KK", (unsigned long long)e->msg_cycles[i],
+                                    (unsigned long long)e->msg_counts[i]);
+        if (PyDictSetItemStringSteal(out, mt_names[i], v) < 0) return nullptr;
     }
     return out;
 }
