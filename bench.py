@@ -143,7 +143,7 @@ def run_fast_engine(
         "commit_ops": commit_ops,
         "commit_ops_per_s": commit_ops / elapsed,
         "host_crypto_s": recording.host_crypto_seconds(),
-        "device_wait_s": float(snap.get("device_wait_seconds", 0.0)),
+        "device_wait_s": float(snap.get("device_wait_seconds_sum", 0.0)),
         # Same definition as the Python engine's: host crypto over wall.
         "host_crypto_share": recording.host_crypto_seconds() / elapsed,
         "hash_dispatches": int(snap.get("device_hash_dispatches", 0)),
@@ -206,7 +206,7 @@ def run_engine(
         "commit_ops": int(snap.get("committed_requests", 0)),
         "commit_ops_per_s": snap.get("committed_requests", 0) / elapsed,
         "host_crypto_s": float(snap.get("host_crypto_seconds", 0.0)),
-        "device_wait_s": float(snap.get("device_wait_seconds", 0.0)),
+        "device_wait_s": float(snap.get("device_wait_seconds_sum", 0.0)),
         "host_crypto_share": float(snap.get("host_crypto_seconds", 0.0))
         / elapsed,
         "hash_dispatches": int(snap.get("device_hash_dispatches", 0)),
@@ -514,6 +514,33 @@ def config5_reconfig_byzantine(detail):
     )
 
 
+def emit_observability_artifacts(detail):
+    """One small traced testengine run, exported as the observability
+    artifacts (docs/OBSERVABILITY.md): BENCH_TRACE.json is a Chrome
+    trace-event file (sim-domain commit spans; load in Perfetto) and
+    BENCH_PROM.txt is the Prometheus text exposition of the run's metrics.
+    Runs outside every timed window — the headline configs trace nothing."""
+    from mirbft_tpu import metrics, tracing
+    from mirbft_tpu.testengine import Spec
+
+    metrics.default_registry.reset()
+    spec = Spec(
+        node_count=4, client_count=2, reqs_per_client=10, batch_size=10
+    )
+    recorder = spec.recorder()
+    tracer = tracing.Tracer(capacity=1 << 18, enabled=True)
+    recorder.tracer = tracer
+    recording = recorder.recording()
+    recording.drain_clients(timeout=20_000_000)
+    tracer.export("BENCH_TRACE.json")
+    with open("BENCH_PROM.txt", "w") as f:
+        f.write(metrics.render_prometheus())
+    detail["trace_events"] = len(tracer)
+    detail["trace_commit_spans"] = sum(
+        t.committed for t in recording.span_trackers.values()
+    )
+
+
 def bench_tpu_hash_kernel(batch=4096, msg_len=640, pipeline=20):
     """Pipelined vs sync dispatch of the batched SHA-256 kernel."""
     import numpy as np
@@ -547,12 +574,7 @@ def bench_tpu_verify_kernel(
     Returns (sigs_per_s, pipelined_per_dispatch_s, sync_p99_s): the p99 is
     over ``sync_reps`` blocking dispatch round-trips — what a latency-bound
     caller observes, tunnel RTT included (round-1 semantics)."""
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
-
-    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier
+    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier, keypair_from_seed
 
     verifier = Ed25519BatchVerifier(min_device_batch=1, kernel=kernel)
     pubs, msgs, sigs = [], [], []
@@ -560,19 +582,12 @@ def bench_tpu_verify_kernel(
     for i in range(batch):
         cid = i % n_keys
         if cid not in keys:
-            keys[cid] = Ed25519PrivateKey.from_private_bytes(
-                (cid + 1).to_bytes(4, "big") * 8
-            )
+            keys[cid] = keypair_from_seed((cid + 1).to_bytes(4, "big") * 8)
         m = b"bench-request-%d" % i
-        pubs.append(
-            keys[cid]
-            .public_key()
-            .public_bytes(
-                serialization.Encoding.Raw, serialization.PublicFormat.Raw
-            )
-        )
+        pub, sign = keys[cid]
+        pubs.append(pub)
         msgs.append(m)
-        sigs.append(keys[cid].sign(m))
+        sigs.append(sign(m))
 
     ok = verifier.collect(verifier.dispatch(pubs, msgs, sigs))  # warm
     if not ok.all():
@@ -707,24 +722,20 @@ def bench_device_resident(detail, hash_batch=4096, msg_len=640,
         100 * hash_int_ops / (kernel_ms / 1e3) / 394e12, 3
     )
 
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
+    from mirbft_tpu.ops.ed25519 import (
+        Ed25519BatchVerifier,
+        ed25519_verify_kernel,
+        keypair_from_seed,
     )
-
-    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier, ed25519_verify_kernel
 
     verifier = Ed25519BatchVerifier(min_device_batch=1)
-    key = Ed25519PrivateKey.from_private_bytes(b"\x07" * 32)
-    pub = key.public_key().public_bytes(
-        serialization.Encoding.Raw, serialization.PublicFormat.Raw
-    )
+    pub, sign = keypair_from_seed(b"\x07" * 32)
     pubs, vmsgs, sigs = [], [], []
     for i in range(verify_batch):
         m = b"resident-%d" % i
         pubs.append(pub)
         vmsgs.append(m)
-        sigs.append(key.sign(m))
+        sigs.append(sign(m))
     ax, ay, r_bytes, s_bits, h_bits, _valid = verifier.pack_inputs(
         pubs, vmsgs, sigs
     )
@@ -1034,6 +1045,11 @@ def main():
         detail["sig_verify_dispatch_1024_mxu_ms"] = round(piped_mxu * 1e3, 2)
     except Exception:
         detail["sig_verify_dispatch_1024_mxu_ms"] = None
+
+    try:
+        emit_observability_artifacts(detail)
+    except Exception as exc:
+        detail["observability_error"] = f"{type(exc).__name__}: {exc}"[:160]
 
     result = {
         "metric": "unique committed req/s (64-replica testengine)",

@@ -15,8 +15,17 @@ Reconfiguration variants.
 
 from __future__ import annotations
 
+import sys
 from dataclasses import dataclass, field
 from typing import Tuple, Union
+
+if sys.version_info >= (3, 11):
+    # voteplane's split cache holds weakrefs to MsgBatch envelopes, which a
+    # slots dataclass only supports via 3.11's weakref_slot.
+    _weakrefable_dataclass = dataclass(frozen=True, slots=True, weakref_slot=True)
+else:
+    # 3.10 has no weakref_slot: forgo slots so __weakref__ exists.
+    _weakrefable_dataclass = dataclass(frozen=True)
 
 # ---------------------------------------------------------------------------
 # Network state (consensused configuration).  Reference: msgs.proto:18-111.
@@ -242,7 +251,7 @@ class AckMsg:
     ack: RequestAck
 
 
-@dataclass(frozen=True, slots=True, weakref_slot=True)
+@_weakrefable_dataclass
 class MsgBatch:
     """Transport envelope: a sequence of consensus messages from one sender
     to the same targets, delivered atomically.  Nesting is not allowed.

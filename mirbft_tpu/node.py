@@ -23,8 +23,10 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import metrics as metrics_mod
 from . import processor as proc
 from . import status as status_mod
+from . import tracing
 from .config import Config
 from .messages import Msg, NetworkState
 from .statemachine.actions import Actions, Events
@@ -159,6 +161,14 @@ class Node:
         self._threads: List[threading.Thread] = []
         self._tick_thread: Optional[threading.Thread] = None
         self._started = False
+        # Wall-clock commit spans: derived from the event/action stream on
+        # the result worker (the only thread touching the state machine), so
+        # no extra synchronization is needed.  Feeds the per-node
+        # commit_latency_seconds histogram; span records go to the process
+        # default tracer only while it is enabled.
+        self.span_tracker = tracing.CommitSpanTracker(
+            tracing.default_tracer, node_id
+        )
 
     # --- boot (reference mirbft.go:436-464) ---
 
@@ -263,10 +273,23 @@ class Node:
             "req_store": lambda events: proc.process_reqstore_events(
                 pc.request_store, events
             ),
-            "result": lambda events: proc.process_state_machine_events(
-                self.state_machine, pc.interceptor, events
-            ),
+            "result": self._process_result_events,
         }
+
+    def _process_result_events(self, events: Events) -> Actions:
+        actions = proc.process_state_machine_events(
+            self.state_machine, self.processor_config.interceptor, events
+        )
+        self.span_tracker.observe(events, actions)
+        return actions
+
+    def metrics_text(self, registry=None) -> str:
+        """Prometheus text exposition of the metrics registry, labeled with
+        this node's id — the scrape surface an embedder serves over HTTP
+        (docs/OBSERVABILITY.md)."""
+        return metrics_mod.render_prometheus(
+            registry, extra_labels={"node": str(self.id)}
+        )
 
     # --- coordinator (reference mirbft.go:465-565) ---
 

@@ -483,6 +483,57 @@ def verify_one(pub: bytes, msg: bytes, sig: bytes) -> bool:
     return _compress_py(q) == sig[:32]
 
 
+_BASE_PT = (_BASE_X, _BASE_Y, 1, _BASE_X * _BASE_Y % P)
+
+
+def _clamp_scalar(h32: bytes) -> int:
+    a = _sc_from_bytes_le(h32)
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a
+
+
+def public_from_seed(seed: bytes) -> bytes:
+    """RFC 8032 public-key derivation from a 32-byte seed (pure Python)."""
+    h = hashlib.sha512(seed).digest()
+    return _compress_py(_pt_mul_py(_clamp_scalar(h[:32]), _BASE_PT))
+
+
+def sign_one(seed: bytes, msg: bytes) -> bytes:
+    """Pure-Python RFC 8032 signing — the twin of ``verify_one``.  Slow
+    (two scalar mults in host ints) but dependency-free; signatures are
+    deterministic and byte-identical to the ``cryptography`` package's."""
+    h = hashlib.sha512(seed).digest()
+    a = _clamp_scalar(h[:32])
+    pub = _compress_py(_pt_mul_py(a, _BASE_PT))
+    r = _sc_from_bytes_le(hashlib.sha512(h[32:] + msg).digest()) % L
+    r_bytes = _compress_py(_pt_mul_py(r, _BASE_PT))
+    s = (r + _challenge(r_bytes, pub, msg) * a) % L
+    return r_bytes + s.to_bytes(32, "little")
+
+
+def keypair_from_seed(seed: bytes):
+    """``(public_key_bytes, sign_callable)`` for a 32-byte seed.
+
+    Uses the ``cryptography`` package when installed (C-speed signing);
+    otherwise falls back to the pure-Python RFC 8032 path above.  Both
+    produce identical deterministic signatures, so sim runs and recorded
+    logs are byte-identical across environments.
+    """
+    try:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+    except ImportError:
+        return public_from_seed(seed), lambda msg: sign_one(seed, msg)
+    key = Ed25519PrivateKey.from_private_bytes(seed)
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    return pub, key.sign
+
+
 def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 

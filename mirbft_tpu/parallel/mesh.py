@@ -19,6 +19,21 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:
+    _shard_map = jax.shard_map
+except AttributeError:  # pre-0.5 jax: experimental module, check_rep kwarg
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
 from ..ops.sha256 import _sha256_padded
 
 BATCH_AXIS = "batch"
@@ -74,7 +89,7 @@ def distributed_verify_step(mesh: Mesh):
         total_mismatches = jax.lax.psum(mismatches, BATCH_AXIS)
         return digests, total_mismatches
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step,
         mesh=mesh,
         in_specs=(P(BATCH_AXIS, None, None), P(BATCH_AXIS), P(BATCH_AXIS, None)),
@@ -119,7 +134,7 @@ def sharded_ed25519_verify(mesh: Mesh, kernel: str = "vpu"):
         return ok, invalid
 
     row = P(BATCH_AXIS, None)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step,
         mesh=mesh,
         in_specs=(row, row, row, row, row, P(BATCH_AXIS), P(BATCH_AXIS)),

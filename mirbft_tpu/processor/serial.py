@@ -13,7 +13,7 @@ from __future__ import annotations
 import operator
 from typing import List, Optional
 
-from .. import metrics
+from .. import metrics, tracing
 from .. import state as st
 from .. import messages as m
 from ..messages import CEntry, EpochConfig, FEntry, NetworkState, Persistent
@@ -176,8 +176,13 @@ def process_hash_actions(hasher: Hasher, actions: Actions) -> Events:
     if not hash_actions:
         return events
     metrics.histogram("hash_batch_size").observe(len(hash_actions))
-    with metrics.timer("hash_dispatch_seconds"):
-        digests = hasher.hash_batches([action.data for action in hash_actions])
+    with tracing.default_tracer.span(
+        "hash_batch", tid=1, args={"batches": len(hash_actions)}
+    ):
+        with metrics.timer("hash_dispatch_seconds"):
+            digests = hasher.hash_batches(
+                [action.data for action in hash_actions]
+            )
     if len(digests) != len(hash_actions):
         raise AssertionError("hasher returned wrong number of digests")
     for action, digest in zip(hash_actions, digests):

@@ -22,6 +22,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import tracing
+
 DOMAIN = b"mirbft-tpu/req/v1\x00"
 SIGNATURE_LEN = 64
 
@@ -126,7 +128,10 @@ class RequestAuthenticator:
             rows.append(i)
         if rows:
             start = time.perf_counter()
-            verdicts = self.verifier.verify_batch(pubs, msgs, sigs)
+            with tracing.default_tracer.span(
+                "auth_batch", tid=2, args={"signatures": len(rows)}
+            ):
+                verdicts = self.verifier.verify_batch(pubs, msgs, sigs)
             self.dispatch_seconds.append(time.perf_counter() - start)
             self.verified_count += len(rows)
             for row, verdict in zip(rows, verdicts):

@@ -37,10 +37,14 @@ Two planes, both shared by all ``SimNode``s of a ``Recording``:
     host.  Invalid signatures are memoized as False — byzantine signers stay
     rejected on the device path.
 
-Host-vs-device accounting: every second spent blocking on device results is
-recorded as ``device_wait_seconds``; host-side crypto (hashlib fallback,
-straggler verification) as ``host_crypto_seconds`` — the "<5% host CPU in
-crypto" half of the BASELINE target is computed from these by the bench.
+Host-vs-device accounting: every blocking collect of device results is
+observed into the ``device_wait_seconds`` histogram (p50/p99 visible in
+snapshots, total in ``device_wait_seconds_sum``); host-side crypto (hashlib
+fallback, straggler verification) as ``host_crypto_seconds`` — the "<5% host
+CPU in crypto" half of the BASELINE target is computed from these by the
+bench.  Wave lifecycles additionally surface as queue-depth / in-flight
+gauges and, when the default tracer is enabled, as ``hash_wave`` /
+``auth_wave`` spans from dispatch to collect (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -50,7 +54,7 @@ import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from .. import metrics
+from .. import metrics, tracing
 
 
 def _next_pow2(n: int) -> int:
@@ -154,6 +158,7 @@ class DeviceHashPlane:
             start = time.perf_counter()
             pending[key] = (tuple(parts), b"".join(parts))
             join_time += time.perf_counter() - start
+        metrics.gauge("hash_wave_queue_depth").set(len(pending))
         if len(pending) >= self.wave_size:
             self._launch_wave()
         if join_time:
@@ -180,6 +185,8 @@ class DeviceHashPlane:
             all_entries = groups[bucket]
             for start in range(0, len(all_entries), self.wave_size):
                 entries = all_entries[start : start + self.wave_size]
+                tracer = tracing.default_tracer
+                dispatch_ts = tracer.now() if tracer.enabled else 0.0
                 handle = self._hasher.dispatch(
                     [m for (_, _, m) in entries],
                     block_bucket=bucket,
@@ -190,12 +197,14 @@ class DeviceHashPlane:
                         [k for (k, _, _) in entries],
                         [r for (_, r, _) in entries],
                         handle,
+                        dispatch_ts,
                     )
                 )
                 for key, refs, _ in entries:
                     self._issued[key] = (refs, handle)
                 metrics.counter("device_hash_dispatches").inc()
                 metrics.counter("device_hashed_messages").inc(len(entries))
+        metrics.gauge("hash_waves_in_flight").set(len(self._inflight))
 
     def poll(self, batches: Sequence[Sequence[bytes]]) -> bool:
         """True if ``hash_batches(batches)`` would not block on the device.
@@ -289,20 +298,32 @@ class DeviceHashPlane:
         flight — a blocking collect is paid only for results the caller
         actually requires (the contract ``poll`` assumes)."""
         start = time.perf_counter()
+        tracer = tracing.default_tracer
         inflight, self._inflight = self._inflight, []
-        for keys, refs, handle in inflight:
+        for keys, refs, handle, dispatch_ts in inflight:
             if (
                 needed is not None
                 and not handle.words.is_ready()
                 and not any(key in needed for key in keys)
             ):
-                self._inflight.append((keys, refs, handle))
+                self._inflight.append((keys, refs, handle, dispatch_ts))
                 continue
             digests = self._hasher.collect(handle)
             for key, ref, digest in zip(keys, refs, digests):
                 self._memo_put(key, ref, digest)
                 self._issued.pop(key, None)
-        metrics.counter("device_wait_seconds").inc(time.perf_counter() - start)
+            if tracer.enabled and dispatch_ts:
+                tracer.complete(
+                    "hash_wave",
+                    dispatch_ts,
+                    pid=0,
+                    tid=1,
+                    args={"messages": len(keys)},
+                )
+        metrics.gauge("hash_waves_in_flight").set(len(self._inflight))
+        metrics.histogram("device_wait_seconds").observe(
+            time.perf_counter() - start
+        )
 
     def _host_hash(self, message: bytes) -> bytes:
         start = time.perf_counter()
@@ -390,8 +411,10 @@ class DeviceAuthPlane:
                 continue
             pending[key] = (client_id, rn, envelope)
             added = True
-        if added and len(pending) >= self.wave_size:
-            self._launch_wave()
+        if added:
+            metrics.gauge("auth_wave_queue_depth").set(len(pending))
+            if len(pending) >= self.wave_size:
+                self._launch_wave()
 
     def _launch_wave(self) -> None:
         """Dispatch the pending set in ``wave_size`` chunks; the dispatcher
@@ -425,18 +448,21 @@ class DeviceAuthPlane:
                 metrics.counter("host_crypto_seconds").inc(
                     time.perf_counter() - pack_start
                 )
+                tracer = tracing.default_tracer
+                dispatch_ts = tracer.now() if tracer.enabled else 0.0
                 dispatch_start = time.perf_counter()
                 handle = self.verifier.dispatch(*packed, n_real=len(items))
                 metrics.counter("device_dispatch_seconds").inc(
                     time.perf_counter() - dispatch_start
                 )
-                self._inflight.append((keys, items, handle))
+                self._inflight.append((keys, items, handle, dispatch_ts))
                 for key, item in zip(keys, items):
                     self._issued[key] = item[2]
                 metrics.counter("device_verify_dispatches").inc()
                 metrics.counter("device_verified_signatures").inc(len(items))
             else:
                 self._verify_host(keys, items, packed)
+        metrics.gauge("auth_waves_in_flight").set(len(self._inflight))
 
     def _pack(self, items) -> Tuple[List[bytes], List[bytes], List[bytes]]:
         from ..processor.verify import signing_payload, unseal
@@ -503,8 +529,9 @@ class DeviceAuthPlane:
         if not self._inflight:
             return
         start = time.perf_counter()
+        tracer = tracing.default_tracer
         inflight, self._inflight = self._inflight, []
-        for keys, items, handle in inflight:
+        for keys, items, handle, dispatch_ts in inflight:
             verdicts = self.verifier.collect(handle)
             for key, item, verdict in zip(keys, items, verdicts):
                 self._issued.pop(key, None)
@@ -512,4 +539,15 @@ class DeviceAuthPlane:
                     continue  # client removed while the dispatch was in flight
                 self._memo_put(key, item[2], bool(verdict))
             self.verified_count += len(keys)
-        metrics.counter("device_wait_seconds").inc(time.perf_counter() - start)
+            if tracer.enabled and dispatch_ts:
+                tracer.complete(
+                    "auth_wave",
+                    dispatch_ts,
+                    pid=0,
+                    tid=2,
+                    args={"signatures": len(keys)},
+                )
+        metrics.gauge("auth_waves_in_flight").set(len(self._inflight))
+        metrics.histogram("device_wait_seconds").observe(
+            time.perf_counter() - start
+        )

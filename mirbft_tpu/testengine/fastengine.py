@@ -37,7 +37,7 @@ from __future__ import annotations
 import hashlib
 from typing import Dict, List, Optional, Tuple
 
-from .. import _native, metrics
+from .. import _native, metrics, tracing
 from .recorder import Spec, _u64
 
 
@@ -249,6 +249,9 @@ class FastRecording:
         # id -> (public_key, payloads, verdicts_supplied_so_far)
         self._stream_clients: Dict[int, tuple] = {}
         self.device_stall_s = 0.0
+        # Optional sim-domain tracer (attach_sim_tracer): progress counters
+        # stamped with the engine's virtual fake_time, not wall time.
+        self.sim_tracer: Optional[tracing.Tracer] = None
 
         client_states = [(c.id, c.width) for c in recorder.network_state.clients]
 
@@ -384,6 +387,8 @@ class FastRecording:
             sigs.append(signature)
 
         if self.device:
+            tracer = tracing.default_tracer
+            wave_ts = tracer.now() if tracer.enabled else 0.0
             verifier = self._make_verifier()
             handles = []
             for start in range(0, len(pubs), auth_wave):
@@ -406,9 +411,17 @@ class FastRecording:
             verdicts = []
             for handle in handles:
                 verdicts.extend(bool(v) for v in verifier.collect(handle))
-            metrics.counter("device_wait_seconds").inc(
+            metrics.histogram("device_wait_seconds").observe(
                 _time.perf_counter() - collect_start
             )
+            if wave_ts:
+                tracer.complete(
+                    "auth_wave",
+                    wave_ts,
+                    pid=0,
+                    tid=2,
+                    args={"signatures": len(pubs), "waves": len(handles)},
+                )
         else:
             from ..ops.ed25519 import verify_one
 
@@ -454,6 +467,7 @@ class FastRecording:
             self._pending_digests.append(digest)
         while len(self._pending_msgs) >= self.hash_wave:
             self._launch_waves()
+        metrics.gauge("hash_wave_queue_depth").set(len(self._pending_msgs))
 
     def _dispatch_hash_chunks(self, by_bucket):
         """Shared dispatch geometry (mirrors DeviceHashPlane._launch_wave):
@@ -461,11 +475,15 @@ class FastRecording:
         the mirror and the authoritative path MUST hit the exact kernel
         shapes the bench warms, or a fresh XLA compile fires mid-run.
         ``by_bucket``: {block_bucket: [(message, aux), ...]}; yields
-        (handle, chunk) pairs."""
+        (handle, chunk, dispatch_ts) triples — dispatch_ts is the tracer
+        timestamp of the dispatch (0.0 when tracing is off), letting the
+        collector close a ``hash_wave`` span."""
+        tracer = tracing.default_tracer
         for bucket in sorted(by_bucket):
             entries = by_bucket[bucket]
             for start in range(0, len(entries), self._BATCH_BUCKET):
                 chunk = entries[start:start + self._BATCH_BUCKET]
+                dispatch_ts = tracer.now() if tracer.enabled else 0.0
                 handle = self._hasher.dispatch(
                     [m for m, _ in chunk],
                     block_bucket=bucket,
@@ -473,7 +491,7 @@ class FastRecording:
                 )
                 metrics.counter("device_hash_dispatches").inc()
                 metrics.counter("device_hashed_messages").inc(len(chunk))
-                yield handle, chunk
+                yield handle, chunk, dispatch_ts
 
     def _launch_waves(self) -> None:
         """One async dispatch per block bucket over the pending set."""
@@ -483,20 +501,31 @@ class FastRecording:
         by_bucket: Dict[int, List[Tuple[bytes, bytes]]] = {}
         for (bucket, message), digest in pending:
             by_bucket.setdefault(bucket, []).append((message, digest))
-        for handle, chunk in self._dispatch_hash_chunks(by_bucket):
-            self._inflight.append((handle, [d for _, d in chunk]))
+        for handle, chunk, dispatch_ts in self._dispatch_hash_chunks(by_bucket):
+            self._inflight.append((handle, [d for _, d in chunk], dispatch_ts))
+        metrics.gauge("hash_waves_in_flight").set(len(self._inflight))
 
     def _collect_inflight(self) -> None:
         if self._pending_msgs:
             self._launch_waves()
-        for handle, expected in self._inflight:
+        tracer = tracing.default_tracer
+        for handle, expected, dispatch_ts in self._inflight:
             digests = self._hasher.collect(handle)
             for device_digest, engine_digest in zip(digests, expected):
                 if bytes(device_digest) != engine_digest:
                     raise AssertionError(
                         "device digest diverged from engine digest"
                     )
+            if tracer.enabled and dispatch_ts:
+                tracer.complete(
+                    "hash_wave",
+                    dispatch_ts,
+                    pid=0,
+                    tid=1,
+                    args={"messages": len(expected)},
+                )
         self._inflight = []
+        metrics.gauge("hash_waves_in_flight").set(0)
 
     # -- drive -------------------------------------------------------------
 
@@ -526,11 +555,20 @@ class FastRecording:
                     by_bucket.setdefault(bucket, []).append((content, None))
             handles = list(self._dispatch_hash_chunks(by_bucket))
             supplied = []
-            for handle, chunk in handles:
+            tracer = tracing.default_tracer
+            for handle, chunk, dispatch_ts in handles:
                 for (content, _), digest in zip(
                     chunk, self._hasher.collect(handle)
                 ):
                     supplied.append((content, bytes(digest)))
+                if tracer.enabled and dispatch_ts:
+                    tracer.complete(
+                        "hash_wave",
+                        dispatch_ts,
+                        pid=0,
+                        tid=1,
+                        args={"messages": len(chunk)},
+                    )
             if host_side:
                 # Above-ladder content keeps the host floor (same rule as
                 # the mirror planes); metered as host crypto.
@@ -653,6 +691,7 @@ class FastRecording:
                 raise FastEngineUnsupported(str(exc)) from exc
             executed += ran
             self._drain_hash_log()
+            self._trace_slice()
             if timed_out:
                 self._collect_inflight()
                 raise TimeoutError(
@@ -739,6 +778,7 @@ class FastRecording:
             except RuntimeError as exc:
                 raise FastEngineUnsupported(str(exc)) from exc
             self._drain_hash_log()
+            self._trace_slice()
             if timed_out:
                 # Collect in-flight device dispatches before raising so the
                 # device-as-verifying-coprocessor check covers everything
@@ -752,6 +792,25 @@ class FastRecording:
                 self._serve_device_work()
         self._finalize()
         return self.steps
+
+    def attach_sim_tracer(self, tracer: tracing.Tracer) -> None:
+        """Attach a sim-domain tracer: each engine slice emits an
+        ``engine_progress`` counter record stamped with the engine's virtual
+        fake_time (1 sim unit = 1 µs in the export), so Perfetto shows
+        commit throughput against simulated time."""
+        self.sim_tracer = tracer
+
+    def _trace_slice(self) -> None:
+        tracer = self.sim_tracer
+        if tracer is None or not tracer.enabled:
+            return
+        steps, fake_time, ops, _ = self._engine.stats()
+        tracer.counter_event(
+            "engine_progress",
+            {"steps": steps, "committed_ops": ops},
+            pid=0,
+            ts=float(fake_time),
+        )
 
     def stats(self) -> Tuple[int, int, int]:
         """(steps, fake_time, committed_ops)."""

@@ -16,6 +16,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import processor as proc
+from .. import tracing
 from .. import wire
 from ..config import standard_initial_network_state
 from ..messages import (
@@ -327,27 +328,21 @@ class SimClient:
 
     def __init__(self, config: ClientConfig):
         self.config = config
-        self._key = None
+        self._keys = None
         self._sealed: Dict[int, bytes] = {}
 
-    def _signing_key(self):
-        if self._key is None:
-            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-                Ed25519PrivateKey,
-            )
+    def _keypair(self):
+        if self._keys is None:
+            from ..ops.ed25519 import keypair_from_seed
 
             seed = hashlib.sha256(
                 b"mirbft-tpu-sim-client-" + _u64(self.config.id)
             ).digest()
-            self._key = Ed25519PrivateKey.from_private_bytes(seed)
-        return self._key
+            self._keys = keypair_from_seed(seed)
+        return self._keys
 
     def public_key(self) -> bytes:
-        from cryptography.hazmat.primitives import serialization
-
-        return self._signing_key().public_key().public_bytes(
-            serialization.Encoding.Raw, serialization.PublicFormat.Raw
-        )
+        return self._keypair()[0]
 
     def request_by_req_no(self, req_no: int) -> Optional[bytes]:
         if req_no >= self.config.total:
@@ -366,7 +361,7 @@ class SimClient:
                     b"corrupt-" + _u64(self.config.id) + _u64(req_no)
                 ).digest()
             else:
-                signature = self._signing_key().sign(
+                signature = self._keypair()[1](
                     signing_payload(self.config.id, req_no, payload)
                 )
             sealed = seal(payload, signature)
@@ -442,6 +437,10 @@ class Recorder:
         self.event_log_writer = event_log_writer
         self.crypto = crypto or CryptoConfig()
         self.logger = logger
+        # Optional sim-domain Tracer (set before recording(), like
+        # event_log_writer): its clock is bound to the event queue's virtual
+        # fake_time and per-node commit-span trackers feed it during step().
+        self.tracer: Optional[tracing.Tracer] = None
 
     def recording(self) -> "Recording":
         event_queue = EventQueue(seed=self.random_seed, mangler=self.mangler)
@@ -533,9 +532,20 @@ class Recorder:
                 i, node_config.init_parms, node_config.start_delay
             )
 
-        return Recording(
+        recording = Recording(
             event_queue, nodes, clients, hash_plane=hash_plane, auth_plane=auth_plane
         )
+        if self.tracer is not None:
+            tracer = self.tracer
+            tracer.clock = lambda: float(event_queue.fake_time)
+            tracer.clock_domain = "sim"
+            recording.tracer = tracer
+            for node in nodes:
+                tracer.name_process(node.id, f"node{node.id}")
+                recording.span_trackers[node.id] = tracing.CommitSpanTracker(
+                    tracer, node.id
+                )
+        return recording
 
 
 class _Interceptor:
@@ -573,6 +583,10 @@ class Recording:
         self.clients = clients  # by client id (ids need not be dense)
         self.hash_plane = hash_plane
         self.auth_plane = auth_plane
+        # Sim-domain tracing (wired by Recorder.recording() when a tracer
+        # is attached): per-node commit-span trackers fed during step().
+        self.tracer: Optional[tracing.Tracer] = None
+        self.span_trackers: Dict[int, tracing.CommitSpanTracker] = {}
 
     def _schedule_proposal(
         self, node_id: int, client_id: int, req_no: int, data: bytes, delay: int
@@ -704,6 +718,9 @@ class Recording:
             actions = proc.process_state_machine_events(
                 node.state_machine, node.interceptor, event.process_result_events
             )
+            tracker = self.span_trackers.get(node.id)
+            if tracker is not None:
+                tracker.observe(event.process_result_events, actions)
             node.work_items.add_state_machine_results(actions)
             node.pending["result"] = False
         elif event.process_wal_actions is not None:

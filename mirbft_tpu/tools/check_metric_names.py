@@ -1,0 +1,87 @@
+"""Metric/span name lint: every instrument or span name used in the tree
+must be snake_case and documented in docs/OBSERVABILITY.md.
+
+Names drift silently otherwise: a renamed counter keeps compiling, the old
+dashboards/readers just read zero.  The tier-1 suite runs ``check()``
+(tests/test_tracing.py), so a new name without a docs entry fails CI.
+
+Usage: ``python -m mirbft_tpu.tools.check_metric_names`` (exit 1 on
+violations).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List
+
+# Instrument creation through the registry helpers (module-level or any
+# registry/Registry object) with a literal name.
+_METRIC_CALL = re.compile(
+    r"\.(?:counter|gauge|histogram|timer)\(\s*\"([^\"]+)\"", re.MULTILINE
+)
+# Span/trace-event emission with a literal name.
+_SPAN_CALL = re.compile(
+    r"\.(?:span|complete|instant|counter_event)\(\s*\n?\s*\"([^\"]+)\"",
+    re.MULTILINE,
+)
+_SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def collect_names(root: Path) -> Dict[str, List[str]]:
+    """{name: [file:line, ...]} for every literal metric/span name used
+    under mirbft_tpu/ and in bench.py (tests and this lint excluded)."""
+    sources = [p for p in (root / "mirbft_tpu").rglob("*.py")]
+    bench = root / "bench.py"
+    if bench.exists():
+        sources.append(bench)
+    out: Dict[str, List[str]] = {}
+    for path in sources:
+        if path.name == "check_metric_names.py":
+            continue
+        text = path.read_text()
+        for pattern in (_METRIC_CALL, _SPAN_CALL):
+            for match in pattern.finditer(text):
+                line = text.count("\n", 0, match.start()) + 1
+                out.setdefault(match.group(1), []).append(
+                    f"{path.relative_to(root)}:{line}"
+                )
+    return out
+
+
+def check(root: Path = None) -> List[str]:
+    """Return violation messages (empty list = clean)."""
+    root = root or repo_root()
+    docs = (root / "docs" / "OBSERVABILITY.md").read_text()
+    violations: List[str] = []
+    for name, sites in sorted(collect_names(root).items()):
+        where = ", ".join(sites[:3])
+        if not _SNAKE_CASE.match(name):
+            violations.append(
+                f"metric/span name {name!r} is not snake_case ({where})"
+            )
+        if f"`{name}`" not in docs:
+            violations.append(
+                f"metric/span name {name!r} is not documented in "
+                f"docs/OBSERVABILITY.md ({where})"
+            )
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    for violation in violations:
+        print(violation, file=sys.stderr)
+    if violations:
+        return 1
+    print("metric/span names OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,10 +6,17 @@ each event through a fresh state machine per node, printing the resulting
 actions, optional per-index status snapshots, and per-node replay wall time
 (reference main.go:172-227, 429-446).
 
+``--trace OUT.json`` converts the log into a Chrome trace-event file
+(Perfetto-loadable, see docs/OBSERVABILITY.md): events replay through fresh
+state machines with the tracer clock pinned to each record's *simulated*
+timestamp, deriving per-request commit spans and device hash-wave spans in
+sim time — offline, from any recorded run.
+
 Usage:
     python -m mirbft_tpu.tools.mircat LOG.gz [--node N ...]
         [--event-type TYPE ...] [--step-type TYPE ...]
         [--interactive] [--status-index IDX ...] [--verbose-text]
+        [--trace OUT.json]
 """
 
 from __future__ import annotations
@@ -20,6 +27,7 @@ import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Set
 
+from .. import metrics, tracing
 from .. import state as st
 from .. import status as status_mod
 from ..eventlog import read_event_log
@@ -76,6 +84,12 @@ def _parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         action="store_true",
         help="print full event structures instead of compact text",
     )
+    parser.add_argument(
+        "--trace",
+        metavar="OUT.json",
+        help="replay and export a Chrome trace-event JSON (sim-time commit "
+        "spans and hash-wave spans; load in Perfetto)",
+    )
     return parser.parse_args(argv)
 
 
@@ -101,10 +115,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     replay_time: Dict[int, float] = defaultdict(float)
     status_indexes: Set[int] = set(args.status_index or [])
 
+    # --trace replays every event (like --interactive, without the action
+    # printing) with the tracer clock pinned to each record's simulated
+    # timestamp, so derived spans land in the sim clock domain.
+    do_replay = args.interactive or bool(args.trace)
+    tracer = None
+    span_trackers: Dict[int, tracing.CommitSpanTracker] = {}
+    wave_trackers: Dict[int, tracing.HashWaveTracker] = {}
+    if args.trace:
+        sim_clock = {"t": 0.0}
+        tracer = tracing.Tracer(
+            capacity=1 << 20,
+            clock=lambda: sim_clock["t"],
+            enabled=True,
+            clock_domain="sim",
+        )
+
     with open(args.log, "rb") as f:
         for index, record in enumerate(read_event_log(f)):
             shown = _matches(record, args)
-            if shown:
+            # --trace without --interactive is a pure converter: no listing.
+            if shown and (args.interactive or not args.trace):
                 text = (
                     repr(record.state_event)
                     if args.verbose_text
@@ -112,15 +143,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                 )
                 print(f"[{index}] node={record.node_id} time={record.time} {text}")
 
-            if args.interactive:
+            if do_replay:
                 sm = machines[record.node_id]
+                if tracer is not None:
+                    sim_clock["t"] = float(record.time)
                 start = time.perf_counter()
                 actions = sm.apply_event(record.state_event)
                 replay_time[record.node_id] += time.perf_counter() - start
-                if shown:
+                if tracer is not None:
+                    node_id = record.node_id
+                    spans = span_trackers.get(node_id)
+                    if spans is None:
+                        tracer.name_process(node_id, f"node{node_id}")
+                        spans = span_trackers[node_id] = (
+                            tracing.CommitSpanTracker(
+                                tracer, node_id, registry=metrics.Registry()
+                            )
+                        )
+                        wave_trackers[node_id] = tracing.HashWaveTracker(
+                            tracer, node_id
+                        )
+                    events = (record.state_event,)
+                    spans.observe(events, actions)
+                    wave_trackers[node_id].observe(events, actions)
+                if shown and args.interactive:
                     for action in actions:
                         print(f"        -> {compact_text(action)}")
-                if index in status_indexes:
+                if index in status_indexes and args.interactive:
                     print(status_mod.snapshot(sm).pretty())
 
     if args.interactive:
@@ -129,6 +178,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"node {node_id} replay time: "
                 f"{replay_time[node_id] * 1000:.1f} ms"
             )
+    if tracer is not None:
+        tracer.export(args.trace)
+        commits = sum(t.committed for t in span_trackers.values())
+        waves = sum(t.waves for t in wave_trackers.values())
+        print(
+            f"trace: {len(tracer)} events ({commits} commit spans, "
+            f"{waves} hash waves) -> {args.trace}"
+        )
     return 0
 
 

@@ -6,7 +6,33 @@ and pins JAX_PLATFORMS before any test code runs, so env vars alone are too
 late — the jax config must be overridden before backends initialize.
 """
 
+import os
+
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax: the option does not exist; the XLA flag (read when the CPU
+    # backend initializes, which has not happened yet at conftest time) is
+    # the equivalent knob.
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+@pytest.fixture(autouse=True)
+def _reset_observability():
+    """Reset the process-wide metrics registry and default tracer between
+    tests: both are process-global, so without this, counts leak across
+    tests and per-test assertions become order-dependent."""
+    from mirbft_tpu import metrics, tracing
+
+    metrics.default_registry.reset()
+    tracing.default_tracer.clear()
+    tracing.default_tracer.enabled = False
+    yield

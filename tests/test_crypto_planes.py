@@ -83,20 +83,13 @@ def test_device_auth_plane_parity_and_engagement():
 def test_auth_plane_rejects_forged_envelopes():
     """A forged signature must be rejected through the batched device path
     (byzantine-signer property for BASELINE config 5)."""
+    from mirbft_tpu.ops.ed25519 import keypair_from_seed
     from mirbft_tpu.processor.verify import seal, signing_payload
 
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
-
-    key = Ed25519PrivateKey.from_private_bytes(bytes(range(32)))
-    pub = key.public_key().public_bytes(
-        serialization.Encoding.Raw, serialization.PublicFormat.Raw
-    )
+    pub, sign = keypair_from_seed(bytes(range(32)))
 
     good = [
-        seal(b"req-%d" % i, key.sign(signing_payload(7, i, b"req-%d" % i)))
+        seal(b"req-%d" % i, sign(signing_payload(7, i, b"req-%d" % i)))
         for i in range(8)
     ]
     forged = seal(b"evil", b"\x01" * 64)

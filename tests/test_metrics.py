@@ -1,4 +1,7 @@
-"""Metrics subsystem: instruments, snapshots, and hot-path integration."""
+"""Metrics subsystem: instruments, snapshots, labels, the Prometheus
+renderer, and hot-path integration."""
+
+import threading
 
 from mirbft_tpu import metrics
 
@@ -34,6 +37,121 @@ def test_timer_records():
     with reg.timer("t"):
         pass
     assert reg.snapshot()["t_count"] == 1
+
+
+def test_snapshot_includes_sum():
+    reg = metrics.Registry()
+    h = reg.histogram("h")
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["h_sum"] == 6.0
+    assert snap["h_count"] == 3
+
+
+def test_labeled_instruments_are_distinct_series():
+    reg = metrics.Registry()
+    reg.counter("c", labels={"node": "0"}).inc(1)
+    reg.counter("c", labels={"node": "1"}).inc(2)
+    reg.histogram("h", labels={"node": "0"}).observe(1.0)
+    snap = reg.snapshot()
+    assert snap['c{node="0"}'] == 1
+    assert snap['c{node="1"}'] == 2
+    assert snap['h{node="0"}_count'] == 1
+
+
+def test_snapshot_safe_under_concurrent_creation():
+    """snapshot() must tolerate first-use instrument creation from another
+    thread (it previously iterated the live dicts without the lock)."""
+    reg = metrics.Registry()
+    stop = threading.Event()
+    errors = []
+
+    def creator():
+        # Counters only: histogram snapshots pay percentile math per
+        # instrument, which would turn this race test into a benchmark.
+        i = 0
+        while not stop.is_set() and i < 20000:
+            reg.counter(f"c_{i}").inc()
+            i += 1
+
+    def snapshotter():
+        try:
+            for _ in range(300):
+                reg.snapshot()
+        except RuntimeError as exc:  # "dictionary changed size ..."
+            errors.append(exc)
+
+    threads = [threading.Thread(target=creator) for _ in range(2)]
+    snap_thread = threading.Thread(target=snapshotter)
+    for t in threads:
+        t.start()
+    snap_thread.start()
+    snap_thread.join()
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+
+
+def _parse_prometheus(text):
+    """Minimal exposition-format parser: validates line shapes, returns
+    (types, samples).  Raises AssertionError on any malformed line."""
+    types = {}
+    samples = {}
+    sample_re = __import__("re").compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? (-?[0-9.e+-]+|NaN)$'
+    )
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            assert kind in ("counter", "gauge", "summary", "histogram")
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unexpected comment: {line!r}"
+        m = sample_re.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        samples[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return types, samples
+
+
+def test_render_prometheus_format():
+    reg = metrics.Registry()
+    reg.counter("reqs_total").inc(7)
+    reg.gauge("depth", labels={"node": "3"}).set(2.0)
+    h = reg.histogram("lat_seconds")
+    for v in (0.1, 0.2, 0.3):
+        h.observe(v)
+    text = metrics.render_prometheus(reg)
+    types, samples = _parse_prometheus(text)
+    assert types == {
+        "reqs_total": "counter",
+        "depth": "gauge",
+        "lat_seconds": "summary",
+    }
+    assert samples["reqs_total"] == 7
+    assert samples['depth{node="3"}'] == 2.0
+    # Summary expansion: quantiles + _sum + _count.
+    assert 'lat_seconds{quantile="0.5"}' in samples
+    assert 'lat_seconds{quantile="0.99"}' in samples
+    assert samples["lat_seconds_count"] == 3
+    assert abs(samples["lat_seconds_sum"] - 0.6) < 1e-9
+    # Each TYPE line precedes its samples exactly once.
+    assert text.count("# TYPE lat_seconds summary") == 1
+
+
+def test_render_prometheus_label_escaping_and_extra_labels():
+    reg = metrics.Registry()
+    reg.counter("c", labels={"path": 'a"b\\c\nd'}).inc()
+    text = metrics.render_prometheus(reg, extra_labels={"node": "9"})
+    # Escaped: backslash, quote, newline — and the extra label merged in.
+    assert '\\"b' in text and "\\\\c" in text and "\\nd" in text
+    assert 'node="9"' in text
+    assert "\n\n" not in text  # raw newline must not split the sample line
+    line = [l for l in text.splitlines() if l.startswith("c{")][0]
+    assert line.endswith(" 1")
 
 
 def test_engine_run_populates_default_registry():

@@ -289,12 +289,8 @@ def test_stressy_signed_requests(tmp_path):
     rejected at propose and never enters dissemination."""
     import hashlib
 
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
-
     from mirbft_tpu.node import AuthenticationError
+    from mirbft_tpu.ops.ed25519 import keypair_from_seed
     from mirbft_tpu.processor.verify import (
         RequestAuthenticator,
         seal,
@@ -302,16 +298,13 @@ def test_stressy_signed_requests(tmp_path):
     )
 
     reqs = 10
-    key = Ed25519PrivateKey.from_private_bytes(
+    pub, sign = keypair_from_seed(
         hashlib.sha256(b"stressy-signed-client-0").digest()
-    )
-    pub = key.public_key().public_bytes(
-        serialization.Encoding.Raw, serialization.PublicFormat.Raw
     )
 
     def envelope(req_no):
         payload = b"signed-req-%d" % req_no
-        return seal(payload, key.sign(signing_payload(0, req_no, payload)))
+        return seal(payload, sign(signing_payload(0, req_no, payload)))
 
     def authenticator():
         auth = RequestAuthenticator()
@@ -340,14 +333,9 @@ def test_stressy_device_crypto(tmp_path):
     rejected on the device path."""
     import hashlib
 
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
-
     from mirbft_tpu import metrics
     from mirbft_tpu.node import AuthenticationError
-    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier
+    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier, keypair_from_seed
     from mirbft_tpu.ops.sha256 import TpuHasher
     from mirbft_tpu.processor.verify import (
         RequestAuthenticator,
@@ -357,17 +345,14 @@ def test_stressy_device_crypto(tmp_path):
 
     metrics.default_registry.reset()
     reqs = 10
-    key = Ed25519PrivateKey.from_private_bytes(
+    pub, sign = keypair_from_seed(
         hashlib.sha256(b"stressy-device-client-0").digest()
-    )
-    pub = key.public_key().public_bytes(
-        serialization.Encoding.Raw, serialization.PublicFormat.Raw
     )
     envelopes = []
     for req_no in range(reqs):
         payload = b"device-req-%d" % req_no
         envelopes.append(
-            seal(payload, key.sign(signing_payload(0, req_no, payload)))
+            seal(payload, sign(signing_payload(0, req_no, payload)))
         )
     forged = seal(b"forged", b"\x22" * 64)
 
@@ -404,5 +389,37 @@ def test_stressy_device_crypto(tmp_path):
         for auth in authenticators:
             assert auth.verified_count >= reqs + 1
             assert auth.dispatch_seconds, "no verify dispatch recorded"
+    finally:
+        stop()
+
+
+def test_node_runtime_commit_spans_and_prometheus_surface(tmp_path):
+    """Wall-clock observability on the real-thread runtime: the result
+    worker derives request_commit spans into the (enabled) default tracer,
+    the per-node commit_latency_seconds histogram fills, and
+    Node.metrics_text() renders a node-labeled Prometheus exposition."""
+    from mirbft_tpu import metrics, tracing
+
+    tracing.default_tracer.enabled = True
+    reqs = 5
+    nodes, _, stop = _run_stress_cluster(
+        tmp_path, 1, reqs, lambda r: b"obs-%d" % r
+    )
+    try:
+        node = nodes[0]
+        assert node.span_tracker.committed >= reqs
+        spans = [
+            e
+            for e in tracing.default_tracer.chrome_trace()["traceEvents"]
+            if e.get("name") == "request_commit"
+        ]
+        assert len(spans) >= reqs
+        assert all(e["pid"] == 0 and e["ph"] == "X" for e in spans)
+        snap = metrics.snapshot()
+        assert snap['commit_latency_seconds{node="0"}_count'] >= reqs
+        text = node.metrics_text()
+        assert "# TYPE commit_latency_seconds summary" in text
+        assert 'node="0"' in text
+        assert 'commit_latency_seconds_count{node="0"}' in text
     finally:
         stop()

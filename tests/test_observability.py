@@ -132,6 +132,40 @@ def test_mircat_filters_and_replay(tmp_path, capsys):
     assert "->" in out  # actions printed
 
 
+def test_mircat_trace_export(tmp_path, capsys):
+    """--trace converts a recorded event log into a Chrome trace-event file
+    with per-request commit spans and hash-wave spans in sim time."""
+    import json
+
+    log_path, _, _ = run_recorded_spec(
+        tmp_path, node_count=2, client_count=1, reqs_per_client=3
+    )
+    out_path = tmp_path / "trace.json"
+    rc = mircat.main([str(log_path), "--trace", str(out_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "commit spans" in out
+
+    trace = json.loads(out_path.read_text())
+    assert trace["otherData"]["clock_domain"] == "sim"
+    events = trace["traceEvents"]
+    commits = [e for e in events if e.get("name") == "request_commit"]
+    waves = [e for e in events if e.get("name") == "hash_wave"]
+    # Every node commits every request; batches hash along the way.
+    assert len(commits) == 2 * 3
+    assert waves
+    real = [e for e in events if e["ph"] != "M"]
+    # Sim-time monotonic, well-formed records.
+    assert [e["ts"] for e in real] == sorted(e["ts"] for e in real)
+    for e in real:
+        assert e["ph"] in ("X", "i", "C")
+        assert e["ts"] >= 0.0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    for span in commits:
+        assert span["dur"] > 0.0
+        assert span["args"]["phases_us"]
+
+
 def test_compact_text_truncates_digests():
     ack = RequestAck(client_id=1, req_no=2, digest=b"\xaa" * 32)
     text = compact_text(ack)

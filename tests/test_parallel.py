@@ -65,27 +65,23 @@ def test_sharded_ed25519_verify_byzantine_psum():
     """Ed25519 verification sharded over the mesh: per-shard verdicts match
     the reference and the psum'd invalid count is global on every chip."""
     import numpy as np
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
 
-    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier, verify_one
+    from mirbft_tpu.ops.ed25519 import (
+        Ed25519BatchVerifier,
+        keypair_from_seed,
+        verify_one,
+    )
     from mirbft_tpu.parallel import make_mesh, sharded_ed25519_verify
 
     mesh = make_mesh(8)
     pubs, msgs, sigs = [], [], []
     for i in range(6):  # 6 real rows; rows 6..7 are padding
-        key = Ed25519PrivateKey.from_private_bytes((i + 9).to_bytes(4, "big") * 8)
+        pub, sign = keypair_from_seed((i + 9).to_bytes(4, "big") * 8)
         m = b"par-%d" % i
-        sig = key.sign(m)
+        sig = sign(m)
         if i in (2, 5):
             sig = sig[:3] + bytes([sig[3] ^ 1]) + sig[4:]
-        pubs.append(
-            key.public_key().public_bytes(
-                serialization.Encoding.Raw, serialization.PublicFormat.Raw
-            )
-        )
+        pubs.append(pub)
         msgs.append(m)
         sigs.append(sig)
     packed = Ed25519BatchVerifier(min_device_batch=1).pack_inputs(

@@ -23,20 +23,14 @@ def test_envelope_roundtrip():
 
 
 def test_authenticator_accepts_valid_and_rejects_forged():
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
+    from mirbft_tpu.ops.ed25519 import keypair_from_seed
 
     auth = RequestAuthenticator()
-    key = Ed25519PrivateKey.from_private_bytes(bytes(range(32)))
-    pub = key.public_key().public_bytes(
-        serialization.Encoding.Raw, serialization.PublicFormat.Raw
-    )
+    pub, sign = keypair_from_seed(bytes(range(32)))
     auth.register(9, pub)
 
     payload = b"the-request"
-    sig = key.sign(signing_payload(9, 3, payload))
+    sig = sign(signing_payload(9, 3, payload))
     envelope = seal(payload, sig)
     assert auth.authenticate(9, 3, envelope)
     # position binding: same envelope replayed for another req_no or client
@@ -51,26 +45,16 @@ def test_authenticator_accepts_valid_and_rejects_forged():
 
 
 def test_key_rotation_invalidates_verdict_memo():
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
+    from mirbft_tpu.ops.ed25519 import keypair_from_seed
 
-    def keypair(seed):
-        key = Ed25519PrivateKey.from_private_bytes(bytes([seed]) * 32)
-        pub = key.public_key().public_bytes(
-            serialization.Encoding.Raw, serialization.PublicFormat.Raw
-        )
-        return key, pub
-
-    old_key, old_pub = keypair(1)
-    new_key, new_pub = keypair(2)
+    old_pub, old_sign = keypair_from_seed(bytes([1]) * 32)
+    new_pub, new_sign = keypair_from_seed(bytes([2]) * 32)
     auth = RequestAuthenticator()
     auth.register(5, old_pub)
 
     payload = b"rotate-me"
-    old_env = seal(payload, old_key.sign(signing_payload(5, 0, payload)))
-    new_env = seal(payload, new_key.sign(signing_payload(5, 0, payload)))
+    old_env = seal(payload, old_sign(signing_payload(5, 0, payload)))
+    new_env = seal(payload, new_sign(signing_payload(5, 0, payload)))
     # Memoize a positive verdict under the old key and a negative one for
     # the new key's envelope.
     assert auth.authenticate(5, 0, old_env)
@@ -89,25 +73,15 @@ def test_key_rotation_invalidates_verdict_memo():
 
 
 def test_authenticator_batch_path_matches_device():
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
-
-    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier
+    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier, keypair_from_seed
 
     auth = RequestAuthenticator(verifier=Ed25519BatchVerifier(min_device_batch=1))
     items = []
     for cid in range(18):
-        key = Ed25519PrivateKey.from_private_bytes(
-            cid.to_bytes(1, "big") * 32
-        )
-        pub = key.public_key().public_bytes(
-            serialization.Encoding.Raw, serialization.PublicFormat.Raw
-        )
+        pub, sign = keypair_from_seed(cid.to_bytes(1, "big") * 32)
         auth.register(cid, pub)
         payload = b"req-%d" % cid
-        sig = key.sign(signing_payload(cid, 0, payload))
+        sig = sign(signing_payload(cid, 0, payload))
         items.append((cid, 0, seal(payload, sig)))
     # corrupt two entries
     cid, req_no, env = items[5]

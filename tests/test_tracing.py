@@ -1,0 +1,188 @@
+"""Tracing plane: ring buffer, Chrome trace-event schema, commit-span
+derivation, sim-domain testengine traces, and the metric-name lint."""
+
+import json
+
+from mirbft_tpu import metrics, state as st, tracing
+from mirbft_tpu.messages import Preprepare, QEntry, RequestAck
+
+
+def make_sim_tracer(start=0.0):
+    clock = {"t": start}
+    tracer = tracing.Tracer(
+        clock=lambda: clock["t"], enabled=True, clock_domain="sim"
+    )
+    return tracer, clock
+
+
+def test_disabled_tracer_emits_nothing():
+    tracer = tracing.Tracer(enabled=False)
+    tracer.instant("x")
+    tracer.complete("y", 0.0, 1.0)
+    tracer.counter_event("z", {"v": 1})
+    with tracer.span("w"):
+        pass
+    assert len(tracer) == 0
+
+
+def test_ring_buffer_bounds_events():
+    tracer, clock = make_sim_tracer()
+    small = tracing.Tracer(capacity=8, clock=lambda: clock["t"], enabled=True)
+    for i in range(100):
+        clock["t"] = float(i)
+        small.instant("e")
+    assert len(small) == 8
+    # Most recent window survives.
+    kept = [e["ts"] for e in small.chrome_trace()["traceEvents"]]
+    assert min(kept) == 92.0
+
+
+def test_chrome_trace_schema_and_monotonic():
+    tracer, clock = make_sim_tracer()
+    tracer.name_process(0, "node0")
+    clock["t"] = 50.0
+    tracer.instant("late", pid=0, tid=1)
+    clock["t"] = 10.0
+    tracer.complete("early", 10.0, 20.0, pid=0, tid=2, args={"k": 1})
+    trace = tracer.chrome_trace()
+    assert trace["otherData"]["clock_domain"] == "sim"
+    events = trace["traceEvents"]
+    # Metadata first; real events sorted by ts despite emission order.
+    assert events[0]["ph"] == "M"
+    real = [e for e in events if e["ph"] != "M"]
+    assert [e["ts"] for e in real] == sorted(e["ts"] for e in real)
+    for e in real:
+        assert e["ph"] in ("X", "i", "C")
+        assert isinstance(e["ts"], float)
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert e["dur"] >= 0.0
+    # JSON round-trip (what export() writes).
+    assert json.loads(json.dumps(trace)) == trace
+
+
+def test_export_writes_loadable_json(tmp_path):
+    tracer, _ = make_sim_tracer()
+    tracer.instant("e")
+    path = tmp_path / "trace.json"
+    tracer.export(str(path))
+    loaded = json.loads(path.read_text())
+    assert loaded["traceEvents"][0]["name"] == "e"
+
+
+def _drive_one_request(tracker, clock, ack, seq_no=5):
+    clock["t"] = 0.0
+    tracker.observe(events=[st.EventRequestPersisted(ack)])
+    clock["t"] = 10.0
+    tracker.observe(actions=[st.ActionCorrectRequest(ack)])
+    clock["t"] = 20.0
+    tracker.observe(
+        actions=[
+            st.ActionHashRequest(
+                data=(b"x",),
+                origin=st.BatchOrigin(0, 0, seq_no, (ack,)),
+            )
+        ]
+    )
+    clock["t"] = 30.0
+    tracker.observe(events=[st.EventStep(0, Preprepare(seq_no, 0, (ack,)))])
+    clock["t"] = 40.0
+    tracker.observe(actions=[st.ActionCommit(QEntry(seq_no, b"d", (ack,)))])
+
+
+def test_commit_span_phases_and_latency():
+    tracer, clock = make_sim_tracer()
+    reg = metrics.Registry()
+    tracker = tracing.CommitSpanTracker(tracer, node_id=3, registry=reg)
+    ack = RequestAck(client_id=7, req_no=1, digest=b"dg")
+    _drive_one_request(tracker, clock, ack)
+    assert tracker.committed == 1
+    (span,) = [
+        e
+        for e in tracer.chrome_trace()["traceEvents"]
+        if e.get("name") == "request_commit"
+    ]
+    assert span["ph"] == "X"
+    assert span["pid"] == 3 and span["tid"] == 7
+    assert span["ts"] == 0.0 and span["dur"] == 40.0
+    assert span["args"]["seq_no"] == 5
+    assert span["args"]["phases_us"] == {
+        "submit": 0.0, "quorum": 10.0, "allocate": 20.0, "preprepare": 30.0,
+    }
+    # 40 sim-µs -> seconds in the per-node histogram.
+    snap = reg.snapshot()
+    assert snap['commit_latency_seconds{node="3"}_count'] == 1
+    assert abs(snap['commit_latency_seconds{node="3"}_sum'] - 40e-6) < 1e-12
+
+
+def test_commit_span_histogram_fed_even_when_tracer_disabled():
+    tracer, clock = make_sim_tracer()
+    tracer.enabled = False
+    reg = metrics.Registry()
+    tracker = tracing.CommitSpanTracker(tracer, node_id=0, registry=reg)
+    _drive_one_request(tracker, clock, RequestAck(1, 1, b"d"))
+    assert len(tracer) == 0
+    assert reg.snapshot()['commit_latency_seconds{node="0"}_count'] == 1
+
+
+def test_commit_tracker_bounded_outstanding():
+    tracer, _ = make_sim_tracer()
+    tracker = tracing.CommitSpanTracker(
+        tracer, node_id=0, registry=metrics.Registry(), max_outstanding=4
+    )
+    for i in range(100):
+        tracker.observe(
+            events=[st.EventRequestPersisted(RequestAck(1, i, b"d"))]
+        )
+    assert len(tracker._pending) <= 4
+
+
+def test_hash_wave_tracker_pairs_dispatch_with_result():
+    tracer, clock = make_sim_tracer()
+    waves = tracing.HashWaveTracker(tracer, node_id=2)
+    ack = RequestAck(1, 1, b"d")
+    origin = st.BatchOrigin(0, 0, 9, (ack,))
+    clock["t"] = 100.0
+    waves.observe(actions=[st.ActionHashRequest(data=(b"x",), origin=origin)])
+    clock["t"] = 130.0
+    waves.observe(events=[st.EventHashResult(b"dg", origin)])
+    assert waves.waves == 1
+    (span,) = tracer.chrome_trace()["traceEvents"]
+    assert span["name"] == "hash_wave"
+    assert span["ts"] == 100.0 and span["dur"] == 30.0
+    assert span["args"]["seq_no"] == 9 and span["args"]["requests"] == 1
+
+
+def test_recorded_run_derives_sim_time_commit_spans():
+    """A testengine run with an attached tracer produces commit spans in
+    the sim clock domain, and the per-node latency histograms fill."""
+    from mirbft_tpu.testengine import Spec
+
+    spec = Spec(node_count=4, client_count=1, reqs_per_client=5)
+    recorder = spec.recorder()
+    tracer = tracing.Tracer(enabled=True)
+    recorder.tracer = tracer
+    recording = recorder.recording()
+    recording.drain_clients(timeout=20000)
+    assert tracer.clock_domain == "sim"
+    spans = [
+        e
+        for e in tracer.chrome_trace()["traceEvents"]
+        if e.get("name") == "request_commit"
+    ]
+    # Every node commits every request: 4 nodes x 5 requests.
+    assert len(spans) == 20
+    final_sim_time = float(recording.event_queue.fake_time)
+    for span in spans:
+        assert 0.0 <= span["ts"] <= final_sim_time
+        assert span["dur"] > 0.0
+        assert span["ts"] + span["dur"] <= final_sim_time
+    snap = metrics.snapshot()
+    for node_id in range(4):
+        assert snap[f'commit_latency_seconds{{node="{node_id}"}}_count'] == 5
+
+
+def test_metric_names_lint():
+    from mirbft_tpu.tools import check_metric_names
+
+    assert check_metric_names.check() == []
