@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import health as health_mod
 from . import metrics as metrics_mod
 from . import processor as proc
 from . import status as status_mod
@@ -95,12 +96,14 @@ class Client:
         notifier: _WorkErrNotifier,
         client_id: int = -1,
         authenticator=None,
+        health_monitor=None,
     ):
         self._client = client
         self._inbox = inbox
         self._notifier = notifier
         self._client_id = client_id
         self._authenticator = authenticator
+        self._health_monitor = health_monitor
 
     def next_req_no(self) -> int:
         return self._client.next_req_no_value()
@@ -116,6 +119,10 @@ class Client:
         ):
             # Forged/corrupt envelope: rejected before it can be persisted
             # or acked (the testengine's ingress gate, on the real runtime).
+            if self._health_monitor is not None:
+                self._health_monitor.record_fault(
+                    self._client_id, "ingress_reject", req_no=req_no
+                )
             raise AuthenticationError(
                 f"client {self._client_id} req {req_no}: signature rejected"
             )
@@ -169,6 +176,13 @@ class Node:
         self.span_tracker = tracing.CommitSpanTracker(
             tracing.default_tracer, node_id
         )
+        # Protocol health plane (docs/OBSERVABILITY.md): the event stream
+        # feeds it on the result worker, periodic status snapshots on the
+        # coordinator (every tick, whenever no state-machine batch is in
+        # flight — the same constraint status() obeys).
+        self.health_monitor = health_mod.HealthMonitor(
+            node_id, logger=config.logger
+        )
 
     # --- boot (reference mirbft.go:436-464) ---
 
@@ -213,6 +227,7 @@ class Node:
             self.notifier,
             client_id=client_id,
             authenticator=self.processor_config.authenticator,
+            health_monitor=self.health_monitor,
         )
 
     def tick(self) -> None:
@@ -281,6 +296,7 @@ class Node:
             self.state_machine, self.processor_config.interceptor, events
         )
         self.span_tracker.observe(events, actions)
+        self.health_monitor.observe_events(events, actions)
         return actions
 
     def metrics_text(self, registry=None) -> str:
@@ -290,6 +306,13 @@ class Node:
         return metrics_mod.render_prometheus(
             registry, extra_labels={"node": str(self.id)}
         )
+
+    def health(self) -> dict:
+        """JSON-ready health report: anomalies, stall windows, and the
+        per-peer fault ledger (docs/OBSERVABILITY.md "Health plane").
+        Pure read of detector state — observation happens on the node's
+        own tick, so polling this cannot perturb the detectors."""
+        return self.health_monitor.report()
 
     # --- coordinator (reference mirbft.go:465-565) ---
 
@@ -348,15 +371,19 @@ class Node:
             "result_results": work.add_state_machine_results,
         }
         waiting_status: List["queue.Queue"] = []
+        health_due = False
         try:
             while not self.notifier.exit_event.is_set():
                 # Status may only be taken while no state-machine batch is in
                 # flight: the result worker mutates the machine off-thread.
-                if waiting_status and not self._pending["result"]:
+                if (waiting_status or health_due) and not self._pending["result"]:
                     snap = status_mod.snapshot(self.state_machine)
                     for reply in waiting_status:
                         reply.put(snap)
                     waiting_status.clear()
+                    if health_due:
+                        health_due = False
+                        self.health_monitor.observe_snapshot(snap)
                 self._dispatch_ready_work()
                 try:
                     tag, payload = self.inbox.get(timeout=0.05)
@@ -366,6 +393,7 @@ class Node:
                     return
                 if tag == "tick":
                     work.result_events.tick_elapsed()
+                    health_due = True
                 elif tag == "status":
                     waiting_status.append(payload)
                 elif tag == "step_events":

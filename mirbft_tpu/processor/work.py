@@ -86,9 +86,16 @@ class WorkItems:
                 self.client_actions.push_back(action)
             elif isinstance(action, st.ActionForwardRequest):
                 # The reference drops these at the same point (work.go:176,
-                # "XXX address"): request forwarding by the leader is
-                # unimplemented; the pull-based FetchRequest path covers
-                # request replication instead.
+                # "XXX address"): request forwarding is unimplemented at
+                # BOTH ends.  This drop swallows the leader's forwards
+                # (sequence.py) AND the disseminator's replies to
+                # FetchRequest, so the pull path never answers; a receiver
+                # would discard an inbound ForwardRequest at ingress anyway
+                # (processor/replicas.py Replica.step).  Replication
+                # actually relies on clients broadcasting to all nodes plus
+                # ack-triggered state transfer (see
+                # test_client_ignores_node_forces_state_transfer); closing
+                # the forwarding gap is an open ROADMAP item.
                 pass
             elif isinstance(action, st.ActionStateTransfer):
                 self.app_actions.push_back(action)

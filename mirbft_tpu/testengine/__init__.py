@@ -7,6 +7,7 @@ without a cluster, bit-for-bit reproducible.  The mangler DSL injects
 network faults (drop/delay/jitter/duplicate/crash-restart) at the queue.
 """
 
+from ..health import DivergenceDetector, HealthConfig, HealthMonitor
 from .crypto import DeviceAuthPlane, DeviceHashPlane
 from .queue import EventQueue, SimEvent
 from .recorder import (
@@ -35,9 +36,12 @@ __all__ = [
     "CryptoConfig",
     "DeviceAuthPlane",
     "DeviceHashPlane",
+    "DivergenceDetector",
     "EventMangling",
     "EventQueue",
     "For",
+    "HealthConfig",
+    "HealthMonitor",
     "NodeConfig",
     "ReconfigPoint",
     "Recorder",

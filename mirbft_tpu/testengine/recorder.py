@@ -16,8 +16,10 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import processor as proc
+from .. import status as status_mod
 from .. import tracing
 from .. import wire
+from ..health import DivergenceDetector, HealthConfig, HealthMonitor
 from ..config import standard_initial_network_state
 from ..messages import (
     CEntry,
@@ -160,6 +162,12 @@ class NodeState:
         # manglers, which cannot fail the app boundary.
         self.fail_transfers = 0
         self.transfer_failures: List[int] = []  # seq_nos of failed attempts
+        # App-level fault injection: the next N snapshots report a flipped
+        # checkpoint fingerprint to introspection while consensus continues
+        # on the honest value — the silent-divergence shape the health
+        # plane's DivergenceDetector exists to catch (a replica whose app
+        # state no longer matches what it voted for).
+        self.corrupt_snapshots = 0
         # Optional sim-clock tap (tests wire it to the event queue) so
         # retry spacing — the backoff — is assertable, not just retry count.
         self.time_source: Optional[Callable[[], int]] = None
@@ -185,6 +193,11 @@ class NodeState:
         # Test convenience (as in the reference): the value carries the full
         # network state so state transfer needs no cross-node lookup.
         value = self.checkpoint_hash + wire.encode(self.checkpoint_state)
+        if self.corrupt_snapshots > 0:
+            self.corrupt_snapshots -= 1
+            self.checkpoint_hash = bytes(
+                b ^ 0xFF for b in self.checkpoint_hash
+            )
         return value, pending
 
     def transfer_to(self, seq_no: int, snap: bytes) -> NetworkState:
@@ -441,6 +454,12 @@ class Recorder:
         # event_log_writer): its clock is bound to the event queue's virtual
         # fake_time and per-node commit-span trackers feed it during step().
         self.tracer: Optional[tracing.Tracer] = None
+        # Optional health plane (set before recording(), same pattern): a
+        # HealthConfig attaches per-node HealthMonitors — fed from the event
+        # stream plus one status snapshot per tick — and a cross-replica
+        # DivergenceDetector fingerprinting checkpoint values each interval
+        # (docs/OBSERVABILITY.md "Health plane").
+        self.health: Optional[HealthConfig] = None
 
     def recording(self) -> "Recording":
         event_queue = EventQueue(seed=self.random_seed, mangler=self.mangler)
@@ -545,6 +564,22 @@ class Recorder:
                 recording.span_trackers[node.id] = tracing.CommitSpanTracker(
                     tracer, node.id
                 )
+        if self.health is not None:
+            health = self.health
+            sim_clock = lambda: float(event_queue.fake_time)  # noqa: E731
+            recording.health_config = health
+            for node in nodes:
+                recording.health_monitors[node.id] = HealthMonitor(
+                    node.id,
+                    tracer=self.tracer,
+                    logger=node.logger,
+                    clock=sim_clock,
+                    thresholds=health.thresholds,
+                    num_nodes=len(nodes),
+                )
+            recording.divergence = DivergenceDetector(
+                tracer=self.tracer, logger=self.logger
+            )
         return recording
 
 
@@ -587,6 +622,14 @@ class Recording:
         # is attached): per-node commit-span trackers fed during step().
         self.tracer: Optional[tracing.Tracer] = None
         self.span_trackers: Dict[int, tracing.CommitSpanTracker] = {}
+        # Health plane (wired by Recorder.recording() when Recorder.health
+        # is set): per-node monitors observe events during step() and a
+        # snapshot per tick; the divergence detector sweeps every node's
+        # checkpoint fingerprint each interval.
+        self.health_config: Optional[HealthConfig] = None
+        self.health_monitors: Dict[int, HealthMonitor] = {}
+        self.divergence: Optional[DivergenceDetector] = None
+        self._next_divergence_check = 0
 
     def _schedule_proposal(
         self, node_id: int, client_id: int, req_no: int, data: bytes, delay: int
@@ -689,6 +732,11 @@ class Recording:
                     # Forged or corrupt proposal: reject before it can be
                     # persisted or acked.  The legitimate client's own
                     # proposal chain is scheduled independently.
+                    monitor = self.health_monitors.get(node.id)
+                    if monitor is not None:
+                        monitor.record_fault(
+                            client_id, "ingress_reject", req_no=req_no
+                        )
                     return
                 events = client.propose(req_no, data)
                 node.work_items.add_client_results(events)
@@ -707,6 +755,31 @@ class Recording:
         elif event.tick:
             node.work_items.result_events.tick_elapsed()
             queue.insert_tick(node.id, parms.tick_interval)
+            if self.health_monitors:
+                monitor = self.health_monitors.get(node.id)
+                if monitor is not None and node.state_machine is not None:
+                    monitor.observe_snapshot(
+                        status_mod.snapshot(node.state_machine),
+                        now=float(queue.fake_time),
+                    )
+                if (
+                    self.divergence is not None
+                    and queue.fake_time >= self._next_divergence_check
+                ):
+                    self._next_divergence_check = (
+                        queue.fake_time
+                        + self.health_config.divergence_check_interval
+                    )
+                    self.divergence.observe(
+                        {
+                            n.id: (
+                                n.state.checkpoint_seq_no,
+                                n.state.checkpoint_hash,
+                            )
+                            for n in self.nodes
+                        },
+                        now=float(queue.fake_time),
+                    )
         elif event.process_req_store_events is not None:
             node.work_items.add_req_store_results(
                 proc.process_reqstore_events(
@@ -721,6 +794,10 @@ class Recording:
             tracker = self.span_trackers.get(node.id)
             if tracker is not None:
                 tracker.observe(event.process_result_events, actions)
+            if self.health_monitors:
+                monitor = self.health_monitors.get(node.id)
+                if monitor is not None:
+                    monitor.observe_events(event.process_result_events, actions)
             node.work_items.add_state_machine_results(actions)
             node.pending["result"] = False
         elif event.process_wal_actions is not None:
@@ -796,6 +873,29 @@ class Recording:
                     # Start the device working on this batch (async) while
                     # the simulated hash latency elapses.
                     self.hash_plane.enqueue([a.data for a in batch])
+
+    def health_report(self) -> dict:
+        """Aggregate health report: per-node monitor reports plus the
+        cross-replica divergence sweep (requires ``Recorder.health``)."""
+        per_node = {
+            str(node_id): monitor.report()
+            for node_id, monitor in sorted(self.health_monitors.items())
+        }
+        divergence = self.divergence
+        anomalies = [
+            a for report in per_node.values() for a in report["anomalies"]
+        ]
+        if divergence is not None:
+            anomalies.extend(a.as_dict() for a in divergence.anomalies)
+        return {
+            "anomaly_count": len(anomalies),
+            "healthy": not anomalies,
+            "anomalies": anomalies,
+            "divergence_checks": (
+                divergence.checks if divergence is not None else 0
+            ),
+            "per_node": per_node,
+        }
 
     def drain_clients(self, timeout: int) -> int:
         """Run until every client's requests commit on every node

@@ -1,5 +1,8 @@
 """Metric/span name lint: every instrument or span name used in the tree
-must be snake_case and documented in docs/OBSERVABILITY.md.
+must be snake_case and documented in docs/OBSERVABILITY.md.  The health
+plane's anomaly and fault kinds (the ``kind`` label values of
+``anomalies_total`` / ``peer_faults_total``) are held to the same rule —
+dashboards select on them exactly like on metric names.
 
 Names drift silently otherwise: a renamed counter keeps compiling, the old
 dashboards/readers just read zero.  The tier-1 suite runs ``check()``
@@ -27,6 +30,12 @@ _SPAN_CALL = re.compile(
     re.MULTILINE,
 )
 _SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
+# The two kind tuples in health.py, parsed textually (keeping this lint
+# import-free so it runs before the tree does).
+_KIND_TUPLE = re.compile(
+    r"^(ANOMALY_KINDS|FAULT_KINDS)\s*=\s*\(([^)]*)\)", re.MULTILINE
+)
+_KIND_ITEM = re.compile(r"\"([^\"]+)\"")
 
 
 def repo_root() -> Path:
@@ -54,20 +63,44 @@ def collect_names(root: Path) -> Dict[str, List[str]]:
     return out
 
 
+def collect_kinds(root: Path) -> Dict[str, List[str]]:
+    """{kind: [source]} for every anomaly/fault kind declared in
+    mirbft_tpu/health.py (empty if the tuples go missing — which is itself
+    reported by ``check``)."""
+    text = (root / "mirbft_tpu" / "health.py").read_text()
+    out: Dict[str, List[str]] = {}
+    for match in _KIND_TUPLE.finditer(text):
+        tuple_name, body = match.groups()
+        for item in _KIND_ITEM.finditer(body):
+            out.setdefault(item.group(1), []).append(
+                f"mirbft_tpu/health.py:{tuple_name}"
+            )
+    return out
+
+
 def check(root: Path = None) -> List[str]:
     """Return violation messages (empty list = clean)."""
     root = root or repo_root()
     docs = (root / "docs" / "OBSERVABILITY.md").read_text()
     violations: List[str] = []
-    for name, sites in sorted(collect_names(root).items()):
+    kinds = collect_kinds(root)
+    if not kinds:
+        violations.append(
+            "no anomaly/fault kinds found in mirbft_tpu/health.py "
+            "(ANOMALY_KINDS/FAULT_KINDS tuples moved or renamed?)"
+        )
+    named = dict(collect_names(root))
+    for kind, sites in kinds.items():
+        named.setdefault(kind, []).extend(sites)
+    for name, sites in sorted(named.items()):
         where = ", ".join(sites[:3])
         if not _SNAKE_CASE.match(name):
             violations.append(
-                f"metric/span name {name!r} is not snake_case ({where})"
+                f"metric/span/kind name {name!r} is not snake_case ({where})"
             )
         if f"`{name}`" not in docs:
             violations.append(
-                f"metric/span name {name!r} is not documented in "
+                f"metric/span/kind name {name!r} is not documented in "
                 f"docs/OBSERVABILITY.md ({where})"
             )
     return violations

@@ -12,26 +12,36 @@ state machines with the tracer clock pinned to each record's *simulated*
 timestamp, deriving per-request commit spans and device hash-wave spans in
 sim time — offline, from any recorded run.
 
+``--doctor`` replays the log through per-node ``HealthMonitor``s (see
+docs/OBSERVABILITY.md "Health plane"): every record feeds the event-stream
+detectors, every tick takes a status snapshot, and the result is a health
+report — stall windows, view-change timelines, anomalies, and per-peer
+fault attribution — for any recorded run, long after it happened.  Exits 1
+when anomalies were found (0 on a clean bill), so it doubles as a CI gate.
+``--doctor-json OUT.json`` additionally writes the full report as JSON.
+
 Usage:
     python -m mirbft_tpu.tools.mircat LOG.gz [--node N ...]
         [--event-type TYPE ...] [--step-type TYPE ...]
         [--interactive] [--status-index IDX ...] [--verbose-text]
-        [--trace OUT.json]
+        [--trace OUT.json] [--doctor] [--doctor-json OUT.json]
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from .. import metrics, tracing
 from .. import state as st
 from .. import status as status_mod
 from ..eventlog import read_event_log
-from ..statemachine.machine import StateMachine
+from ..health import HealthMonitor
+from ..statemachine.machine import MachineState, StateMachine
 from .textmarshal import compact_text
 
 _EVENT_TYPE_NAMES = {
@@ -90,6 +100,18 @@ def _parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         help="replay and export a Chrome trace-event JSON (sim-time commit "
         "spans and hash-wave spans; load in Perfetto)",
     )
+    parser.add_argument(
+        "--doctor",
+        action="store_true",
+        help="replay through per-node health monitors and print a health "
+        "report (stall windows, view changes, per-peer faults); exits 1 "
+        "if anomalies were detected",
+    )
+    parser.add_argument(
+        "--doctor-json",
+        metavar="OUT.json",
+        help="with --doctor: also write the full report as JSON",
+    )
     return parser.parse_args(argv)
 
 
@@ -118,7 +140,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     # --trace replays every event (like --interactive, without the action
     # printing) with the tracer clock pinned to each record's simulated
     # timestamp, so derived spans land in the sim clock domain.
-    do_replay = args.interactive or bool(args.trace)
+    do_replay = args.interactive or bool(args.trace) or args.doctor
     tracer = None
     span_trackers: Dict[int, tracing.CommitSpanTracker] = {}
     wave_trackers: Dict[int, tracing.HashWaveTracker] = {}
@@ -131,11 +153,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             clock_domain="sim",
         )
 
+    # --doctor: per-node monitors with the clock pinned to each record's
+    # simulated timestamp and a private registry (offline analysis must not
+    # pollute the process-global metrics).  Each tick record triggers one
+    # snapshot observation — the same cadence as the live wirings.
+    doctor_monitors: Dict[int, HealthMonitor] = {}
+    doctor_epochs: Dict[int, List[Tuple[float, int]]] = {}
+    doctor_registry = metrics.Registry() if args.doctor else None
+    doctor_clock = {"t": 0.0}
+
     with open(args.log, "rb") as f:
         for index, record in enumerate(read_event_log(f)):
             shown = _matches(record, args)
-            # --trace without --interactive is a pure converter: no listing.
-            if shown and (args.interactive or not args.trace):
+            # --trace / --doctor without --interactive are pure analysis
+            # modes: no event listing.
+            if shown and (
+                args.interactive or not (args.trace or args.doctor)
+            ):
                 text = (
                     repr(record.state_event)
                     if args.verbose_text
@@ -166,6 +200,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                     events = (record.state_event,)
                     spans.observe(events, actions)
                     wave_trackers[node_id].observe(events, actions)
+                if args.doctor:
+                    node_id = record.node_id
+                    doctor_clock["t"] = float(record.time)
+                    monitor = doctor_monitors.get(node_id)
+                    if monitor is None:
+                        monitor = doctor_monitors[node_id] = HealthMonitor(
+                            node_id,
+                            registry=doctor_registry,
+                            clock=lambda: doctor_clock["t"],
+                        )
+                        doctor_epochs[node_id] = []
+                    monitor.observe_events((record.state_event,), actions)
+                    if sm.state == MachineState.INITIALIZED:
+                        epoch = sm.epoch_tracker.current_epoch.number
+                        timeline = doctor_epochs[node_id]
+                        if not timeline or timeline[-1][1] != epoch:
+                            timeline.append((float(record.time), epoch))
+                    if isinstance(record.state_event, st.EventTickElapsed):
+                        monitor.observe_snapshot(
+                            status_mod.snapshot(sm), now=float(record.time)
+                        )
                 if shown and args.interactive:
                     for action in actions:
                         print(f"        -> {compact_text(action)}")
@@ -186,7 +241,87 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"trace: {len(tracer)} events ({commits} commit spans, "
             f"{waves} hash waves) -> {args.trace}"
         )
+    if args.doctor:
+        return _doctor_report(args, doctor_monitors, doctor_epochs)
     return 0
+
+
+def _doctor_report(
+    args: argparse.Namespace,
+    monitors: Dict[int, HealthMonitor],
+    epochs: Dict[int, List[Tuple[float, int]]],
+) -> int:
+    """Print the offline health report; exit 1 if any anomaly was found."""
+    total_anomalies = 0
+    aggregate_faults: Dict[Tuple[int, str], int] = {}
+    per_node = {}
+    for node_id in sorted(monitors):
+        monitor = monitors[node_id]
+        report = monitor.report()
+        report["epoch_timeline"] = [
+            {"time": t, "epoch": e} for t, e in epochs.get(node_id, [])
+        ]
+        per_node[node_id] = report
+        total_anomalies += report["anomaly_count"]
+        for (peer, kind), count in monitor.faults.items():
+            key = (peer, kind)
+            aggregate_faults[key] = aggregate_faults.get(key, 0) + count
+
+        print(
+            f"node {node_id}: "
+            f"{'HEALTHY' if report['healthy'] else 'UNHEALTHY'} "
+            f"({report['anomaly_count']} anomalies, "
+            f"{report['observations']} observations)"
+        )
+        for anomaly in monitor.anomalies:
+            print(f"  {anomaly.describe()}")
+        for window in report["stall_windows"]:
+            until = (
+                f"{window['until']:g}"
+                if window["until"] is not None
+                else "end-of-log"
+            )
+            print(
+                f"  stall window: {window['since']:g}..{until} "
+                f"(low_watermark={window['low_watermark']})"
+            )
+        timeline = epochs.get(node_id, [])
+        if len(timeline) > 1:
+            changes = " -> ".join(
+                f"{e}@{t:g}" for t, e in timeline
+            )
+            print(f"  view changes: {changes}")
+
+    if aggregate_faults:
+        print("peer faults (all nodes):")
+        for (peer, kind), count in sorted(aggregate_faults.items()):
+            print(f"  peer {peer}: {kind} x{count}")
+
+    healthy = total_anomalies == 0
+    print(
+        f"verdict: {'HEALTHY' if healthy else 'UNHEALTHY'} "
+        f"({total_anomalies} anomalies across {len(monitors)} nodes)"
+    )
+    if args.doctor_json:
+        with open(args.doctor_json, "w") as f:
+            json.dump(
+                {
+                    "log": args.log,
+                    "healthy": healthy,
+                    "anomaly_count": total_anomalies,
+                    "peer_faults": {
+                        f"{peer}:{kind}": count
+                        for (peer, kind), count in sorted(
+                            aggregate_faults.items()
+                        )
+                    },
+                    "per_node": per_node,
+                },
+                f,
+                indent=2,
+            )
+        print(f"doctor report -> {args.doctor_json}")
+    return 0 if healthy else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,492 @@
+"""Health plane (mirbft_tpu/health.py, docs/OBSERVABILITY.md).
+
+Unit tier: each detector driven by synthetic status snapshots and event
+streams.  Integration tier: the testengine wiring — a clean run raises
+zero anomalies (the false-positive guard), a silenced-node partition
+raises watermark_stall with suspicion votes attributed to the mangled
+peer, dropped preprepares raise epoch_thrash, a corrupted checkpoint
+fingerprint trips the divergence tripwire, and ``mircat --doctor``
+reproduces the diagnosis offline from the recorded event log.
+"""
+
+import gzip
+import json
+
+import pytest
+
+from mirbft_tpu import metrics
+from mirbft_tpu import state as st
+from mirbft_tpu.health import (
+    ANOMALY_KINDS,
+    FAULT_KINDS,
+    Anomaly,
+    DivergenceDetector,
+    HealthMonitor,
+    HealthThresholds,
+)
+from mirbft_tpu.messages import QEntry, RequestAck, Suspect
+from mirbft_tpu.status import (
+    BucketStatus,
+    CheckpointStatus,
+    ClientTrackerStatus,
+    EpochTargetStatus,
+    EpochTrackerStatus,
+    MsgBufferStatus,
+    NodeBufferStatus,
+    StateMachineStatus,
+)
+from mirbft_tpu.testengine import HealthConfig, Spec
+from mirbft_tpu.testengine.manglers import DropMessages, For, matching
+from mirbft_tpu.tools import mircat
+
+
+# ---------------------------------------------------------------------------
+# Synthetic snapshot scaffolding.
+# ---------------------------------------------------------------------------
+
+
+def snap(
+    low=1,
+    epoch=1,
+    checkpoints=(),
+    client_windows=(),
+    buffer_bytes=0,
+    suspicions=(),
+    buckets=(),
+):
+    return StateMachineStatus(
+        node_id=0,
+        low_watermark=low,
+        high_watermark=low + 39,
+        epoch_tracker=EpochTrackerStatus(
+            active_epoch=EpochTargetStatus(
+                number=epoch,
+                state=4,
+                epoch_changes=[],
+                echos=[],
+                readies=[],
+                suspicions=list(suspicions),
+                leaders=[0, 1, 2, 3],
+            )
+        ),
+        node_buffers=[
+            NodeBufferStatus(
+                id=1,
+                size=buffer_bytes,
+                msgs=1 if buffer_bytes else 0,
+                msg_buffers=[
+                    MsgBufferStatus(
+                        component="ready", size=buffer_bytes, msgs=1
+                    )
+                ],
+            )
+        ],
+        buckets=[BucketStatus(id=i, leader=i == 0, sequences=list(s))
+                 for i, s in enumerate(buckets)],
+        checkpoints=[CheckpointStatus(*cp) for cp in checkpoints],
+        client_windows=[ClientTrackerStatus(*cw) for cw in client_windows],
+    )
+
+
+def pending_snap(**kw):
+    """A snapshot with allocated-uncommitted client requests (the stall
+    detector's pending-work gate)."""
+    kw.setdefault("client_windows", (((0, 0, 100, [1, 1, 1]),)))
+    return snap(**kw)
+
+
+def commit_actions(client_id, req_no, seq_no=5):
+    return (
+        st.ActionCommit(
+            batch=QEntry(
+                seq_no=seq_no,
+                digest=b"d" * 32,
+                requests=(RequestAck(client_id, req_no, b"r" * 32),),
+            )
+        ),
+    )
+
+
+def monitor(**kw):
+    kw.setdefault("registry", metrics.Registry())
+    kw.setdefault("num_nodes", 4)
+    return HealthMonitor(0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: detectors over synthetic streams.
+# ---------------------------------------------------------------------------
+
+
+def test_watermark_stall_fires_and_recovers():
+    m = monitor(thresholds=HealthThresholds(stall_observations=3))
+    for t in range(6):
+        m.observe_snapshot(pending_snap(), now=float(t * 500))
+    kinds = [a.kind for a in m.anomalies]
+    assert kinds == ["watermark_stall"]
+    anomaly = m.anomalies[0]
+    assert anomaly.since == 500.0  # first *unchanged* observation
+    # Recovery: any activity closes the open stall window.
+    m.observe_events((), commit_actions(0, 0))
+    m.observe_snapshot(pending_snap(), now=3000.0)
+    report = m.report()
+    assert report["stall_windows"] == [
+        {"since": 500.0, "until": 3000.0, "low_watermark": 1}
+    ]
+    assert kinds == [a.kind for a in m.anomalies]  # no new anomaly
+
+
+def test_no_stall_when_quiescent_or_active():
+    # Quiescent: identical snapshots but nothing pending -> healthy.
+    m = monitor()
+    for t in range(20):
+        m.observe_snapshot(snap(), now=float(t * 500))
+    assert m.anomalies == []
+    # Active: pending work but commits flowing -> healthy.
+    m = monitor()
+    for t in range(20):
+        m.observe_events((), commit_actions(0, t))
+        m.observe_snapshot(pending_snap(), now=float(t * 500))
+    assert m.anomalies == []
+    # Three-phase activity alone (fill phase before the first commit)
+    # also counts as progress.
+    m = monitor()
+    for t in range(20):
+        m.observe_snapshot(
+            pending_snap(buckets=([t, t + 1],)), now=float(t * 500)
+        )
+    assert m.anomalies == []
+
+
+def test_genesis_checkpoint_is_not_stagnation():
+    # The genesis checkpoint (seq 0, locally decided, never quorums) sits
+    # below the low watermark for the whole run: not an anomaly, and not
+    # pending work for the stall gate either.
+    m = monitor()
+    for t in range(20):
+        m.observe_snapshot(
+            snap(checkpoints=((0, 1, False, True),)), now=float(t * 500)
+        )
+    assert m.anomalies == []
+
+
+def test_checkpoint_stagnation_above_watermark_fires():
+    m = monitor(thresholds=HealthThresholds(checkpoint_stalled_observations=3))
+    for t in range(5):
+        # Keep commits flowing so stall/thrash stay quiet: stagnation is
+        # about one checkpoint, not global progress.
+        m.observe_events((), commit_actions(0, t))
+        m.observe_snapshot(
+            snap(checkpoints=((20, 2, False, True),)), now=float(t * 500)
+        )
+    kinds = [a.kind for a in m.anomalies]
+    assert kinds == ["checkpoint_stagnation"]
+    assert m.anomalies[0].detail["seq_no"] == 20
+
+
+def test_epoch_thrash_fires_without_commits():
+    m = monitor(thresholds=HealthThresholds(thrash_epoch_increments=3))
+    for t, epoch in enumerate([1, 1, 2, 3, 4]):
+        m.observe_snapshot(snap(epoch=epoch), now=float(t * 500))
+    kinds = [a.kind for a in m.anomalies]
+    assert kinds == ["epoch_thrash"]
+    assert m.anomalies[0].detail["view_changes_without_commit"] == 3
+    # With commits between view changes the streak resets: no anomaly.
+    m = monitor(thresholds=HealthThresholds(thrash_epoch_increments=3))
+    for t, epoch in enumerate([1, 2, 3, 4, 5]):
+        m.observe_events((), commit_actions(0, t))
+        m.observe_snapshot(snap(epoch=epoch), now=float(t * 500))
+    assert m.anomalies == []
+
+
+def test_client_starvation_is_relative():
+    th = HealthThresholds(starvation_observations=3)
+    m = monitor(thresholds=th)
+    windows = ((0, 0, 100, [1, 1]), (1, 0, 100, [1]))
+    for t in range(6):
+        # Client 1 commits; client 0's requests sit allocated.
+        m.observe_events((), commit_actions(1, t))
+        m.observe_snapshot(snap(client_windows=windows), now=float(t * 500))
+    starved = [a for a in m.anomalies if a.kind == "client_starvation"]
+    assert [a.detail["client_id"] for a in starved] == [0]
+    # Under a global freeze nothing is "starved" -- that is a stall.
+    m = monitor(thresholds=th)
+    for t in range(6):
+        m.observe_snapshot(snap(client_windows=windows), now=float(t * 500))
+    assert not any(a.kind == "client_starvation" for a in m.anomalies)
+
+
+def test_msg_buffer_growth_needs_monotonic_growth_above_floor():
+    th = HealthThresholds(
+        buffer_growth_observations=3, buffer_growth_floor_bytes=1000
+    )
+    m = monitor(thresholds=th)
+    for t, size in enumerate([2000, 3000, 4000, 5000]):
+        m.observe_events((), commit_actions(0, t))
+        m.observe_snapshot(pending_snap(buffer_bytes=size), now=float(t * 500))
+    assert [a.kind for a in m.anomalies] == ["msg_buffer_growth"]
+    # Growth below the floor, or interrupted by a drain, never fires.
+    m = monitor(thresholds=th)
+    for t, size in enumerate([100, 200, 300, 400, 2000, 500, 2000, 500]):
+        m.observe_events((), commit_actions(0, t))
+        m.observe_snapshot(pending_snap(buffer_bytes=size), now=float(t * 500))
+    assert m.anomalies == []
+
+
+def test_fault_ledger_counts_all_dedups_anomalies():
+    registry = metrics.Registry()
+    m = monitor(registry=registry)
+    m.record_fault(2, "invalid_digest", now=1.0, seq_no=7)
+    m.record_fault(2, "invalid_digest", now=2.0, seq_no=9)
+    m.record_fault(3, "suspicion_vote", now=3.0)
+    report = m.report()
+    assert report["peer_faults"] == {
+        "2:invalid_digest": 2,
+        "3:suspicion_vote": 1,
+    }
+    # One peer_fault anomaly per (peer, kind), every fault counted.
+    assert [
+        (a.peer, a.detail["fault"])
+        for a in m.anomalies
+    ] == [(2, "invalid_digest"), (3, "suspicion_vote")]
+    snap_m = registry.snapshot()
+    assert snap_m['peer_faults_total{kind="invalid_digest",peer="2"}'] == 2
+    assert snap_m['anomalies_total{kind="peer_fault"}'] == 2
+    assert snap_m['health_status{node="0"}'] == 1.0
+    with pytest.raises(ValueError):
+        m.record_fault(1, "not_a_kind")
+
+
+def test_event_stream_attribution():
+    m = monitor()
+    # A suspicion vote targets the suspected epoch's primary.
+    m.observe_events(
+        (st.EventStep(source=2, msg=Suspect(epoch=5)),), ()
+    )
+    assert m.faults == {(5 % 4, "suspicion_vote"): 1}
+    # A fetched batch whose content does not hash to the advertised digest
+    # is attributed to the forwarder.
+    m.observe_events(
+        (
+            st.EventHashResult(
+                digest=b"actual",
+                origin=st.VerifyBatchOrigin(
+                    source=3,
+                    seq_no=11,
+                    request_acks=(),
+                    expected_digest=b"advertised",
+                ),
+            ),
+        ),
+        (),
+    )
+    assert m.faults[(3, "invalid_digest")] == 1
+
+
+def test_divergence_detector_flags_minority_and_dedups():
+    d = DivergenceDetector(registry=metrics.Registry())
+    agree = {0: (20, b"aa"), 1: (20, b"aa"), 2: (20, b"aa")}
+    fresh = d.observe({**agree, 3: (20, b"bb")}, now=100.0)
+    assert [a.node_id for a in fresh] == [3]
+    assert fresh[0].detail["seq_no"] == 20
+    # Same divergence re-observed: no duplicate anomaly.
+    assert d.observe({**agree, 3: (20, b"bb")}, now=200.0) == []
+    # Nodes at different seq_nos are legitimately apart: no anomaly.
+    assert d.observe({0: (20, b"aa"), 1: (40, b"cc")}, now=300.0) == []
+    # A 2-2 split has no majority: every holder is flagged.
+    d2 = DivergenceDetector(registry=metrics.Registry())
+    fresh = d2.observe(
+        {0: (20, b"aa"), 1: (20, b"aa"), 2: (20, b"bb"), 3: (20, b"bb")},
+        now=100.0,
+    )
+    assert sorted(a.node_id for a in fresh) == [0, 1, 2, 3]
+
+
+def test_anomaly_schema_and_kind_tables():
+    a = Anomaly(
+        kind="watermark_stall", node_id=1, time=2.0, since=1.0,
+        detail={"low_watermark": 3},
+    )
+    assert a.as_dict() == {
+        "kind": "watermark_stall",
+        "node_id": 1,
+        "time": 2.0,
+        "since": 1.0,
+        "peer": None,
+        "detail": {"low_watermark": 3},
+    }
+    assert "watermark_stall" in a.describe()
+    assert len(set(ANOMALY_KINDS)) == len(ANOMALY_KINDS)
+    assert len(set(FAULT_KINDS)) == len(FAULT_KINDS)
+
+
+# ---------------------------------------------------------------------------
+# Integration tier: testengine wiring and mircat --doctor.
+# ---------------------------------------------------------------------------
+
+
+def run_health_spec(timeout=30_000_000, health=None, log_writer=None, **kw):
+    tweak = kw.pop("tweak_recorder", None)
+
+    def tweak_all(r):
+        r.health = health if health is not None else HealthConfig()
+        if log_writer is not None:
+            r.event_log_writer = log_writer
+        if tweak is not None:
+            tweak(r)
+
+    spec = Spec(tweak_recorder=tweak_all, **kw)
+    recording = spec.recorder().recording()
+    recording.drain_clients(timeout=timeout)
+    return recording
+
+
+def test_clean_run_raises_zero_anomalies():
+    """The false-positive guard: a clean config-1-shaped run is healthy."""
+    recording = run_health_spec(
+        node_count=4, client_count=2, reqs_per_client=20, batch_size=4
+    )
+    report = recording.health_report()
+    assert report["healthy"] is True
+    assert report["anomaly_count"] == 0, report["anomalies"]
+    assert report["divergence_checks"] > 0
+    # Every node was observed on its tick cadence.
+    assert all(n["observations"] > 0 for n in report["per_node"].values())
+
+
+def test_partition_stall_attributes_mangled_peer():
+    """DropMessages partition: the stall fires and the suspicion votes
+    attribute to the silenced node (the initial epoch's primary)."""
+    recording = run_health_spec(
+        node_count=4,
+        client_count=4,
+        reqs_per_client=10,
+        batch_size=2,
+        health=HealthConfig(thresholds=HealthThresholds(stall_observations=2)),
+        tweak_recorder=lambda r: setattr(
+            r, "mangler", DropMessages(from_nodes=(1,))
+        ),
+    )
+    report = recording.health_report()
+    assert report["healthy"] is False
+    kinds = {a["kind"] for a in report["anomalies"]}
+    assert "watermark_stall" in kinds
+    for node_report in report["per_node"].values():
+        assert node_report["peer_faults"].get("1:suspicion_vote", 0) >= 1
+        assert node_report["stall_windows"], "stall window not recorded"
+
+
+def test_forced_view_changes_raise_epoch_thrash():
+    """Dropping every Preprepare forces view changes that keep completing
+    but never commit anything: the thrash detector trips."""
+    from mirbft_tpu.messages import Preprepare
+
+    def tweak(r):
+        r.mangler = For(matching.msgs().of_type(Preprepare)).drop()
+        r.health = HealthConfig()
+
+    spec = Spec(
+        node_count=4,
+        client_count=2,
+        reqs_per_client=10,
+        batch_size=2,
+        tweak_recorder=tweak,
+    )
+    recording = spec.recorder().recording()
+    queue = recording.event_queue
+    steps = 0
+    thrashed = lambda: any(  # noqa: E731
+        a["kind"] == "epoch_thrash"
+        for a in recording.health_report()["anomalies"]
+    )
+    while queue.fake_time < 120_000 and steps < 60_000:
+        recording.step()
+        steps += 1
+        if steps % 2000 == 0 and thrashed():
+            break
+    assert thrashed(), recording.health_report()["anomalies"]
+
+
+def test_divergence_tripwire_flags_corrupted_replica():
+    """App-level fault injection: node 3 reports corrupted checkpoint
+    fingerprints while consensus proceeds on the honest value — the
+    cross-replica sweep flags exactly the corrupted node."""
+    spec = Spec(
+        node_count=4,
+        client_count=2,
+        reqs_per_client=60,
+        batch_size=2,
+        tweak_recorder=lambda r: setattr(r, "health", HealthConfig()),
+    )
+    recording = spec.recorder().recording()
+    recording.nodes[3].state.corrupt_snapshots = 999
+    recording.drain_clients(timeout=30_000_000)
+    report = recording.health_report()
+    divergences = [
+        a for a in report["anomalies"] if a["kind"] == "checkpoint_divergence"
+    ]
+    assert divergences, report
+    assert {a["node_id"] for a in divergences} == {3}
+    assert all(
+        sorted(a["detail"]["disagreeing_nodes"]) == [0, 1, 2]
+        for a in divergences
+    )
+
+
+def test_mircat_doctor_reports_mangled_run(tmp_path, capsys):
+    """Offline diagnosis: --doctor on the recorded event log of a
+    silenced-node run reports the stall window, the view-change timeline,
+    and attributes the suspicion votes to the mangled peer — and exits 1."""
+    log_path = tmp_path / "mangled.eventlog.gz"
+    raw = open(log_path, "wb")
+    gz = gzip.GzipFile(fileobj=raw, mode="wb")
+    run_health_spec(
+        node_count=4,
+        client_count=4,
+        reqs_per_client=10,
+        batch_size=2,
+        log_writer=gz,
+        tweak_recorder=lambda r: setattr(
+            r, "mangler", DropMessages(from_nodes=(1,))
+        ),
+    )
+    gz.close()
+    raw.close()
+
+    json_path = tmp_path / "doctor.json"
+    rc = mircat.main(
+        [str(log_path), "--doctor", "--doctor-json", str(json_path)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "verdict: UNHEALTHY" in out
+    assert "stall window:" in out
+    assert "view changes:" in out
+    assert "peer 1: suspicion_vote" in out
+
+    report = json.loads(json_path.read_text())
+    assert report["healthy"] is False
+    assert any(k.startswith("1:suspicion_vote") for k in report["peer_faults"])
+    for node_report in report["per_node"].values():
+        assert node_report["stall_windows"]
+        assert len(node_report["epoch_timeline"]) >= 2
+
+
+def test_mircat_doctor_clean_log_is_healthy(tmp_path, capsys):
+    log_path = tmp_path / "clean.eventlog.gz"
+    raw = open(log_path, "wb")
+    gz = gzip.GzipFile(fileobj=raw, mode="wb")
+    run_health_spec(
+        node_count=4,
+        client_count=2,
+        reqs_per_client=10,
+        batch_size=2,
+        timeout=20_000_000,
+        log_writer=gz,
+    )
+    gz.close()
+    raw.close()
+    rc = mircat.main([str(log_path), "--doctor"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "verdict: HEALTHY" in out

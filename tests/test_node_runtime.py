@@ -389,6 +389,14 @@ def test_stressy_device_crypto(tmp_path):
         for auth in authenticators:
             assert auth.verified_count >= reqs + 1
             assert auth.dispatch_seconds, "no verify dispatch recorded"
+        # The rejected forgery landed in node 0's fault ledger as an
+        # ingress_reject attributed to the claimed client id.
+        health = nodes[0].health()
+        assert health["peer_faults"].get("0:ingress_reject") == 1
+        assert any(
+            a["kind"] == "peer_fault" and a["detail"]["fault"] == "ingress_reject"
+            for a in health["anomalies"]
+        )
     finally:
         stop()
 
@@ -421,5 +429,12 @@ def test_node_runtime_commit_spans_and_prometheus_surface(tmp_path):
         assert "# TYPE commit_latency_seconds summary" in text
         assert 'node="0"' in text
         assert 'commit_latency_seconds_count{node="0"}' in text
+        # Node.health(): the runtime health scrape next to metrics_text().
+        # A clean single-node run is anomaly-free and has been observed at
+        # least once by the coordinator's periodic health tick.
+        health = node.health()
+        assert health["node_id"] == 0
+        assert health["healthy"] is True
+        assert health["anomalies"] == []
     finally:
         stop()
