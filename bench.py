@@ -687,6 +687,83 @@ def bench_tpu_verify_kernel(
     return batch / piped, piped, sync_p99
 
 
+def bench_pack_path(detail, hash_batch=4096, msg_len=640,
+                    verify_batch=1024, n_keys=64, reps=5):
+    """Host-side marshalling anatomy: the vectorized pooled SHA-256 packer
+    vs the legacy per-message ``pad_message`` + row-copy loop (bit-identical
+    kernel inputs, asserted here), and the vectorized Ed25519
+    ``pack_inputs``.  Pure host CPU timings — no device dispatch — so the
+    pack share of ``hash_dispatch_4096_ms`` / ``sig_verify_dispatch_1024_ms``
+    is a recorded artifact (docs/PERFORMANCE.md "Dispatch-path anatomy")."""
+    import numpy as np
+
+    from mirbft_tpu.ops.sha256 import TpuHasher, _next_pow2, pad_message
+
+    rng = np.random.default_rng(0)
+    msgs = [
+        rng.integers(0, 256, size=msg_len, dtype=np.uint8).tobytes()
+        for _ in range(hash_batch)
+    ]
+    hasher = TpuHasher(min_device_batch=1)
+
+    def vec_pack():
+        packed = hasher.pack(msgs)
+        hasher._pool.release(packed.lease)
+        return packed
+
+    def legacy_pack():
+        padded = [pad_message(m) for m in msgs]
+        bucket = _next_pow2(max(p.shape[0] for p in padded))
+        batch = _next_pow2(len(msgs))
+        blocks = np.zeros((batch, bucket, 16), dtype=np.uint32)
+        n_blocks = np.zeros(batch, dtype=np.uint32)
+        for row, p in enumerate(padded):
+            blocks[row, : p.shape[0]] = p
+            n_blocks[row] = p.shape[0]
+        return blocks, n_blocks
+
+    packed = vec_pack()  # warm the pool
+    ref_blocks, ref_n = legacy_pack()
+    if not (
+        np.array_equal(np.asarray(packed.blocks), ref_blocks)
+        and np.array_equal(np.asarray(packed.n_blocks), ref_n)
+    ):
+        raise RuntimeError("vectorized packer diverged from legacy packing")
+
+    vec = min(_timed(vec_pack) for _ in range(reps))
+    legacy = min(_timed(legacy_pack) for _ in range(max(2, reps // 2)))
+    detail["hash_pack_4096_ms"] = round(vec * 1e3, 2)
+    detail["hash_pack_4096_legacy_ms"] = round(legacy * 1e3, 2)
+    detail["hash_pack_speedup"] = round(legacy / vec, 1) if vec else None
+
+    from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier, keypair_from_seed
+
+    verifier = Ed25519BatchVerifier(min_device_batch=1)
+    pubs, vmsgs, sigs = [], [], []
+    keys = {}
+    for i in range(verify_batch):
+        cid = i % n_keys
+        if cid not in keys:
+            keys[cid] = keypair_from_seed((cid + 1).to_bytes(4, "big") * 8)
+        m = b"bench-request-%d" % i
+        pub, sign = keys[cid]
+        pubs.append(pub)
+        vmsgs.append(m)
+        sigs.append(sign(m))
+    verifier.pack_inputs(pubs, vmsgs, sigs)  # warm the key/limb caches
+    vpack = min(
+        _timed(lambda: verifier.pack_inputs(pubs, vmsgs, sigs))
+        for _ in range(reps)
+    )
+    detail["verify_pack_1024_ms"] = round(vpack * 1e3, 2)
+
+
+def _timed(fn):
+    start = time.perf_counter()
+    fn()
+    return time.perf_counter() - start
+
+
 def bench_device_resident(detail, hash_batch=4096, msg_len=640,
                           verify_batch=1024, reps=8):
     """Device-resident kernel rates (inputs staged on device once; timing
@@ -1084,6 +1161,10 @@ def main():
         bench_quorum_plane(detail)
     except Exception as exc:
         detail["quorum_plane_error"] = f"{type(exc).__name__}: {exc}"[:160]
+    try:
+        bench_pack_path(detail)
+    except Exception as exc:
+        detail["pack_path_error"] = f"{type(exc).__name__}: {exc}"[:160]
     try:
         per_s, piped, sync = bench_tpu_hash_kernel()
         detail["tpu_hashes_per_s"] = round(per_s, 1)

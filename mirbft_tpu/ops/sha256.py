@@ -33,7 +33,8 @@ interchangeable implementations of ``processor.Hasher``).
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -135,13 +136,16 @@ def sha256_batch_kernel(blocks: jnp.ndarray, n_blocks: jnp.ndarray) -> jnp.ndarr
 
 
 # ---------------------------------------------------------------------------
-# Host-side packing: bytes -> padded uint32 block arrays.
+# Host-side packing: bytes -> padded uint32 block arrays (vectorized, pooled).
 # ---------------------------------------------------------------------------
 
 
 def pad_message(message: bytes) -> np.ndarray:
     """SHA-256 padding: message || 0x80 || zeros || 64-bit bit length,
-    as an [n_blocks, 16] uint32 (big-endian words) array."""
+    as an [n_blocks, 16] uint32 (big-endian words) array.
+
+    Per-message reference implementation — the dispatch path uses the
+    vectorized ``pack_messages`` and tests pin the two against each other."""
     length = len(message)
     n_blocks = (length + 8) // 64 + 1
     buf = np.zeros(n_blocks * 64, dtype=np.uint8)
@@ -157,22 +161,224 @@ def _next_pow2(n: int) -> int:
 
 
 def digests_from_words(words: np.ndarray) -> List[bytes]:
-    """[B, 8] uint32 -> list of 32-byte digests."""
-    be = words.astype(">u4")
-    return [be[i].tobytes() for i in range(be.shape[0])]
+    """[B, 8] uint32 -> list of 32-byte digests.
+
+    One bulk big-endian conversion + ``memoryview`` slicing — no per-row
+    numpy calls (the per-row ``tobytes()`` loop was a measurable slice of
+    dispatch wall time at wave sizes)."""
+    buf = np.ascontiguousarray(words).astype(">u4").tobytes()
+    view = memoryview(buf)
+    return [bytes(view[i * 32 : i * 32 + 32]) for i in range(words.shape[0])]
+
+
+class _Lease:
+    """One pooled set of packing buffers, alive from ``acquire`` until the
+    matching ``collect`` releases it.  The jax CPU backend may zero-copy
+    alias numpy inputs, so a buffer must never be refilled while a dispatch
+    that read it is still in flight; ``collect`` blocks on materialization,
+    which makes release-at-collect safe on every backend."""
+
+    __slots__ = ("key", "flat", "n_blocks", "scratch")
+
+    def __init__(self, key, flat, n_blocks, scratch):
+        self.key = key  # (layout, batch, bucket)
+        self.flat = flat  # uint8 [batch * bucket * 64], kernel-layout bytes
+        self.n_blocks = n_blocks  # uint32, kernel-layout shaped
+        self.scratch = scratch  # uint8 batch-major staging (lanes only)
+
+
+class _BufferPool:
+    """Reusable packing buffers keyed by (layout, batch bucket, block
+    bucket).  Dual bucketing means steady-state traffic cycles through a
+    handful of shapes, so pooled buffers remove the dominant allocation +
+    zero-fill cost from the dispatch path.  At most ``cap`` free buffers are
+    kept per key; extras are dropped to the GC."""
+
+    def __init__(self, cap: int = 4):
+        self.cap = cap
+        self._free: Dict[tuple, List[_Lease]] = {}
+
+    def acquire(self, layout: str, batch: int, bucket: int) -> _Lease:
+        key = (layout, batch, bucket)
+        free = self._free.get(key)
+        if free:
+            return free.pop()
+        nbytes = batch * bucket * 64
+        flat = np.empty(nbytes, dtype=np.uint8)
+        if layout == "lanes":
+            from .sha256_pallas_lanes import LANES, SUB, TILE
+
+            n_blocks = np.empty((batch // TILE, 1, SUB, LANES), dtype=np.uint32)
+            scratch = np.empty(nbytes, dtype=np.uint8)
+        else:
+            n_blocks = np.empty(batch, dtype=np.uint32)
+            scratch = None
+        return _Lease(key, flat, n_blocks, scratch)
+
+    def release(self, lease: _Lease) -> None:
+        free = self._free.setdefault(lease.key, [])
+        if len(free) < self.cap:
+            free.append(lease)
+
+
+class PackedWave:
+    """Kernel-ready arrays from ``pack_messages`` plus the pooled lease (if
+    any).  Unpacks as ``blocks, n_blocks = pack_messages(...)`` for callers
+    that only want the arrays."""
+
+    __slots__ = ("blocks", "n_blocks", "count", "layout", "lease")
+
+    def __init__(self, blocks, n_blocks, count, layout, lease=None):
+        self.blocks = blocks
+        self.n_blocks = n_blocks
+        self.count = count
+        self.layout = layout
+        self.lease = lease
+
+    def __iter__(self):
+        return iter((self.blocks, self.n_blocks))
+
+
+def pack_messages(
+    messages: Sequence[bytes],
+    block_bucket: Optional[int] = None,
+    batch_bucket: Optional[int] = None,
+    *,
+    layout: str = "batch",
+    batch_multiple: int = 1,
+    pool: Optional[_BufferPool] = None,
+) -> PackedWave:
+    """Vectorized SHA-256 packer: pad + pack a whole wave with bulk numpy
+    arithmetic instead of a per-message ``pad_message`` loop.
+
+    Rows are grouped by byte length so each distinct length costs one
+    ``b"".join`` + one 2D slice assign; the 0x80 terminator and big-endian
+    64-bit bit-length words are written with n-element fancy assignments.
+    The uint32 big-endian word view is produced by one in-place byteswap.
+
+    ``layout="batch"`` returns [batch, bucket, 16] / [batch] for the scan
+    and batch-major pallas kernels; ``layout="lanes"`` returns
+    [tiles, bucket, 16, 8, 128] / [tiles, 1, 8, 128] packed directly for
+    the lanes-major pallas kernel (no device-side relayout).
+
+    ``pool`` reuses buffers keyed by the (layout, batch, bucket) shape —
+    zero steady-state allocation; the caller must route the returned lease
+    through ``TpuHasher.collect`` (or ``_BufferPool.release``) before the
+    same shape is packed twice concurrently."""
+    n = len(messages)
+    lengths = np.fromiter((len(m) for m in messages), dtype=np.int64, count=n)
+    nb_real = (lengths + 8) // 64 + 1
+    bucket = _next_pow2(int(nb_real.max())) if n else 1
+    if block_bucket is not None:
+        bucket = max(bucket, block_bucket)
+    batch = _next_pow2(n)
+    if batch_bucket is not None:
+        batch = max(batch, batch_bucket)
+    if layout == "lanes":
+        from .sha256_pallas_lanes import LANES, SUB, TILE
+
+        batch = ((batch + TILE - 1) // TILE) * TILE
+    if batch_multiple > 1:
+        batch = ((batch + batch_multiple - 1) // batch_multiple) * batch_multiple
+    row_bytes = bucket * 64
+
+    lease = pool.acquire(layout, batch, bucket) if pool is not None else None
+    if lease is not None:
+        flat, n_blocks_arr, scratch = lease.flat, lease.n_blocks, lease.scratch
+    else:
+        flat = np.empty(batch * row_bytes, dtype=np.uint8)
+        if layout == "lanes":
+            n_blocks_arr = np.empty((batch // TILE, 1, SUB, LANES), dtype=np.uint32)
+            scratch = np.empty(batch * row_bytes, dtype=np.uint8)
+        else:
+            n_blocks_arr = np.empty(batch, dtype=np.uint32)
+            scratch = None
+
+    staging = scratch if layout == "lanes" else flat
+    staging.fill(0)
+    rows2d = staging.reshape(batch, row_bytes)
+
+    groups: Dict[int, List[int]] = {}
+    for i, m in enumerate(messages):
+        groups.setdefault(len(m), []).append(i)
+    for length, idx in groups.items():
+        if length == 0:
+            continue
+        cat = np.frombuffer(b"".join(messages[i] for i in idx), dtype=np.uint8)
+        rows2d[np.asarray(idx), :length] = cat.reshape(len(idx), length)
+
+    rows = np.arange(n, dtype=np.int64)
+    rows2d[rows, lengths] = 0x80
+    tail = (nb_real * 64 - 8)[:, None] + np.arange(8, dtype=np.int64)[None, :]
+    bits = (lengths * 8).astype(np.uint64)
+    be = (
+        (bits[:, None] >> (np.arange(8, dtype=np.uint64)[::-1] * np.uint64(8)))
+        & np.uint64(0xFF)
+    ).astype(np.uint8)
+    rows2d[np.broadcast_to(rows[:, None], tail.shape), tail] = be
+
+    nb_flat = n_blocks_arr.reshape(batch)
+    nb_flat[:n] = nb_real
+    nb_flat[n:] = 0
+
+    if layout == "batch":
+        words = staging.view(np.uint32)
+        words.byteswap(inplace=True)
+        blocks = words.reshape(batch, bucket, 16)
+    else:
+        tiles = batch // TILE
+        blocks = flat.view(np.uint32).reshape(tiles, bucket, 16, SUB, LANES)
+        np.copyto(
+            blocks,
+            staging.view(np.uint32)
+            .reshape(tiles, SUB, LANES, bucket, 16)
+            .transpose(0, 3, 4, 1, 2),
+        )
+        blocks.byteswap(inplace=True)
+    return PackedWave(blocks, n_blocks_arr, n, layout, lease)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _sha256_batch_kernel_donated(
+    blocks: jnp.ndarray, n_blocks: jnp.ndarray
+) -> jnp.ndarray:
+    """Same as ``sha256_batch_kernel`` but with donated inputs: the packed
+    block buffer's device copy is released back to the allocator as soon as
+    the kernel has consumed it, halving device-memory pressure per in-flight
+    wave.  Kept separate from the undonated jit — callers like
+    ``bench_device_resident`` reuse device-resident inputs across calls,
+    which donation would invalidate."""
+    return jax.vmap(_sha256_padded)(blocks, n_blocks)
+
+
+@functools.lru_cache(maxsize=1)
+def _donation_pays() -> bool:
+    # Donating numpy inputs only helps on backends that transfer then reuse
+    # the device buffer; the CPU backend just warns about unused donations.
+    return jax.default_backend() == "tpu"
+
+
+def _metrics():
+    from .. import metrics
+
+    return metrics
 
 
 class HashDispatch:
     """An in-flight async device dispatch: the result array is still on the
     device; ``TpuHasher.collect`` materializes it.  Launching costs one
     enqueue (non-blocking); the ~100 ms round-trip of a tunneled device is
-    paid only when (and if) the digests are first needed."""
+    paid only when (and if) the digests are first needed.  Carries the
+    packing lease so ``collect`` can return the pooled buffer once the
+    device results are host-resident."""
 
-    __slots__ = ("words", "count")
+    __slots__ = ("words", "count", "layout", "lease")
 
-    def __init__(self, words, count: int):
+    def __init__(self, words, count: int, layout: str = "batch", lease=None):
         self.words = words  # jax [B, 8] uint32, possibly padded rows
         self.count = count  # real rows
+        self.layout = layout
+        self.lease = lease
 
 
 class TpuHasher:
@@ -189,17 +395,29 @@ class TpuHasher:
     ``kernel``: "scan" (vmapped lax.scan, the default), "pallas"
     (batch-major explicit VMEM tiling; see ``ops/sha256_pallas.py``), or
     "lanes" (lanes-major pallas, the round-5 experiment winner at large
-    device-resident batches; see ``ops/sha256_pallas_lanes.py`` — the
-    host packs lanes-major so no device-side relayout is paid).  ``dispatch``/``collect``
-    expose the asynchronous path: ``dispatch`` enqueues the device work and
-    returns immediately; ``collect`` blocks until the digests are on host.
-    """
+    device-resident batches; see ``ops/sha256_pallas_lanes.py`` — the host
+    packs lanes-major directly so no relayout is paid on either side).
+
+    ``mesh``: an optional ``jax.sharding.Mesh`` (see ``parallel.mesh``);
+    when set, dispatches shard the batch dimension across the mesh via
+    ``sharded_sha256`` (forces batch-major layout) and the
+    ``mesh_hash_dispatches`` / ``mesh_hashed_messages`` counters track the
+    traffic.
+
+    The marshalling path is split in two: ``pack`` runs the vectorized
+    packer into pooled buffers (host CPU work, ``hash_pack_seconds``);
+    ``dispatch_packed`` enqueues the kernel (``hash_device_dispatch_seconds``) and
+    returns without blocking; ``collect`` blocks until the digests are
+    host-resident and releases the buffers back to the pool.  ``dispatch``
+    is the pack+enqueue convenience used by callers without their own
+    pipelining."""
 
     def __init__(
         self,
         min_device_batch: int = 32,
         max_block_bucket: int = 1 << 14,
         kernel: str = "scan",
+        mesh=None,
     ):
         self.min_device_batch = min_device_batch
         self.max_block_bucket = max_block_bucket
@@ -207,27 +425,77 @@ class TpuHasher:
             raise ValueError(f"unknown sha256 kernel {kernel!r}")
         self.kernel = kernel
         self._cpu = None
+        self._pool = _BufferPool()
+        self._mesh_fn = None
+        self._mesh_size = 0
+        if mesh is not None:
+            from ..parallel.mesh import sharded_sha256
+
+            self._mesh_fn = sharded_sha256(mesh)
+            self._mesh_size = int(mesh.devices.size)
 
     def _kernel_fn(self):
         if self.kernel == "pallas":
-            import jax
-
             from .sha256_pallas import sha256_batch_kernel_pallas
 
             interpret = jax.default_backend() != "tpu"
             return functools.partial(
                 sha256_batch_kernel_pallas, interpret=interpret
             )
-        if self.kernel == "lanes":
-            import jax
+        if _donation_pays():
+            return _sha256_batch_kernel_donated
+        return sha256_batch_kernel
 
-            from .sha256_pallas_lanes import sha256_lanes_from_batch_major
+    def pack(
+        self,
+        messages: Sequence[bytes],
+        block_bucket: Optional[int] = None,
+        batch_bucket: Optional[int] = None,
+    ) -> PackedWave:
+        """Phase 1 of a dispatch: vectorized packing into pooled buffers,
+        shaped for this hasher's kernel (lanes-major for ``kernel="lanes"``).
+        Pure host CPU work — callers may overlap it with in-flight device
+        execution of the previous wave."""
+        start = time.perf_counter()
+        layout = (
+            "lanes" if self.kernel == "lanes" and self._mesh_fn is None
+            else "batch"
+        )
+        packed = pack_messages(
+            messages,
+            block_bucket,
+            batch_bucket,
+            layout=layout,
+            batch_multiple=self._mesh_size or 1,
+            pool=self._pool,
+        )
+        _metrics().histogram("hash_pack_seconds").observe(
+            time.perf_counter() - start
+        )
+        return packed
+
+    def dispatch_packed(self, packed: PackedWave) -> HashDispatch:
+        """Phase 2: enqueue ONE kernel call on the packed wave; returns
+        without blocking on device execution."""
+        start = time.perf_counter()
+        if self._mesh_fn is not None:
+            words = self._mesh_fn(packed.blocks, packed.n_blocks)
+            m = _metrics()
+            m.counter("mesh_hash_dispatches").inc()
+            m.counter("mesh_hashed_messages").inc(packed.count)
+        elif packed.layout == "lanes":
+            from .sha256_pallas_lanes import sha256_lanes_kernel
 
             interpret = jax.default_backend() != "tpu"
-            return functools.partial(
-                sha256_lanes_from_batch_major, interpret=interpret
+            words = sha256_lanes_kernel(
+                packed.blocks, packed.n_blocks, interpret=interpret
             )
-        return sha256_batch_kernel
+        else:
+            words = self._kernel_fn()(packed.blocks, packed.n_blocks)
+        _metrics().histogram("hash_device_dispatch_seconds").observe(
+            time.perf_counter() - start
+        )
+        return HashDispatch(words, packed.count, packed.layout, packed.lease)
 
     def dispatch(
         self,
@@ -235,31 +503,31 @@ class TpuHasher:
         block_bucket: Optional[int] = None,
         batch_bucket: Optional[int] = None,
     ) -> HashDispatch:
-        """Asynchronously digest same-bucket packed messages: pads shapes,
+        """Asynchronously digest same-bucket packed messages: packs shapes,
         enqueues ONE kernel call, returns without blocking.  All messages
         must fit one block bucket (the caller groups by bucket).  Callers may
         pin ``block_bucket``/``batch_bucket`` to quantized values so repeated
-        dispatches reuse one compiled kernel shape."""
-        padded = [pad_message(m) for m in messages]
-        bucket = _next_pow2(max(p.shape[0] for p in padded))
-        if block_bucket is not None:
-            bucket = max(bucket, block_bucket)
-        batch_size = _next_pow2(len(messages))
-        if batch_bucket is not None:
-            batch_size = max(batch_size, batch_bucket)
-        blocks = np.zeros((batch_size, bucket, 16), dtype=np.uint32)
-        n_blocks = np.zeros(batch_size, dtype=np.uint32)
-        for row, p in enumerate(padded):
-            blocks[row, : p.shape[0]] = p
-            n_blocks[row] = p.shape[0]
-        words = self._kernel_fn()(blocks, n_blocks)
-        return HashDispatch(words, len(messages))
+        dispatches reuse one compiled kernel shape (and one pooled buffer)."""
+        return self.dispatch_packed(
+            self.pack(messages, block_bucket, batch_bucket)
+        )
 
     def collect(self, handle: HashDispatch) -> List[bytes]:
         """Block until a dispatch's digests are host-resident; return them
-        in input order."""
+        in input order and release the packing buffers to the pool."""
         words = np.asarray(handle.words)
-        return digests_from_words(words[: handle.count])
+        if handle.layout == "lanes":
+            from .sha256_pallas_lanes import TILE
+
+            tiles = words.shape[0]
+            words = words.transpose(0, 2, 3, 1).reshape(tiles * TILE, 8)
+        digests = digests_from_words(words[: handle.count])
+        if handle.lease is not None:
+            # np.asarray above materialized the device result, so the device
+            # can no longer be reading the pooled input buffer.
+            self._pool.release(handle.lease)
+            handle.lease = None
+        return digests
 
     def _hash_cpu(self, batches: Sequence[Sequence[bytes]]) -> List[bytes]:
         if self._cpu is None:
@@ -273,37 +541,37 @@ class TpuHasher:
             return self._hash_cpu(batches)
 
         messages = [b"".join(parts) for parts in batches]
-        padded = [pad_message(m) for m in messages]
 
-        # Group indices by power-of-two block bucket.
-        groups = {}
-        for i, blocks in enumerate(padded):
-            bucket = _next_pow2(blocks.shape[0])
+        # Group indices by power-of-two block bucket; degenerate huge
+        # messages hash on CPU rather than shipping an outsized one-off
+        # shape to the device.
+        groups: Dict[int, List[int]] = {}
+        cpu_indices: List[int] = []
+        for i, m in enumerate(messages):
+            bucket = _next_pow2((len(m) + 8) // 64 + 1)
             if bucket > self.max_block_bucket:
-                # Degenerate huge message: hash on CPU rather than ship an
-                # outsized one-off shape to the device.
-                groups.setdefault("cpu", []).append(i)
+                cpu_indices.append(i)
             else:
                 groups.setdefault(bucket, []).append(i)
 
         out: List[Optional[bytes]] = [None] * len(messages)
-        for bucket, indices in sorted(
-            groups.items(), key=lambda kv: (kv[0] == "cpu", kv[0] if kv[0] != "cpu" else 0)
-        ):
-            if bucket == "cpu":
-                cpu_digests = self._hash_cpu([batches[i] for i in indices])
-                for i, d in zip(indices, cpu_digests):
-                    out[i] = d
-                continue
-            batch_size = _next_pow2(len(indices))
-            blocks = np.zeros((batch_size, bucket, 16), dtype=np.uint32)
-            n_blocks = np.zeros(batch_size, dtype=np.uint32)
-            for row, i in enumerate(indices):
-                nb = padded[i].shape[0]
-                blocks[row, :nb] = padded[i]
-                n_blocks[row] = nb
-            words = np.asarray(self._kernel_fn()(blocks, n_blocks))
-            digests = digests_from_words(words[: len(indices)])
-            for i, d in zip(indices, digests):
+        # Enqueue every device group before collecting any: the device works
+        # through wave k while the host packs wave k+1.  Buckets are all
+        # ints here (CPU overflow rows are kept separate), so the sort key
+        # is total — no mixed str/int comparison.
+        in_flight: List[Tuple[List[int], HashDispatch]] = []
+        for bucket in sorted(groups):
+            indices = groups[bucket]
+            handle = self.dispatch(
+                [messages[i] for i in indices], block_bucket=bucket
+            )
+            in_flight.append((indices, handle))
+        if cpu_indices:
+            for i, d in zip(
+                cpu_indices, self._hash_cpu([batches[i] for i in cpu_indices])
+            ):
+                out[i] = d
+        for indices, handle in in_flight:
+            for i, d in zip(indices, self.collect(handle)):
                 out[i] = d
         return out  # type: ignore[return-value]

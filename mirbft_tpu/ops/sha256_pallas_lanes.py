@@ -108,7 +108,10 @@ def _compiled(tiles: int, n_block_bucket: int, interpret: bool):
         ),
         out_shape=jax.ShapeDtypeStruct((tiles, 8, SUB, LANES), jnp.uint32),
         scratch_shapes=[pltpu.VMEM((8, SUB, LANES), jnp.uint32)],
-        compiler_params=pltpu.CompilerParams(
+        # jax renamed TPUCompilerParams -> CompilerParams around 0.5.
+        compiler_params=getattr(
+            pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+        )(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -134,6 +137,15 @@ def pack_lanes_major(blocks, n_blocks):
     )
     nb = n_blocks.astype(np.uint32).reshape(tiles, 1, SUB, LANES)
     return lanes, nb
+
+
+def sha256_lanes_kernel(blocks, n_blocks, *, interpret: bool = False):
+    """Lanes-major entry: blocks [tiles, L, 16, 8, 128] and n_blocks
+    [tiles, 1, 8, 128] as produced by ``pack_messages(layout="lanes")`` (or
+    ``pack_lanes_major``) -> [tiles, 8, 8, 128] digest words.  No relayout
+    on either side — the packer writes the kernel's native layout."""
+    tiles, bucket = blocks.shape[0], blocks.shape[1]
+    return _compiled(tiles, bucket, interpret)(blocks, n_blocks)
 
 
 def sha256_lanes_from_batch_major(

@@ -107,6 +107,7 @@ class DeviceHashPlane:
         max_block_bucket: int = 64,
         kernel: str = "scan",
         defer_unready: bool = False,
+        mesh_devices: int = 0,
     ):
         self.device = device
         self.wave_size = wave_size
@@ -128,10 +129,20 @@ class DeviceHashPlane:
         if device:
             from ..ops.sha256 import TpuHasher
 
+            # mesh_devices > 0: hash waves shard their batch dimension over
+            # a device mesh exactly like verify waves (digests are
+            # bit-identical to single-device; mesh_hash_dispatches counts
+            # the traffic).
+            mesh = None
+            if mesh_devices:
+                from ..parallel.mesh import make_mesh
+
+                mesh = make_mesh(mesh_devices)
             self._hasher = TpuHasher(
                 min_device_batch=1,
                 max_block_bucket=max_block_bucket,
                 kernel=kernel,
+                mesh=mesh,
             )
 
     # -- scheduler-side -----------------------------------------------------
@@ -187,10 +198,24 @@ class DeviceHashPlane:
                 entries = all_entries[start : start + self.wave_size]
                 tracer = tracing.default_tracer
                 dispatch_ts = tracer.now() if tracer.enabled else 0.0
-                handle = self._hasher.dispatch(
+                # Pipelined phases: ``pack`` is pure host CPU work (metered
+                # as host crypto + hash_pack_seconds by the hasher);
+                # ``dispatch_packed`` enqueues without blocking, so while
+                # the device executes chunk k the host is already packing
+                # chunk k+1 of this loop.
+                pack_start = time.perf_counter()
+                packed = self._hasher.pack(
                     [m for (_, _, m) in entries],
                     block_bucket=bucket,
                     batch_bucket=batch_bucket,
+                )
+                metrics.counter("host_crypto_seconds").inc(
+                    time.perf_counter() - pack_start
+                )
+                dispatch_start = time.perf_counter()
+                handle = self._hasher.dispatch_packed(packed)
+                metrics.counter("device_dispatch_seconds").inc(
+                    time.perf_counter() - dispatch_start
                 )
                 self._inflight.append(
                     (

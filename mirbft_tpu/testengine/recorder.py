@@ -321,10 +321,11 @@ class CryptoConfig:
     auth_floor: int = 16
     lookahead: int = 128
     kernel: str = "scan"  # sha256 backend: "scan" | "pallas"
-    # > 0: build a jax.sharding.Mesh over this many devices and route the
-    # auth plane's verify waves through the batch-sharded multi-chip
-    # kernel (parallel.sharded_ed25519_verify) — consensus traffic then
-    # transits the mesh.  Verdicts stay bit-identical to single-device.
+    # > 0: build a jax.sharding.Mesh over this many devices and route BOTH
+    # crypto planes' waves through the batch-sharded multi-chip kernels
+    # (parallel.sharded_ed25519_verify for verify waves, sharded_sha256 for
+    # hash waves) — consensus traffic then transits the mesh.  Digests and
+    # verdicts stay bit-identical to single-device.
     mesh_devices: int = 0
     # Re-schedule (in sim time) hash events whose device dispatch is still
     # in flight rather than blocking the host loop.  Step counts become
@@ -479,6 +480,7 @@ class Recorder:
                 device_floor=crypto.hash_floor,
                 kernel=crypto.kernel,
                 defer_unready=crypto.defer_unready,
+                mesh_devices=crypto.mesh_devices,
             )
         else:
             hash_plane = _SHARED_CPU_PLANE

@@ -37,6 +37,20 @@ _KIND_TUPLE = re.compile(
 )
 _KIND_ITEM = re.compile(r"\"([^\"]+)\"")
 
+# Dispatch-path phase instruments that MUST exist somewhere in the tree:
+# the pack/dispatch split is load-bearing for perf triage (docs/PERFORMANCE.md
+# "Dispatch-path anatomy"), so losing one of these in a refactor should fail
+# the lint even though the name regexes above only validate names that are
+# still present.
+REQUIRED_NAMES = (
+    "hash_pack_seconds",
+    "hash_device_dispatch_seconds",
+    "verify_pack_seconds",
+    "verify_device_dispatch_seconds",
+    "mesh_hash_dispatches",
+    "mesh_hashed_messages",
+)
+
 
 def repo_root() -> Path:
     return Path(__file__).resolve().parents[2]
@@ -92,6 +106,12 @@ def check(root: Path = None) -> List[str]:
     named = dict(collect_names(root))
     for kind, sites in kinds.items():
         named.setdefault(kind, []).extend(sites)
+    for required in REQUIRED_NAMES:
+        if required not in named:
+            violations.append(
+                f"required dispatch-path instrument {required!r} is no "
+                "longer emitted anywhere under mirbft_tpu/ or bench.py"
+            )
     for name, sites in sorted(named.items()):
         where = ", ".join(sites[:3])
         if not _SNAKE_CASE.match(name):

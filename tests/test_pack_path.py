@@ -1,0 +1,216 @@
+"""Vectorized dispatch-path tests: packer parity at padding boundaries,
+pooled-buffer aliasing under pipelined dispatch, Ed25519 bulk limb
+decomposition, and a fast perf smoke pinning the vectorized packer ahead
+of the legacy per-message loop (docs/PERFORMANCE.md §13)."""
+
+import hashlib
+import time
+
+import numpy as np
+import pytest
+
+from mirbft_tpu.ops import ed25519 as e
+from mirbft_tpu.ops.sha256 import (
+    TpuHasher,
+    digests_from_words,
+    pack_messages,
+    pad_message,
+    sha256_batch_kernel,
+)
+
+# Every SHA-256 padding boundary: empty, one byte, the 55/56 one-vs-two
+# block edge, the 63/64 block edge, the two-vs-three edge (119/120), and
+# off-by-one around larger block multiples.
+BOUNDARY_LENGTHS = [0, 1, 55, 56, 63, 64, 119, 120, 127, 128, 129, 191, 192, 193, 640]
+
+
+def _boundary_messages():
+    rng = np.random.default_rng(7)
+    return [
+        rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+        for n in BOUNDARY_LENGTHS
+    ]
+
+
+def test_pack_messages_matches_pad_message_at_boundaries():
+    """The bulk packer's blocks/n_blocks are bit-identical to the
+    per-message reference at every padding boundary (batch layout)."""
+    messages = _boundary_messages()
+    packed = pack_messages(messages)
+    blocks, n_blocks = packed
+    assert packed.count == len(messages)
+    for i, m in enumerate(messages):
+        ref = pad_message(m)
+        assert n_blocks[i] == ref.shape[0], f"len={len(m)}"
+        assert np.array_equal(blocks[i, : ref.shape[0]], ref), f"len={len(m)}"
+        assert not blocks[i, ref.shape[0] :].any(), f"len={len(m)} pad rows"
+    # Padding rows beyond the real batch are marked empty.
+    assert not np.asarray(n_blocks[len(messages) :]).any()
+
+
+def test_pack_messages_lanes_layout_matches_reference():
+    """The lanes-major packer output equals the reference lanes packing
+    (pack_lanes_major) built from per-message pad_message rows."""
+    from mirbft_tpu.ops.sha256_pallas_lanes import pack_lanes_major
+
+    messages = _boundary_messages() * 3
+    # Batch-major reference via the per-message loop, then the reference
+    # lanes relayout.
+    bucket = max(pad_message(m).shape[0] for m in messages)
+    bucket = 1 << (bucket - 1).bit_length()
+    ref = np.zeros((len(messages), bucket, 16), dtype=np.uint32)
+    ref_nb_rows = np.zeros(len(messages), dtype=np.uint32)
+    for i, m in enumerate(messages):
+        padded = pad_message(m)
+        ref[i, : padded.shape[0]] = padded
+        ref_nb_rows[i] = padded.shape[0]
+    ref_blocks, ref_nb = pack_lanes_major(ref, ref_nb_rows)
+    packed = pack_messages(messages, layout="lanes")
+    assert np.array_equal(packed.blocks, ref_blocks)
+    assert np.array_equal(packed.n_blocks, ref_nb)
+
+
+def test_boundary_digests_match_hashlib_scan_and_lanes():
+    """End-to-end digests at every boundary length equal hashlib through
+    both the scan kernel and the lanes packer+kernel (interpret mode)."""
+    messages = _boundary_messages()
+    packed = pack_messages(messages)
+    words = np.asarray(sha256_batch_kernel(packed.blocks, packed.n_blocks))
+    expected = [hashlib.sha256(m).digest() for m in messages]
+    assert digests_from_words(words[: len(messages)]) == expected
+
+    hasher = TpuHasher(min_device_batch=1, kernel="lanes")
+    handle = hasher.dispatch(messages)
+    assert hasher.collect(handle) == expected
+
+
+def test_digests_from_words_bulk_unpack():
+    rng = np.random.default_rng(3)
+    words = rng.integers(0, 2**32, size=(9, 8), dtype=np.uint64).astype(np.uint32)
+    expected = [
+        b"".join(int(w).to_bytes(4, "big") for w in row) for row in words
+    ]
+    assert digests_from_words(words) == expected
+
+
+def test_buffer_pool_no_aliasing_across_inflight_dispatches():
+    """Dispatch wave A, then wave B of the SAME shape, and only then
+    collect A: B's packing must not have recycled (and overwritten) A's
+    pooled buffer while A's kernel could still be reading it."""
+    hasher = TpuHasher(min_device_batch=1)
+    msgs_a = [b"wave-a-%d" % i for i in range(16)]
+    msgs_b = [b"wave-b-%d" % i for i in range(16)]
+    handle_a = hasher.dispatch(msgs_a)
+    handle_b = hasher.dispatch(msgs_b)  # same (batch, bucket) shape as A
+    assert hasher.collect(handle_a) == [
+        hashlib.sha256(m).digest() for m in msgs_a
+    ]
+    assert hasher.collect(handle_b) == [
+        hashlib.sha256(m).digest() for m in msgs_b
+    ]
+    # After both collects the pool really is reused: a third same-shape
+    # pack acquires a previously-released lease, and results stay right.
+    free = hasher._pool._free[("batch", 16, 1)]
+    assert len(free) >= 1
+    recycled = free[-1]
+    packed = hasher.pack(msgs_a)
+    assert packed.lease is recycled
+    assert hasher.collect(hasher.dispatch_packed(packed)) == [
+        hashlib.sha256(m).digest() for m in msgs_a
+    ]
+
+
+def test_hash_plane_pipelined_waves_no_aliasing():
+    """The pipelined DeviceHashPlane (packs chunk k+1 while chunk k runs)
+    serves hashlib-identical digests when one enqueue spans several
+    same-shape chunks — the buffer-pool lifecycle under real plane
+    traffic."""
+    from mirbft_tpu.testengine import DeviceHashPlane
+
+    plane = DeviceHashPlane(device=True, wave_size=64, device_floor=1)
+    batches = [(b"req-%d" % i, b"x" * (i % 48)) for i in range(64)]
+    out = plane.hash_batches(batches)
+    for parts, digest in zip(batches, out):
+        h = hashlib.sha256()
+        for p in parts:
+            h.update(p)
+        assert digest == h.digest()
+
+
+def test_limbs_from_le_bytes_matches_int_to_limbs():
+    rng = np.random.default_rng(11)
+    raw = rng.integers(0, 256, size=(64, 32), dtype=np.uint8)
+    raw[:, -1] &= 0x7F  # 255-bit values
+    got = e.limbs_from_le_bytes(raw)
+    for row_bytes, row_limbs in zip(raw, got):
+        value = int.from_bytes(bytes(row_bytes), "little")
+        assert np.array_equal(row_limbs, e.int_to_limbs(value))
+    # Shape/dtype guard rails.
+    with pytest.raises(ValueError):
+        e.limbs_from_le_bytes(raw[:, :31])
+    with pytest.raises(ValueError):
+        e.limbs_from_le_bytes(raw.astype(np.int32))
+
+
+def test_s_below_l_exact_at_group_order():
+    """The vectorized S < L screen is exact at the group order edges —
+    the malleability check RFC 8032 requires."""
+    values = [0, 1, e.L - 1, e.L, e.L + 1, 2**256 - 1]
+    s_le = np.stack(
+        [
+            np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)
+            for v in values
+        ]
+    )
+    got = e._s_below_l(s_le)
+    assert got.tolist() == [v < e.L for v in values]
+
+
+def test_vectorized_packer_beats_legacy_loop():
+    """Perf smoke (tier-1): the vectorized packer beats the legacy
+    per-message pad_message loop on a 1024-message wave by at least 2x
+    (measured ~7-13x; the generous margin keeps CI machines green)."""
+    rng = np.random.default_rng(5)
+    messages = [
+        rng.integers(0, 256, size=640, dtype=np.uint8).tobytes()
+        for _ in range(1024)
+    ]
+
+    def legacy():
+        bucket = (640 + 8) // 64 + 1
+        bucket = 1 << (bucket - 1).bit_length()
+        blocks = np.zeros((1024, bucket, 16), dtype=np.uint32)
+        n_blocks = np.zeros(1024, dtype=np.uint32)
+        for i, m in enumerate(messages):
+            padded = pad_message(m)
+            blocks[i, : padded.shape[0]] = padded
+            n_blocks[i] = padded.shape[0]
+        return blocks, n_blocks
+
+    hasher = TpuHasher()
+
+    def vectorized():
+        packed = hasher.pack(messages)
+        hasher._pool.release(packed.lease)
+        return packed.blocks, packed.n_blocks
+
+    # Parity first (also warms the pooled buffer), then best-of-N timing.
+    ref_blocks, ref_nb = legacy()
+    got_blocks, got_nb = vectorized()
+    assert np.array_equal(got_blocks, ref_blocks)
+    assert np.array_equal(got_nb, ref_nb)
+
+    def best_of(fn, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            start = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - start)
+        return best
+
+    legacy_s = best_of(legacy)
+    vectorized_s = best_of(vectorized)
+    assert vectorized_s * 2 < legacy_s, (
+        f"vectorized packer not 2x faster: {vectorized_s * 1e3:.2f} ms vs "
+        f"legacy {legacy_s * 1e3:.2f} ms"
+    )

@@ -150,3 +150,36 @@ def test_auth_plane_drives_mesh_in_consensus_run():
     assert snap_mesh.get("mesh_verified_signatures", 0) > 0
     for _, _, committed in state_mesh:
         assert committed.get(2, 0) == 0  # byzantine signer never commits
+
+
+def test_hash_plane_drives_mesh(mesh):
+    """DeviceHashPlane with mesh_devices routes its hash waves through the
+    batch-sharded mesh kernel (VERDICT r5 Missing #3): digests identical
+    to hashlib and to the single-device plane, and the mesh dispatch
+    counters prove the waves transited it."""
+    from mirbft_tpu import metrics
+    from mirbft_tpu.testengine import DeviceHashPlane
+
+    batches = [(b"mesh-req-%d" % i, b"y" * (i % 40)) for i in range(48)]
+    expected = []
+    for parts in batches:
+        h = hashlib.sha256()
+        for p in parts:
+            h.update(p)
+        expected.append(h.digest())
+
+    metrics.default_registry.reset()
+    single = DeviceHashPlane(device=True, wave_size=16, device_floor=1)
+    assert single.hash_batches(batches) == expected
+    assert metrics.snapshot().get("mesh_hash_dispatches", 0) == 0
+
+    metrics.default_registry.reset()
+    plane = DeviceHashPlane(
+        device=True, wave_size=16, device_floor=1, mesh_devices=8
+    )
+    assert plane.hash_batches(batches) == expected
+    snap = metrics.snapshot()
+    assert snap.get("mesh_hash_dispatches", 0) >= 1, (
+        "no hash wave transited the mesh"
+    )
+    assert snap.get("mesh_hashed_messages", 0) >= len(batches)
