@@ -71,6 +71,7 @@ FAULT_KINDS = (
     "ingress_reject",
     "invalid_digest",
     "suspicion_vote",
+    "peer_unreachable",
 )
 
 

@@ -1,0 +1,126 @@
+"""Length-prefixed frame codec for the socket transport.
+
+A frame is::
+
+    magic   2 bytes  b"MB"
+    version 1 byte   FRAME_VERSION
+    kind    1 byte   KIND_HANDSHAKE / KIND_MSG / KIND_CLIENT
+    length  4 bytes  big-endian payload length
+    crc32   4 bytes  big-endian CRC32 of the payload
+    payload ``length`` bytes (``wire.encode`` output for KIND_MSG)
+
+The payload codec stays ``mirbft_tpu.wire`` — this layer only delimits and
+integrity-checks byte streams.  :class:`FrameDecoder` is incremental: feed
+it whatever ``recv`` returned (a torn header, half a payload, three frames
+at once) and it yields every complete frame.  Any malformed input — wrong
+magic, unknown version/kind, oversized length, CRC mismatch — raises
+:class:`FrameError`; the caller's contract is to drop the *connection* (the
+peer re-syncs by reconnecting; there is no in-stream resynchronization),
+never the process.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import List, Tuple
+
+FRAME_MAGIC = b"MB"
+FRAME_VERSION = 1
+FRAME_HEADER_LEN = 12
+
+# Frame kinds.  KIND_HANDSHAKE must be the first frame on every connection
+# (tcp.py); KIND_MSG carries one wire-encoded protocol message; KIND_CLIENT
+# carries a client-submission envelope (tools/mirnet.py).
+KIND_HANDSHAKE = 0
+KIND_MSG = 1
+KIND_CLIENT = 2
+
+# Upper bound on a single payload.  Generous against the largest legitimate
+# protocol message (a MsgBatch of a full iteration's sends), tight against
+# a garbage length field committing us to buffer gigabytes.
+MAX_FRAME_PAYLOAD = 32 * 1024 * 1024
+
+_HEADER = struct.Struct(">2sBBII")
+
+
+class FrameError(ValueError):
+    """The byte stream is not a valid frame sequence; drop the connection."""
+
+
+def encode_frame(kind: int, payload: bytes) -> bytes:
+    if len(payload) > MAX_FRAME_PAYLOAD:
+        raise FrameError(
+            f"payload of {len(payload)} bytes exceeds frame cap "
+            f"{MAX_FRAME_PAYLOAD}"
+        )
+    return (
+        _HEADER.pack(
+            FRAME_MAGIC,
+            FRAME_VERSION,
+            kind,
+            len(payload),
+            zlib.crc32(payload) & 0xFFFFFFFF,
+        )
+        + payload
+    )
+
+
+class FrameDecoder:
+    """Incremental decoder over a byte stream of frames.
+
+    ``feed(data)`` returns every frame completed by ``data`` as a list of
+    ``(kind, payload)`` tuples and buffers any tail for the next call.
+    Raises :class:`FrameError` on malformed input; after an error the
+    decoder is poisoned (the stream has no resync point) and every further
+    ``feed`` re-raises.
+    """
+
+    __slots__ = ("_buf", "_max_payload", "_error")
+
+    def __init__(self, max_payload: int = MAX_FRAME_PAYLOAD):
+        self._buf = bytearray()
+        self._max_payload = max_payload
+        self._error: FrameError = None
+
+    def feed(self, data: bytes) -> List[Tuple[int, bytes]]:
+        if self._error is not None:
+            raise self._error
+        self._buf.extend(data)
+        frames: List[Tuple[int, bytes]] = []
+        try:
+            pos = 0
+            buf = self._buf
+            while len(buf) - pos >= FRAME_HEADER_LEN:
+                magic, version, kind, length, crc = _HEADER.unpack_from(
+                    buf, pos
+                )
+                if magic != FRAME_MAGIC:
+                    raise FrameError(f"bad frame magic {bytes(magic)!r}")
+                if version != FRAME_VERSION:
+                    raise FrameError(f"unsupported frame version {version}")
+                if kind not in (KIND_HANDSHAKE, KIND_MSG, KIND_CLIENT):
+                    raise FrameError(f"unknown frame kind {kind}")
+                if length > self._max_payload:
+                    raise FrameError(
+                        f"frame length {length} exceeds cap {self._max_payload}"
+                    )
+                if len(buf) - pos - FRAME_HEADER_LEN < length:
+                    break  # torn tail: wait for more bytes
+                start = pos + FRAME_HEADER_LEN
+                payload = bytes(buf[start : start + length])
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    raise FrameError("frame CRC mismatch")
+                frames.append((kind, payload))
+                pos = start + length
+            if pos:
+                del buf[:pos]
+        except FrameError as exc:
+            self._error = exc
+            raise
+        return frames
+
+    @property
+    def pending_bytes(self) -> int:
+        """Bytes buffered awaiting a complete frame (diagnostics only)."""
+        return len(self._buf)

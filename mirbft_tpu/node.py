@@ -156,7 +156,7 @@ class Node:
         self.clients = proc.Clients(
             processor_config.hasher, processor_config.request_store
         )
-        self.replicas = proc.Replicas()
+        self.replicas = proc.Replicas(on_forward=self._ingest_forward)
         self.notifier = _WorkErrNotifier()
         # Coordinator inbox: tagged results/ingress/control messages.
         self.inbox: "queue.Queue" = queue.Queue()
@@ -220,6 +220,26 @@ class Node:
         if events:
             self.inbox.put(("step_events", events))
 
+    def _ingest_forward(self, source: int, msg) -> None:
+        """Inbound ForwardRequest (a peer answering our FetchRequest),
+        intercepted at replica ingress.  Verified + stored via the client
+        store; the RequestPersisted events take the client_results inbox
+        path so they cross the request-store durability barrier before the
+        state machine sees them — the same ordering ``propose`` gets."""
+        events = self.clients.ingest_forwarded(msg)
+        if events is None:
+            # Body does not hash to the claimed digest: peer-controlled
+            # garbage, attributed to the sender.
+            self.health_monitor.record_fault(
+                source,
+                "invalid_digest",
+                client_id=msg.request_ack.client_id,
+                req_no=msg.request_ack.req_no,
+            )
+            return
+        if events:
+            self.inbox.put(("client_results", events))
+
     def client(self, client_id: int) -> Client:
         return Client(
             self.clients.client(client_id),
@@ -280,7 +300,7 @@ class Node:
         return {
             "wal": lambda actions: proc.process_wal_actions(pc.wal, actions),
             "net": lambda actions: proc.process_net_actions(
-                self.id, pc.link, actions
+                self.id, pc.link, actions, request_store=pc.request_store
             ),
             "hash": lambda actions: proc.process_hash_actions(pc.hasher, actions),
             "client": lambda actions: self.clients.process_client_actions(actions),

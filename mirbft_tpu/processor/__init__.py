@@ -24,7 +24,7 @@ from .serial import (
 )
 from .work import WorkItems
 from .clients import Client, Clients
-from .replicas import Replicas
+from .replicas import Replicas, split_forward_requests
 
 __all__ = [
     "App",
@@ -45,4 +45,5 @@ __all__ = [
     "process_state_machine_events",
     "process_wal_actions",
     "recover_wal_for_existing_node",
+    "split_forward_requests",
 ]

@@ -143,6 +143,34 @@ class Client:
                 return Events().request_persisted(ack)
             return Events()
 
+    def store_forwarded(self, ack: RequestAck, data: bytes) -> Events:
+        """Persist a peer-forwarded request body (the answer to our
+        FetchRequest).  Only digests the state machine marked correct
+        (ActionCorrectRequest — an f+1-backed quorum observation) are
+        accepted, so an unsolicited forward with self-consistent garbage
+        cannot plant data; anything else is silently dropped and the fetch
+        retry loop re-asks.  Caller must have verified
+        ``hash(data) == ack.digest``."""
+        with self._lock:
+            cr = self.requests.get(ack.req_no)
+            if cr is None:
+                return Events()  # never allocated here, or already GC'd
+            if ack.digest not in cr.remote_correct_digests:
+                return Events()  # not a known-correct digest: refuse
+            if cr.local_allocation_digest == ack.digest:
+                return Events()  # already stored (duplicate forward)
+            self.request_store.put_request(ack, data)
+            if cr.local_allocation_digest is None:
+                # First body for this req_no: record the allocation so a
+                # restart replays it.  A conflicting local digest (byzantine
+                # client equivocation) keeps its allocation — the store
+                # holds both bodies, keyed by full ack.
+                self.request_store.put_allocation(
+                    self.client_id, ack.req_no, ack.digest
+                )
+                cr.local_allocation_digest = ack.digest
+            return Events().request_persisted(ack)
+
 
 class Clients:
     """Reference clients.go:23-45."""
@@ -162,6 +190,20 @@ class Clients:
                 c = Client(client_id, self.hasher, self.request_store)
                 self._clients[client_id] = c
             return c
+
+    def ingest_forwarded(self, msg) -> Optional[Events]:
+        """Verify and store an inbound ForwardRequest.  Returns None when
+        the body does not hash to the claimed digest (peer-controlled
+        input: the caller attributes an ``invalid_digest`` fault to the
+        sender); otherwise the RequestPersisted events to route through
+        the request-store durability barrier (possibly empty)."""
+        ack = msg.request_ack
+        (digest,) = self.hasher.hash_batches([[msg.request_data]])
+        if digest != ack.digest:
+            return None
+        return self.client(ack.client_id).store_forwarded(
+            ack, msg.request_data
+        )
 
     def process_client_actions(self, actions: Actions) -> Events:
         """Reference clients.go:46-83.  AllocatedRequest dominates (a whole

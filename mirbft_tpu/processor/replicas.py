@@ -112,48 +112,71 @@ def pre_process(msg: Msg) -> None:
             )
 
 
-class Replica:
-    """Reference replicas.go:34-56."""
-
-    __slots__ = ("id",)
-
-    def __init__(self, replica_id: int):
-        self.id = replica_id
-
-    def step(self, msg: Msg) -> Events:
-        pre_process(msg)
-        if isinstance(msg, ForwardRequest):
-            # Buffered outside the state machine (unimplemented, mirroring
-            # the reference).
-            return Events()
-        if isinstance(msg, MsgBatch):
-            # The interception above must also apply inside envelopes — the
-            # state machine's client message path does not accept
-            # ForwardRequest, so letting one through would crash on
-            # peer-controlled input.
+def split_forward_requests(msg: Msg):
+    """Separate ForwardRequests from a message (unwrapping one MsgBatch
+    envelope level): returns ``(remainder_or_None, [forward_requests])``.
+    The state machine's client message path does not accept ForwardRequest,
+    so every ingress (threaded runtime and testengine alike) must intercept
+    them — including inside envelopes — before stepping."""
+    if isinstance(msg, ForwardRequest):
+        return None, (msg,)
+    if isinstance(msg, MsgBatch):
+        forwards = tuple(
+            inner for inner in msg.msgs if isinstance(inner, ForwardRequest)
+        )
+        if forwards:
             kept = tuple(
                 inner
                 for inner in msg.msgs
                 if not isinstance(inner, ForwardRequest)
             )
             if not kept:
-                return Events()
-            if len(kept) != len(msg.msgs):
-                msg = kept[0] if len(kept) == 1 else MsgBatch(msgs=kept)
+                return None, forwards
+            return (
+                kept[0] if len(kept) == 1 else MsgBatch(msgs=kept)
+            ), forwards
+    return msg, ()
+
+
+class Replica:
+    """Reference replicas.go:34-56.
+
+    ``on_forward(source, forward_request)`` handles intercepted
+    ForwardRequests (reference replicas.go:45-52 keeps their handling
+    deliberately external so embedders can attach validation; here the node
+    runtime wires it to ``Clients.ingest_forwarded`` and routes the result
+    through the request-store durability barrier).  Without a handler,
+    forwards are dropped at ingress as before."""
+
+    __slots__ = ("id", "on_forward")
+
+    def __init__(self, replica_id: int, on_forward=None):
+        self.id = replica_id
+        self.on_forward = on_forward
+
+    def step(self, msg: Msg) -> Events:
+        pre_process(msg)
+        msg, forwards = split_forward_requests(msg)
+        if forwards and self.on_forward is not None:
+            for forward in forwards:
+                self.on_forward(self.id, forward)
+        if msg is None:
+            return Events()
         return Events().step(self.id, msg)
 
 
 class Replicas:
     """Reference replicas.go:14-32."""
 
-    __slots__ = ("_replicas",)
+    __slots__ = ("_replicas", "_on_forward")
 
-    def __init__(self):
+    def __init__(self, on_forward=None):
         self._replicas: Dict[int, Replica] = {}
+        self._on_forward = on_forward
 
     def replica(self, replica_id: int) -> Replica:
         r = self._replicas.get(replica_id)
         if r is None:
-            r = Replica(replica_id)
+            r = Replica(replica_id, self._on_forward)
             self._replicas[replica_id] = r
         return r

@@ -145,10 +145,46 @@ def _coalesce_sends(actions: Actions) -> List[st.ActionSend]:
     return [a for a in out if a is not None]
 
 
-def process_net_actions(self_id: int, link: Link, actions: Actions) -> Events:
+def _resolve_forwards(
+    self_id: int, request_store: Optional[RequestStore], actions: Actions
+) -> Actions:
+    """Convert ActionForwardRequest into ActionSend(ForwardRequest) by
+    resolving the ack against the request store.  Drops silently when the
+    store lacks the body (GC'd since the action was emitted) or no store
+    was provided — the requester's FetchRequest retry loop
+    (disseminator.ClientRequest.fetch) re-asks another replica, so a
+    dropped forward costs latency, never liveness."""
+    if not any(isinstance(a, st.ActionForwardRequest) for a in actions):
+        return actions
+    resolved = Actions()
+    for action in actions:
+        if not isinstance(action, st.ActionForwardRequest):
+            resolved.push_back(action)
+            continue
+        if request_store is None:
+            continue
+        data = request_store.get_request(action.ack)
+        if data is None:
+            continue
+        msg = m.ForwardRequest(request_ack=action.ack, request_data=data)
+        targets = tuple(t for t in action.targets if t != self_id)
+        if targets:
+            resolved.push_back(st.ActionSend(targets=targets, msg=msg))
+    return resolved
+
+
+def process_net_actions(
+    self_id: int,
+    link: Link,
+    actions: Actions,
+    request_store: Optional[RequestStore] = None,
+) -> Events:
     """Sends to self become local Step events (reference serial.go:158-178).
-    Sends are coalesced per target set first (see _coalesce_sends)."""
+    ForwardRequest actions resolve against the request store (see
+    _resolve_forwards), then sends are coalesced per target set
+    (see _coalesce_sends)."""
     events = Events()
+    actions = _resolve_forwards(self_id, request_store, actions)
     for action in _coalesce_sends(actions):
         for replica in action.targets:
             if replica == self_id:

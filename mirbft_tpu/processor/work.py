@@ -17,7 +17,14 @@ _WAL_INDEPENDENT_SENDS = (AckMsg, AckBatch, CheckpointMsg, FetchBatch, ForwardBa
 
 
 class WorkItems:
-    """Reference work.go:15-136."""
+    """Reference work.go:15-136.
+
+    ``forwarding`` routes ActionForwardRequest into the net category
+    (where ``process_net_actions`` resolves it to a ForwardRequest send
+    from the request store).  The testengine's differential mode passes
+    False to mirror the native fast engine, which still drops forwards at
+    this point (fastengine.cpp, reference work.go:176) — routing them
+    would change the simulated schedule and break bit-identity."""
 
     __slots__ = (
         "wal_actions",
@@ -27,9 +34,10 @@ class WorkItems:
         "app_actions",
         "req_store_events",
         "result_events",
+        "forwarding",
     )
 
-    def __init__(self):
+    def __init__(self, forwarding: bool = True):
         self.wal_actions = Actions()
         self.net_actions = Actions()
         self.hash_actions = Actions()
@@ -37,6 +45,7 @@ class WorkItems:
         self.app_actions = Actions()
         self.req_store_events = Events()
         self.result_events = Events()
+        self.forwarding = forwarding
 
     # --- result ingestion ---
 
@@ -85,18 +94,19 @@ class WorkItems:
             ):
                 self.client_actions.push_back(action)
             elif isinstance(action, st.ActionForwardRequest):
-                # The reference drops these at the same point (work.go:176,
-                # "XXX address"): request forwarding is unimplemented at
-                # BOTH ends.  This drop swallows the leader's forwards
-                # (sequence.py) AND the disseminator's replies to
-                # FetchRequest, so the pull path never answers; a receiver
-                # would discard an inbound ForwardRequest at ingress anyway
-                # (processor/replicas.py Replica.step).  Replication
-                # actually relies on clients broadcasting to all nodes plus
-                # ack-triggered state transfer (see
-                # test_client_ignores_node_forces_state_transfer); closing
-                # the forwarding gap is an open ROADMAP item.
-                pass
+                # Forwarding closes the pull path the reference leaves open
+                # (work.go:176 "XXX address" drops these): the action is
+                # WAL-independent — the referenced body is already durable
+                # in the request store, and the reply carries no protocol
+                # state of ours — so it rides the net category directly,
+                # where process_net_actions resolves the ack to the stored
+                # body and sends a ForwardRequest.  Ingress accepts it at
+                # processor/replicas.py (digest-verified, routed through the
+                # request-store durability barrier).  With forwarding off
+                # (native-engine differential mode) the action is dropped
+                # here, exactly as fastengine.cpp still does.
+                if self.forwarding:
+                    self.net_actions.push_back(action)
             elif isinstance(action, st.ActionStateTransfer):
                 self.app_actions.push_back(action)
             else:

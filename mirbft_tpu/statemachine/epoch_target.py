@@ -97,6 +97,8 @@ class EpochTarget:
         "logger",
         "_ec_digests",
         "_ec_keys",
+        "_ne_construct_key",
+        "_ne_verify_key",
     )
 
     def __init__(
@@ -164,6 +166,20 @@ class EpochTarget:
         # an identity side-table skips re-flattening per ack (values pin the
         # msg so ids stay stable); replay simply misses here and re-flattens.
         self._ec_keys: Dict[int, tuple] = {}
+        # NewEpoch-construction/validation memos.  construct_new_epoch_config
+        # is the expensive derivation of the view change (~1.3M cycles/msg at
+        # 128 nodes per the profiler), and both its call sites re-run with
+        # unchanged inputs on almost every event while the epoch change is
+        # in flight: check_epoch_quorum per advance_state pass once quorum
+        # is reached, verify_new_epoch_state per rebroadcast NewEpoch (the
+        # primary re-sends every 2 ticks).  Each memo records the input
+        # fingerprint of the last attempt that did NOT advance (None config
+        # / failed validation) and skips re-derivation until the cert set —
+        # monotone: entries are added once and never replaced — or the
+        # leader's message changes.  Pure functions of the event stream,
+        # like _ec_digests, so replay is unaffected.
+        self._ne_construct_key: Optional[tuple] = None
+        self._ne_verify_key: Optional[tuple] = None
 
     # --- three-phase traffic routing (reference :120-131) ---
 
@@ -201,19 +217,56 @@ class EpochTarget:
 
     def verify_new_epoch_state(self) -> None:
         """Validate the primary's NewEpoch against locally-acked epoch
-        changes and the deterministic reconstruction (reference :173-225)."""
+        changes and the deterministic reconstruction (reference :173-225).
+
+        Memoized: validation is a pure function of (the leader's NewEpoch,
+        which referenced certs are locally acked past the weak quorum), so
+        a failed attempt is only retried when one of those inputs changes —
+        not per rebroadcast/advance_state pass (see _ne_verify_key)."""
+        key = (self.leader_new_epoch, self._verify_fingerprint())
+        if key == self._ne_verify_key:
+            return  # identical inputs already failed validation
+        if self._validate_leader_new_epoch():
+            self._ne_verify_key = None
+            self.state = EpochTargetState.FETCHING
+        else:
+            self._ne_verify_key = key
+
+    def _verify_fingerprint(self) -> tuple:
+        """The cert-set inputs validation depends on, per referenced cert:
+        is a parse for (node, digest) locally known and weakly acked?  The
+        parsed *content* for a digest is fixed (it hashes to the digest),
+        so the boolean crossing is the only thing that can change."""
+        quorum = some_correct_quorum(self.network_config)
+        fingerprint = []
+        for remote in self.leader_new_epoch.epoch_changes:
+            votes = self.changes.get(remote.node_id)
+            parsed = (
+                None if votes is None
+                else votes.parsed_by_digest.get(remote.digest)
+            )
+            fingerprint.append(
+                (
+                    remote.node_id,
+                    remote.digest,
+                    parsed is not None and len(parsed.acks) >= quorum,
+                )
+            )
+        return tuple(fingerprint)
+
+    def _validate_leader_new_epoch(self) -> bool:
         epoch_changes: Dict[int, ParsedEpochChange] = {}
         for remote in self.leader_new_epoch.epoch_changes:
             if remote.node_id in epoch_changes:
-                return  # duplicate reference: malformed
+                return False  # duplicate reference: malformed
             votes = self.changes.get(remote.node_id)
             if votes is None:
-                return  # primary lying, or we lack information
+                return False  # primary lying, or we lack information
             parsed = votes.parsed_by_digest.get(remote.digest)
             if parsed is None or len(parsed.acks) < some_correct_quorum(
                 self.network_config
             ):
-                return
+                return False
             epoch_changes[remote.node_id] = parsed
 
         reconstructed = construct_new_epoch_config(
@@ -221,10 +274,7 @@ class EpochTarget:
             self.leader_new_epoch.new_config.config.leaders,
             epoch_changes,
         )
-        if reconstructed != self.leader_new_epoch.new_config:
-            return  # byzantine primary
-
-        self.state = EpochTargetState.FETCHING
+        return reconstructed == self.leader_new_epoch.new_config
 
     def fetch_new_epoch_state(self) -> Actions:
         """Retrieve batches/requests the new epoch references that we lack
@@ -516,16 +566,26 @@ class EpochTarget:
         return Actions()
 
     def check_epoch_quorum(self) -> Actions:
-        """Reference :564-593."""
+        """Reference :564-593.
+
+        Memoized on (leader choice, strong-cert set): entries are added to
+        ``strong_changes`` at most once per node (``:561``) and never
+        replaced, so the sorted key tuple fingerprints the whole input of
+        ``construct_new_epoch`` — a failed construction is not re-derived
+        until another strong cert lands (see _ne_construct_key)."""
         if (
             len(self.strong_changes) < intersection_quorum(self.network_config)
             or self.my_epoch_change is None
         ):
             return Actions()
+        key = (self.my_leader_choice, tuple(sorted(self.strong_changes)))
+        if key == self._ne_construct_key:
+            return Actions()
         self.my_new_epoch = self.construct_new_epoch(
             self.my_leader_choice, self.network_config
         )
         if self.my_new_epoch is None:
+            self._ne_construct_key = key
             return Actions()
         self.state_ticks = 0
         self.state = EpochTargetState.PENDING

@@ -207,6 +207,12 @@ class FastRecording:
         if device_authoritative or streaming_auth:
             _require(device, "device modes require device=True")
         recorder = spec.recorder()
+        # The native engine drops ActionForwardRequest (reference
+        # work.go:176); a forwarding-enabled recorder cannot be twinned.
+        _require(
+            not getattr(recorder, "forwarding", False),
+            "request forwarding enabled",
+        )
 
         mangler_desc = None
         if recorder.mangler is not None:

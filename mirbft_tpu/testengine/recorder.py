@@ -401,6 +401,7 @@ class SimNode:
         authenticator=None,
         hasher=None,
         logger=None,
+        forwarding: bool = False,
     ):
         self.id = node_id
         self.config = config
@@ -412,6 +413,11 @@ class SimNode:
         self.authenticator = authenticator
         self.hasher = hasher if hasher is not None else _SHARED_CPU_PLANE
         self.logger = logger
+        # Request forwarding is off by default in the sim: the native fast
+        # engine still drops ActionForwardRequest (fastengine.cpp), so the
+        # differential suite would diverge on fetch-path scenarios.  Tests
+        # of the forwarding round trip opt in via Recorder.forwarding.
+        self.forwarding = forwarding
         self.work_items: Optional[proc.WorkItems] = None
         self.clients: Optional[proc.Clients] = None
         self.state_machine: Optional[StateMachine] = None
@@ -419,7 +425,7 @@ class SimNode:
 
     def initialize(self, init_parms: EventInitialParameters) -> None:
         """(Re)boot the node from its WAL (reference recorder.go:222-244)."""
-        self.work_items = proc.WorkItems()
+        self.work_items = proc.WorkItems(forwarding=self.forwarding)
         self.clients = proc.Clients(self.hasher, self.req_store)
         self.state_machine = StateMachine(self.logger)
         self.pending = {}
@@ -451,6 +457,10 @@ class Recorder:
         self.event_log_writer = event_log_writer
         self.crypto = crypto or CryptoConfig()
         self.logger = logger
+        # Enable the request-forwarding round trip (work.py routing +
+        # ingress ingestion).  Default False: bit-identical to the native
+        # fast engine, which still drops forwards (see SimNode).
+        self.forwarding = False
         # Optional sim-domain Tracer (set before recording(), like
         # event_log_writer): its clock is bound to the event queue's virtual
         # fake_time and per-node commit-span trackers feed it during step().
@@ -547,6 +557,7 @@ class Recorder:
                     auth_plane,
                     hash_plane,
                     node_logger,
+                    forwarding=self.forwarding,
                 )
             )
             event_queue.insert_initialize(
@@ -687,7 +698,27 @@ class Recording:
         elif event.msg_received is not None:
             if node.state_machine is not None:
                 source, msg = event.msg_received
-                node.work_items.result_events.step(source, msg)
+                # ForwardRequests never enter the state machine: intercept
+                # (including inside MsgBatch envelopes) and ingest through
+                # the client store, with the resulting RequestPersisted
+                # events crossing the request-store durability barrier —
+                # the sim mirror of Node._ingest_forward.
+                msg, forwards = proc.split_forward_requests(msg)
+                for forward in forwards:
+                    events = node.clients.ingest_forwarded(forward)
+                    if events is None:
+                        monitor = self.health_monitors.get(node.id)
+                        if monitor is not None:
+                            monitor.record_fault(
+                                source,
+                                "invalid_digest",
+                                client_id=forward.request_ack.client_id,
+                                req_no=forward.request_ack.req_no,
+                            )
+                    elif events:
+                        node.work_items.add_client_results(events)
+                if msg is not None:
+                    node.work_items.result_events.step(source, msg)
         elif event.client_proposal is not None:
             # One event proposes a PIPELINE of up to _PROPOSAL_CHUNK requests
             # from this client to this node (real clients stream requests;
@@ -810,7 +841,10 @@ class Recording:
         elif event.process_net_actions is not None:
             node.work_items.add_net_results(
                 proc.process_net_actions(
-                    node.id, node.link, event.process_net_actions
+                    node.id,
+                    node.link,
+                    event.process_net_actions,
+                    request_store=node.req_store,
                 )
             )
             node.pending["net"] = False

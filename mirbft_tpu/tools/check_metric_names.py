@@ -49,6 +49,16 @@ REQUIRED_NAMES = (
     "verify_device_dispatch_seconds",
     "mesh_hash_dispatches",
     "mesh_hashed_messages",
+    # Socket transport plane (net/tcp.py): the reconnect counter is how
+    # deployments observe outages (docs/TRANSPORT.md), and the byte
+    # counters are the only wire-level throughput signal — losing any of
+    # these in a refactor must fail the lint.
+    "net_tx_bytes_total",
+    "net_rx_bytes_total",
+    "net_tx_dropped_total",
+    "net_reconnects_total",
+    "net_peer_queue_depth",
+    "net_peer_up",
 )
 
 

@@ -1,0 +1,550 @@
+"""mirnet: multi-process deployment harness over real localhost TCP.
+
+One module, two roles:
+
+* **Parent (default)** — reserves N ports, writes ``cluster.json``, spawns
+  one OS process per node (``python -m mirbft_tpu.tools.mirnet --node i``),
+  submits client requests through a real socket client handle
+  (:class:`SocketClient`, KIND_CLIENT frames), waits until a quorum of
+  nodes has committed every request, then diffs the per-node commit logs
+  for **bit-identical agreement** — same sequence numbers, same batch
+  digests, byte for byte.  ``--kill-restart`` additionally SIGKILLs one
+  node mid-run, verifies the survivors' ``net_reconnects_total`` moved
+  (reconnect/backoff observed through Prometheus text, not logs), restarts
+  the node from its durable WAL, and requires the cluster to keep
+  committing.
+* **Child (``--node i``)** — runs a full :class:`~mirbft_tpu.node.Node`
+  over :class:`~mirbft_tpu.net.tcp.TcpTransport` with durable WAL +
+  request store under ``<dir>/node-<i>/``, appends every applied batch to
+  ``commits.log``, snapshots ``metrics.prom`` twice a second, and exits
+  cleanly on SIGTERM.
+
+The harness is also importable: tests and ``bench.py`` call
+:func:`run_deployment` directly (see tests/test_mirnet.py and the
+``net_loopback_4n_commit_s`` bench key).
+
+Usage::
+
+    python -m mirbft_tpu.tools.mirnet --nodes 4 --reqs 20 --kill-restart
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+# Client-frame payloads: 8-byte big-endian req_no + opaque request body.
+# Replies are a 1-byte status.
+_CLIENT_REQ = struct.Struct(">Q")
+CLIENT_OK = b"\x01"
+CLIENT_BUSY = b"\x00"
+
+_METRICS_SNAPSHOT_S = 0.5
+_PROPOSE_RETRY_S = 10.0
+
+
+def _cluster_path(root: Path) -> Path:
+    return root / "cluster.json"
+
+
+def _node_dir(root: Path, node_id: int) -> Path:
+    return root / f"node-{node_id}"
+
+
+def _reserve_ports(count: int) -> List[int]:
+    """Bind ``count`` ephemeral ports, record them, release them all at
+    once right before the children start.  The tiny reuse race is
+    acceptable on localhost (SO_REUSEADDR on both sides)."""
+    socks, ports = [], []
+    for _ in range(count):
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(("127.0.0.1", 0))
+        socks.append(sock)
+        ports.append(sock.getsockname()[1])
+    for sock in socks:
+        sock.close()
+    return ports
+
+
+# --------------------------------------------------------------------------
+# Child role: one real node process
+# --------------------------------------------------------------------------
+
+
+class _CommitLogApp:
+    """App that journals every applied batch to ``commits.log`` — one line
+    per QEntry: ``<seq_no> <digest-hex> <client:req,...>``.  The file is
+    the ground truth the parent diffs across nodes."""
+
+    def __init__(self, log_path: Path):
+        self._file = open(log_path, "a", buffering=1)
+        self._lock = threading.Lock()
+        self.last_checkpoint = (0, b"")
+        self.state_transfers: List[int] = []
+
+    def apply(self, entry) -> None:
+        reqs = ",".join(f"{r.client_id}:{r.req_no}" for r in entry.requests)
+        with self._lock:
+            self._file.write(f"{entry.seq_no} {entry.digest.hex()} {reqs}\n")
+
+    def snap(self, network_config, client_states):
+        import hashlib
+
+        from mirbft_tpu import wire
+        from mirbft_tpu.messages import NetworkState
+
+        state = NetworkState(
+            config=network_config,
+            clients=tuple(client_states),
+            pending_reconfigurations=(),
+        )
+        encoded = wire.encode(state)
+        return hashlib.sha256(encoded).digest() + encoded, ()
+
+    def transfer_to(self, seq_no, snap):
+        from mirbft_tpu import wire
+
+        with self._lock:
+            self.state_transfers.append(seq_no)
+        return wire.decode(snap[32:])
+
+    def close(self) -> None:
+        with self._lock:
+            self._file.close()
+
+
+def run_node(root: Path, node_id: int) -> int:
+    """Child entry point: node ``node_id`` of the cluster described by
+    ``<root>/cluster.json``, serving protocol traffic and client frames
+    until SIGTERM."""
+    from mirbft_tpu.config import Config, standard_initial_network_state
+    from mirbft_tpu.net.tcp import TcpTransport, config_fingerprint
+    from mirbft_tpu.node import Node, ProcessorConfig
+    from mirbft_tpu.ops import CpuHasher
+    from mirbft_tpu.reqstore import Store
+    from mirbft_tpu.simplewal import WAL
+
+    cluster = json.loads(_cluster_path(root).read_text())
+    node_count = cluster["node_count"]
+    client_ids = cluster["client_ids"]
+    ports: Dict[int, int] = {int(k): v for k, v in cluster["ports"].items()}
+    network_state = standard_initial_network_state(node_count, *client_ids)
+
+    ndir = _node_dir(root, node_id)
+    ndir.mkdir(parents=True, exist_ok=True)
+    marker = ndir / "initialized"
+    restarting = marker.exists()
+
+    transport = TcpTransport(
+        node_id,
+        peers={pid: ("127.0.0.1", port) for pid, port in ports.items()},
+        listen_port=ports[node_id],
+        fingerprint=config_fingerprint(network_state),
+    )
+    app = _CommitLogApp(ndir / "commits.log")
+    node = Node(
+        node_id,
+        Config(id=node_id, batch_size=1),
+        ProcessorConfig(
+            link=transport,
+            hasher=CpuHasher(),
+            app=app,
+            wal=WAL(str(ndir / "wal")),
+            request_store=Store(str(ndir / "reqs.db")),
+        ),
+    )
+    transport.health_monitor = node.health_monitor
+
+    def on_message(source: int, msg) -> None:
+        try:
+            node.step(source, msg)
+        except Exception:
+            pass  # node stopping; the reader connection just drops
+
+    def on_client(payload: bytes, reply) -> None:
+        (req_no,) = _CLIENT_REQ.unpack_from(payload)
+        data = payload[_CLIENT_REQ.size :]
+        deadline = time.monotonic() + _PROPOSE_RETRY_S
+        while time.monotonic() < deadline:
+            try:
+                node.client(client_ids[0]).propose(req_no, data)
+                reply(CLIENT_OK)
+                return
+            except KeyError:
+                time.sleep(0.02)  # client window not allocated yet
+        reply(CLIENT_BUSY)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    transport.start(on_message, on_client=on_client)
+    if restarting:
+        node.restart_processing(tick_interval=0.02)
+    else:
+        node.process_as_new_node(network_state, b"initial", tick_interval=0.02)
+        marker.write_text("1")
+
+    metrics_path = ndir / "metrics.prom"
+    while not stop.is_set():
+        # Atomic snapshot: readers (the parent) never see a torn file.
+        tmp = metrics_path.with_suffix(".prom.tmp")
+        tmp.write_text(node.metrics_text())
+        tmp.replace(metrics_path)
+        err = node.notifier.err()
+        if err is not None:
+            print(f"node {node_id} failed: {err!r}", file=sys.stderr)
+            break
+        stop.wait(_METRICS_SNAPSHOT_S)
+
+    node.stop()
+    transport.stop()
+    app.close()
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Parent role: deployment harness
+# --------------------------------------------------------------------------
+
+
+class SocketClient:
+    """Real-socket client handle: submits requests as KIND_CLIENT frames
+    and waits for the node's acknowledgement on the same connection."""
+
+    def __init__(self, addr: Tuple[str, int], timeout_s: float = 15.0):
+        from mirbft_tpu.net.framing import FrameDecoder
+
+        self._sock = socket.create_connection(addr, timeout=timeout_s)
+        self._decoder = FrameDecoder()
+        self._pending: List[bytes] = []
+
+    def submit(self, req_no: int, data: bytes) -> bool:
+        """Submit and await the ack; True iff the node accepted."""
+        from mirbft_tpu.net.framing import KIND_CLIENT, encode_frame
+
+        self._sock.sendall(
+            encode_frame(KIND_CLIENT, _CLIENT_REQ.pack(req_no) + data)
+        )
+        while not self._pending:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("node closed the client connection")
+            for kind, payload in self._decoder.feed(chunk):
+                if kind == KIND_CLIENT:
+                    self._pending.append(payload)
+        return self._pending.pop(0) == CLIENT_OK
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _spawn(root: Path, node_id: int) -> subprocess.Popen:
+    log = open(_node_dir(root, node_id) / "stdio.log", "ab")
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "mirbft_tpu.tools.mirnet",
+            "--node",
+            str(node_id),
+            "--dir",
+            str(root),
+        ],
+        stdout=log,
+        stderr=log,
+    )
+
+
+def _read_commits(root: Path, node_id: int) -> List[str]:
+    path = _node_dir(root, node_id) / "commits.log"
+    if not path.exists():
+        return []
+    return [line for line in path.read_text().splitlines() if line]
+
+
+def _committed_reqs(lines: List[str]) -> set:
+    done = set()
+    for line in lines:
+        for ref in line.split(" ", 2)[2].split(","):
+            if ref:
+                client, req_no = ref.split(":")
+                done.add((int(client), int(req_no)))
+    return done
+
+
+def _metric_value(root: Path, node_id: int, name: str) -> float:
+    path = _node_dir(root, node_id) / "metrics.prom"
+    if not path.exists():
+        return 0.0
+    total = 0.0
+    for line in path.read_text().splitlines():
+        if line.startswith(name) and " " in line:
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def _diff_commit_logs(root: Path, node_ids: List[int]) -> List[str]:
+    """Bit-identical agreement check: every pair of nodes must agree on
+    the common prefix of their commit sequences, byte for byte."""
+    logs = {i: _read_commits(root, i) for i in node_ids}
+    problems = []
+    for i in node_ids:
+        for j in node_ids:
+            if j <= i:
+                continue
+            common = min(len(logs[i]), len(logs[j]))
+            for k in range(common):
+                if logs[i][k] != logs[j][k]:
+                    problems.append(
+                        f"nodes {i}/{j} diverge at commit {k}: "
+                        f"{logs[i][k]!r} vs {logs[j][k]!r}"
+                    )
+                    break
+    return problems
+
+
+def run_deployment(
+    root_dir: Optional[str] = None,
+    node_count: int = 4,
+    reqs: int = 10,
+    kill_restart: bool = False,
+    timeout_s: float = 90.0,
+    client_id: int = 0,
+) -> dict:
+    """Run a real multi-process deployment and return a result summary:
+    ``{"commits": {node: n}, "agreement_problems": [...], "reconnects":
+    {node: count}, "elapsed_s": ...}``.  Raises on timeout or divergence.
+    """
+    owned_tmp = root_dir is None
+    if owned_tmp:
+        root_dir = tempfile.mkdtemp(prefix="mirnet-")
+    root = Path(root_dir)
+    root.mkdir(parents=True, exist_ok=True)
+    ports = _reserve_ports(node_count)
+    _cluster_path(root).write_text(
+        json.dumps(
+            {
+                "node_count": node_count,
+                "client_ids": [client_id],
+                "ports": {str(i): ports[i] for i in range(node_count)},
+            }
+        )
+    )
+    for i in range(node_count):
+        _node_dir(root, i).mkdir(parents=True, exist_ok=True)
+
+    started = time.monotonic()
+    procs: Dict[int, subprocess.Popen] = {
+        i: _spawn(root, i) for i in range(node_count)
+    }
+    victim = node_count - 1 if kill_restart else None
+    try:
+        # Mid-run drill shape: submit half the load, kill+restart a node,
+        # then submit the rest — the surviving client connections to the
+        # victim are rebuilt after the restart.
+        first_batch = reqs // 2 if kill_restart else reqs
+        _submit_range(root, ports, 0, first_batch, timeout_s)
+
+        if kill_restart:
+            _kill_restart_drill(root, procs, victim, timeout_s)
+            _submit_range(root, ports, first_batch, reqs, timeout_s)
+
+        quorum = node_count - (node_count - 1) // 3  # 2f+1
+        _wait_commits(root, procs, range(node_count), client_id, reqs,
+                      quorum, timeout_s)
+        problems = _diff_commit_logs(root, list(range(node_count)))
+        if problems:
+            raise AssertionError(
+                "commit logs diverged:\n" + "\n".join(problems)
+            )
+        result = {
+            "root": str(root),
+            "commits": {
+                i: len(_read_commits(root, i)) for i in range(node_count)
+            },
+            "agreement_problems": problems,
+            "reconnects": {
+                i: _metric_value(root, i, "net_reconnects_total")
+                for i in range(node_count)
+            },
+            "elapsed_s": time.monotonic() - started,
+        }
+        if kill_restart:
+            survivors = [i for i in range(node_count) if i != victim]
+            if not any(result["reconnects"][i] > 0 for i in survivors):
+                raise AssertionError(
+                    "kill/restart drill: no survivor observed a reconnect "
+                    f"({result['reconnects']})"
+                )
+        return result
+    finally:
+        for process in procs.values():
+            if process.poll() is None:
+                process.terminate()
+        for process in procs.values():
+            try:
+                process.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                process.kill()
+                process.wait(timeout=5)
+
+
+def _connect_clients(
+    root: Path, ports: List[int], timeout_s: float
+) -> Dict[int, SocketClient]:
+    """One client connection per node, retried while children boot."""
+    clients: Dict[int, SocketClient] = {}
+    deadline = time.monotonic() + timeout_s
+    for i, port in enumerate(ports):
+        while True:
+            try:
+                clients[i] = SocketClient(("127.0.0.1", port))
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"node {i} never started listening")
+                time.sleep(0.1)
+    return clients
+
+
+def _submit_range(
+    root: Path, ports: List[int], start: int, stop: int, timeout_s: float
+) -> None:
+    """Propose requests ``[start, stop)`` to every node (the reference
+    stress shape: N proposals per request, commit-once enforced by the
+    protocol) over fresh client connections."""
+    if start >= stop:
+        return
+    clients = _connect_clients(root, ports, timeout_s)
+    try:
+        deadline = time.monotonic() + timeout_s
+        for req_no in range(start, stop):
+            data = b"mirnet-%d" % req_no
+            for node_id, client in clients.items():
+                while not client.submit(req_no, data):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"node {node_id} kept refusing request {req_no}"
+                        )
+                    time.sleep(0.05)
+    finally:
+        for client in clients.values():
+            client.close()
+
+
+def _wait_commits(
+    root: Path,
+    procs: Dict[int, subprocess.Popen],
+    node_ids,
+    client_id: int,
+    reqs: int,
+    quorum: int,
+    timeout_s: float,
+) -> None:
+    expect = {(client_id, r) for r in range(reqs)}
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        done = sum(
+            1
+            for i in node_ids
+            if expect <= _committed_reqs(_read_commits(root, i))
+        )
+        if done >= quorum:
+            return
+        for i, process in procs.items():
+            code = process.poll()
+            if code not in (None, 0, -signal.SIGKILL, -signal.SIGTERM):
+                raise RuntimeError(
+                    f"node {i} exited with {code}; see "
+                    f"{_node_dir(root, i) / 'stdio.log'}"
+                )
+        time.sleep(0.2)
+    status = {
+        i: sorted(_committed_reqs(_read_commits(root, i))) for i in node_ids
+    }
+    raise TimeoutError(f"quorum never committed all requests: {status}")
+
+
+def _kill_restart_drill(
+    root: Path,
+    procs: Dict[int, subprocess.Popen],
+    victim: int,
+    timeout_s: float,
+) -> None:
+    """SIGKILL one node, wait for a survivor to observe the outage
+    (``net_reconnects_total`` > 0 in its metrics.prom), then restart the
+    victim from its durable stores."""
+    procs[victim].kill()
+    procs[victim].wait(timeout=10)
+    survivors = [i for i in procs if i != victim]
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if any(
+            _metric_value(root, i, "net_reconnects_total") > 0
+            for i in survivors
+        ):
+            break
+        time.sleep(0.2)
+    else:
+        raise TimeoutError("no survivor ever recorded a reconnect")
+    procs[victim] = _spawn(root, victim)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="mirnet", description=__doc__.split("\n", 1)[0]
+    )
+    parser.add_argument("--node", type=int, default=None,
+                        help="(internal) run as node process with this id")
+    parser.add_argument("--dir", default=None,
+                        help="deployment directory (default: fresh tempdir)")
+    parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--reqs", type=int, default=10)
+    parser.add_argument("--kill-restart", action="store_true",
+                        help="SIGKILL+restart one node mid-run")
+    parser.add_argument("--timeout", type=float, default=90.0)
+    args = parser.parse_args(argv)
+
+    if args.node is not None:
+        if args.dir is None:
+            parser.error("--node requires --dir")
+        return run_node(Path(args.dir), args.node)
+
+    result = run_deployment(
+        root_dir=args.dir,
+        node_count=args.nodes,
+        reqs=args.reqs,
+        kill_restart=args.kill_restart,
+        timeout_s=args.timeout,
+    )
+    print(json.dumps(result, indent=2, sort_keys=True))
+    print(
+        f"mirnet: {args.nodes} processes agreed on "
+        f"{min(result['commits'].values())}+ commits in "
+        f"{result['elapsed_s']:.1f}s",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -321,6 +321,18 @@ def test_unsupported_configs_raise():
     with pytest.raises(FastEngineUnsupported):
         FastRecording(spec)
 
+    # Request forwarding: the native engine still drops ActionForwardRequest
+    # (fastengine.cpp mirrors the reference's work.go:176), so a
+    # forwarding-enabled recorder would diverge — refuse it loudly.
+    spec = Spec(
+        node_count=4,
+        client_count=1,
+        reqs_per_client=1,
+        tweak_recorder=lambda r: setattr(r, "forwarding", True),
+    )
+    with pytest.raises(FastEngineUnsupported):
+        FastRecording(spec)
+
 
 # ---------------------------------------------------------------------------
 # Failure-path differentials: manglers, restarts, state transfer.  The

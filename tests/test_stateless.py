@@ -179,3 +179,90 @@ def test_new_epoch_config_picks_max_checkpoint():
     nec = sl.construct_new_epoch_config(cfg, (0,), changes)
     assert nec is not None
     assert nec.starting_checkpoint.seq_no == 10
+
+
+# ---------------------------------------------------------------------------
+# NewEpoch construction/verification memoization (epoch_target.py).  The
+# memo keys must gate exactly one derivation per distinct input set: a
+# failed construct/verify is not retried until a strong cert lands or the
+# verification fingerprint moves, and a success clears the memo.
+
+
+def _bare_target(cfg):
+    from mirbft_tpu.statemachine import epoch_target as et
+
+    target = object.__new__(et.EpochTarget)
+    target.network_config = cfg
+    target.state = et.EpochTargetState.PREPENDING
+    target.state_ticks = 7
+    target.is_primary = False
+    target.my_new_epoch = None
+    target.my_epoch_change = object()
+    target.my_leader_choice = (0,)
+    target.strong_changes = {i: object() for i in range(3)}
+    target._ne_construct_key = None
+    target._ne_verify_key = None
+    target.logger = None
+    return target
+
+
+def test_check_epoch_quorum_memoizes_failed_construction():
+    from unittest import mock
+
+    from mirbft_tpu.statemachine import epoch_target as et
+
+    target = _bare_target(net_config())
+    with mock.patch.object(et.EpochTarget, "construct_new_epoch") as construct:
+        construct.return_value = None
+        target.check_epoch_quorum()
+        target.check_epoch_quorum()
+        # identical (leader choice, strong-cert set): derived exactly once
+        assert construct.call_count == 1
+        assert target.state is et.EpochTargetState.PREPENDING
+
+        target.strong_changes[3] = object()  # a new strong cert lands
+        target.check_epoch_quorum()
+        assert construct.call_count == 2
+
+        construct.return_value = mock.sentinel.new_epoch
+        target.check_epoch_quorum()  # same key as the failed attempt above
+        assert construct.call_count == 2
+        target.my_leader_choice = (0, 1)  # input change → re-derives
+        target.check_epoch_quorum()
+        assert construct.call_count == 3
+        assert target.my_new_epoch is mock.sentinel.new_epoch
+        assert target.state is et.EpochTargetState.PENDING
+        assert target.state_ticks == 0
+
+
+def test_verify_new_epoch_state_memoizes_failed_validation():
+    from unittest import mock
+
+    from mirbft_tpu.statemachine import epoch_target as et
+
+    target = _bare_target(net_config())
+    target.state = et.EpochTargetState.VERIFYING
+    target.leader_new_epoch = object()
+    with mock.patch.object(
+        et.EpochTarget, "_verify_fingerprint"
+    ) as fingerprint, mock.patch.object(
+        et.EpochTarget, "_validate_leader_new_epoch"
+    ) as validate:
+        fingerprint.return_value = ((1, b"d1", False),)
+        validate.return_value = False
+        target.verify_new_epoch_state()
+        target.verify_new_epoch_state()
+        # same NewEpoch, same acked-cert fingerprint: validated once
+        assert validate.call_count == 1
+        assert target.state is et.EpochTargetState.VERIFYING
+
+        fingerprint.return_value = ((1, b"d1", True),)  # an ack crossed quorum
+        target.verify_new_epoch_state()
+        assert validate.call_count == 2
+
+        validate.return_value = True
+        fingerprint.return_value = ((2, b"d2", True),)
+        target.verify_new_epoch_state()
+        assert validate.call_count == 3
+        assert target.state is et.EpochTargetState.FETCHING
+        assert target._ne_verify_key is None

@@ -214,6 +214,64 @@ def test_client_ignores_node_forces_state_transfer():
         assert not node.state.state_transfers
 
 
+def test_forward_request_recovers_ignored_node_without_transfer():
+    # Same scenario as test_client_ignores_node_forces_state_transfer, but
+    # with request forwarding enabled: peers answer node 3's FetchRequest
+    # with ForwardRequest, so the ignored node recovers every request body
+    # over the wire and commits them all WITHOUT state transfer — the
+    # pull path the reference leaves open (work.go:176 "XXX address").
+    recording, count = run_spec(
+        Spec(
+            node_count=4,
+            client_count=1,
+            reqs_per_client=20,
+            clients_ignore=(3,),
+            tweak_recorder=lambda r: setattr(r, "forwarding", True),
+        ),
+        timeout=40000,
+    )
+    assert_all_nodes_agree(recording)
+    for node in recording.nodes:
+        assert not node.state.state_transfers, (
+            f"node {node.id} transferred despite forwarding"
+        )
+        assert sum(node.state.committed_reqs.values()) == 20
+
+
+def test_forwarded_garbage_body_attributed_as_invalid_digest():
+    # A forged ForwardRequest whose body does not hash to the claimed
+    # digest must be dropped at ingress and attributed to the sender as an
+    # invalid_digest fault — never stored, never crashing the node.
+    from mirbft_tpu.health import HealthConfig
+    from mirbft_tpu.messages import ForwardRequest, RequestAck
+
+    def tweak(recorder):
+        recorder.forwarding = True
+        recorder.health = HealthConfig()
+
+    spec = Spec(
+        node_count=4,
+        client_count=1,
+        reqs_per_client=5,
+        clients_ignore=(3,),
+        tweak_recorder=tweak,
+    )
+    forged = ForwardRequest(
+        request_ack=RequestAck(client_id=0, req_no=0, digest=b"\x5a" * 32),
+        request_data=b"not-the-request",
+    )
+    recording = spec.recorder().recording()
+    # Let every node's initialize event fire first (initialization clears
+    # the node's pending events), then inject at node 3's ingress,
+    # attributed to node 1.
+    for _ in range(4):
+        recording.step()
+    recording.event_queue.insert_msg_received(3, 1, forged, 100)
+    recording.drain_clients(timeout=40000)
+    monitor = recording.health_monitors[3]
+    assert monitor.faults.get((1, "invalid_digest"), 0) >= 1
+
+
 def test_late_start_node_forces_state_transfer():
     # Node 3 boots long after the others have made progress and must state
     # transfer to catch up (reference integration_test.go late-start scenario).
