@@ -34,6 +34,11 @@ import time
 
 BASELINE_REQ_PER_S = 100_000
 
+# Round-5 recorded value of the blocking single-dispatch hash round-trip
+# (BENCH_r05.json); the regression guard flags a >25% regression so kernel
+# or staging changes cannot silently slow the latency-bound path.
+BENCH_R05_HASH_SYNC_MS = 289.09
+
 
 def _device_crypto():
     """Crypto plane config for the bench configs: small hash waves (unique
@@ -630,6 +635,69 @@ def bench_tpu_hash_kernel(batch=4096, msg_len=640, pipeline=20):
     return batch / piped, piped, sync
 
 
+def bench_fused_pipeline(detail, batch=4096, msg_len=640, pipeline=20):
+    """Fused hash→verify→quorum waves (ops/fused.py) and the adaptive wave
+    controller, on record:
+
+    - ``hash_e2e_resident_per_s``: end-to-end hash rate through the fused
+      pipeline — host packing included, dispatches pipelined, digests
+      staying device-resident (they feed the quorum gate in the same
+      program), ONE trailing collect.  The honest e2e counterpart of
+      ``hash_device_resident_per_s``.
+    - ``fused_wave_4096_ms``: per-dispatch time of the fused wave at the
+      pipeline depth above (same semantics as ``hash_dispatch_4096_ms``).
+    - ``wave_autotune_final_size``: the size the WaveController converges
+      to when a DeviceHashPlane is driven with a sustained 4096-deep
+      backlog from the default 192.
+    """
+    import numpy as np
+
+    from mirbft_tpu.ops.fused import FusedCryptoPipeline
+
+    rng = np.random.default_rng(0)
+    msg_sets = [
+        [
+            rng.integers(0, 256, size=msg_len, dtype=np.uint8).tobytes()
+            for _ in range(batch)
+        ]
+        for _ in range(2)
+    ]
+    pipe = FusedCryptoPipeline(n_slots=batch, n_digest_slots=4)
+    quorum = [(s, [(s % batch, 0, None, None)]) for s in range(8)]
+    # Warm both message sets' shapes (identical, so one compile).
+    pipe.collect(pipe.dispatch_wave(msg_sets[0], quorum=quorum))
+
+    start = time.perf_counter()
+    handles = [
+        pipe.dispatch_wave(msg_sets[i % 2], quorum=quorum)
+        for i in range(pipeline)
+    ]
+    pipe.collect(handles[-1])
+    piped = (time.perf_counter() - start) / pipeline
+    # The trailing collect proves every earlier dispatch consumed its
+    # input (same device, program order): release their leases now.
+    for h in handles[:-1]:
+        if h.lease is not None:
+            pipe.hasher._pool.release(h.lease)
+            h.lease = None
+    detail["fused_wave_4096_ms"] = round(piped * 1e3, 2)
+    detail["hash_e2e_resident_per_s"] = round(batch / piped, 1)
+
+    from mirbft_tpu.testengine.crypto import DeviceHashPlane
+
+    plane = DeviceHashPlane(
+        device=True, wave_size=192, device_floor=1, kernel="auto"
+    )
+    for round_no in range(6):
+        msgs = [
+            b"autotune-%d-%d" % (round_no, i) + b"\x00" * 600
+            for i in range(batch)
+        ]
+        plane.enqueue([[m] for m in msgs])
+        plane.hash_batches([[m] for m in msgs])
+    detail["wave_autotune_final_size"] = plane.wave_size
+
+
 def bench_tpu_verify_kernel(
     batch=1024, n_keys=64, pipeline=10, sync_reps=9, kernel="vpu"
 ):
@@ -1206,6 +1274,21 @@ def main():
         detail["hash_dispatch_4096_sync_ms"] = round(sync * 1e3, 2)
     except Exception:
         detail["tpu_hashes_per_s"] = None
+    try:
+        # Regression guard (keys above are already recorded either way):
+        # the blocking round-trip must stay within 25% of round 5's value.
+        sync_ms = detail.get("hash_dispatch_4096_sync_ms")
+        if sync_ms is not None and sync_ms > BENCH_R05_HASH_SYNC_MS * 1.25:
+            raise RuntimeError(
+                f"hash_dispatch_4096_sync_ms={sync_ms} regressed >25% vs "
+                f"round-5 {BENCH_R05_HASH_SYNC_MS}"
+            )
+    except Exception as exc:
+        detail["hash_sync_regression_error"] = f"{type(exc).__name__}: {exc}"[:160]
+    try:
+        bench_fused_pipeline(detail)
+    except Exception as exc:
+        detail["fused_pipeline_error"] = f"{type(exc).__name__}: {exc}"[:160]
     try:
         per_s, piped, sync_p99 = bench_tpu_verify_kernel(kernel="vpu")
         detail["tpu_sig_verifies_per_s"] = round(per_s, 1)

@@ -3076,9 +3076,11 @@ struct ClientD {
         }
     }
 
-    Actions advance_acks(const Targets &nodes) {
-        Actions actions;
-        vector<AckS> acks;
+    // Appends freshly generated acks to `acks` instead of broadcasting:
+    // the disseminator's flush_acks coalesces acks across all dirty
+    // clients into one AckBatch per event batch (mirrors the Python
+    // Client.advance_acks / flush_acks split).
+    void advance_acks(vector<AckS> &acks) {
         for (i64 i = next_ack_mark; i <= high_watermark; i++) {
             CRNP crn = req_no_of(i);
             AckS ack{0, 0, 0};
@@ -3088,11 +3090,6 @@ struct ClientD {
             update_attention(*crn);
             next_ack_mark = i + 1;
         }
-        if (acks.size() == 1)
-            actions.push_back(act_send(nodes, mk_ack_msg(acks[0])));
-        else if (!acks.empty())
-            actions.push_back(act_send(nodes, mk_ack_batch(std::move(acks))));
-        return actions;
     }
 
     void update_attention(ClientReqNoD &crn) {
@@ -3460,13 +3457,22 @@ struct Disseminator {
     }
 
     Actions flush_acks() {
+        // All dirty clients' acks coalesce into ONE AckBatch per flush
+        // (mirrors the Python flush_acks: one broadcast per event batch,
+        // not one per client; receive arms classify per ack).
         if (ack_dirty.empty()) return Actions();
         Actions actions;
+        vector<AckS> merged;
         for (i64 client_id : ack_dirty) {  // std::set: sorted like Python
             ClientD *c = client(client_id);
-            if (c) concat(actions, c->advance_acks(ctx->bcast));
+            if (c) c->advance_acks(merged);
         }
         ack_dirty.clear();
+        if (merged.size() == 1)
+            actions.push_back(act_send(ctx->bcast, mk_ack_msg(merged[0])));
+        else if (!merged.empty())
+            actions.push_back(
+                act_send(ctx->bcast, mk_ack_batch(std::move(merged))));
         return actions;
     }
 

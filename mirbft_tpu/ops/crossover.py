@@ -1,0 +1,185 @@
+"""Measured kernel crossover: pick device kernels empirically, not by fiat.
+
+Round 5 left two "experiment winner" kernels parked behind explicit opt-in
+flags: the lanes-major Pallas SHA-256 (``sha256_pallas_lanes``, ~4.5x the
+scan kernel device-resident at 4096 msgs) and the MXU ed25519 formulation
+(``ops/ed25519._mul_mxu``).  Hardcoding either as the default would repeat
+the mistake this module exists to prevent — PERFORMANCE.md records one
+"obvious" winner per round that lost when measured (§3 batch-major pallas,
+§7 int8 ed25519).  So the default ``kernel="auto"`` resolves through a
+**measured crossover**:
+
+* On non-TPU backends the answer is static: ``scan`` / ``vpu``.  The
+  interpret-mode pallas kernel and the MXU nibble formulation are both
+  strictly slower off-chip, and measuring them on CPU would only add noise.
+* On TPU, a one-time probe per process times both candidates at a
+  representative shape and derives the crossover batch size: the lanes
+  kernel pays a fixed per-tile cost (1024-message tiles), the scan kernel
+  scales per message, so the break-even batch is
+  ``lanes_tile_time / scan_per_message_time``.  Waves at or above the
+  crossover dispatch lanes-major; smaller waves keep the scan kernel.
+* The ed25519 backend probe races "vpu" against "mxu" at the bench's wave
+  shape and keeps the winner for the process.
+
+Probe timings are cached per backend (``functools.lru_cache``), and every
+resolver takes the backend name and probe results as injectable arguments
+so the tier-1 suite can pin the resolution logic on a CPU-only container
+(tests/test_kernel_crossover.py).  Environment overrides
+``MIRBFT_TPU_HASH_KERNEL`` / ``MIRBFT_TPU_VERIFY_KERNEL`` short-circuit
+everything for A/B runs.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+# Probe shape: one lanes tile of 4-block messages — the smallest shape that
+# exercises the lanes kernel's real geometry, and the block bucket the
+# planes' BLOCK_LADDER dispatches most.
+_PROBE_BLOCK_BUCKET = 4
+_PROBE_VERIFY_BATCH = 256
+
+
+def _time_call(fn: Callable[[], object], repeats: int = 3) -> float:
+    """Best-of-N wall time of ``fn()`` with the result materialized (the
+    first call is a throwaway warmup so XLA compilation never counts)."""
+    np.asarray(fn())  # warmup / compile
+    best = float("inf")
+    for _ in range(repeats):
+        start = time.perf_counter()
+        np.asarray(fn())
+        best = min(best, time.perf_counter() - start)
+    return best
+
+
+def _default_backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+@functools.lru_cache(maxsize=None)
+def measure_hash_probe(backend: Optional[str] = None) -> Tuple[float, float]:
+    """(lanes_tile_seconds, scan_per_message_seconds) measured on the real
+    device.  Only called on TPU backends; raises off-chip (callers gate)."""
+    from .sha256 import sha256_batch_kernel
+    from .sha256_pallas_lanes import TILE, pack_lanes_major, sha256_lanes_kernel
+
+    rng = np.random.default_rng(7)
+    blocks = rng.integers(
+        0, 2**32, size=(TILE, _PROBE_BLOCK_BUCKET, 16), dtype=np.uint32
+    )
+    n_blocks = np.full(TILE, _PROBE_BLOCK_BUCKET, dtype=np.uint32)
+    lanes_blocks, lanes_nb = pack_lanes_major(blocks, n_blocks)
+    lanes_t = _time_call(
+        lambda: sha256_lanes_kernel(lanes_blocks, lanes_nb)
+    )
+    # Scan probe at a deliberately small batch so the per-message slope is
+    # taken where the scan kernel actually runs (small stragglers).
+    scan_batch = 128
+    scan_t = _time_call(
+        lambda: sha256_batch_kernel(blocks[:scan_batch], n_blocks[:scan_batch])
+    )
+    return lanes_t, scan_t / scan_batch
+
+
+def hash_crossover_batch(
+    backend: Optional[str] = None,
+    probe: Optional[Tuple[float, float]] = None,
+) -> int:
+    """Smallest wave size at which the lanes kernel should win; waves below
+    it keep the scan kernel.  Off-TPU the answer is "never" (a sentinel
+    above any real wave)."""
+    env = os.environ.get("MIRBFT_TPU_HASH_KERNEL")
+    if env == "lanes":
+        return 1
+    if env in ("scan", "pallas"):
+        return 1 << 30
+    backend = backend or _default_backend()
+    if backend != "tpu":
+        return 1 << 30
+    from .sha256_pallas_lanes import TILE
+
+    if probe is None:
+        probe = measure_hash_probe(backend)
+    lanes_tile_t, scan_per_msg_t = probe
+    if scan_per_msg_t <= 0:
+        return TILE
+    crossover = int(lanes_tile_t / scan_per_msg_t)
+    # A wave always pads to whole tiles, so below ~an eighth of a tile the
+    # padding waste dominates regardless of the slope; above one tile the
+    # lanes kernel amortizes by construction.
+    return max(TILE // 8, min(crossover, TILE))
+
+
+def resolve_hash_kernel(
+    requested: str,
+    batch: int,
+    backend: Optional[str] = None,
+    probe: Optional[Tuple[float, float]] = None,
+) -> str:
+    """Resolve a hasher's ``kernel`` setting for one wave of ``batch``
+    messages: explicit names pass through, ``auto`` applies the measured
+    crossover ("scan" on CPU, "lanes" on TPU at production wave sizes)."""
+    if requested != "auto":
+        return requested
+    env = os.environ.get("MIRBFT_TPU_HASH_KERNEL")
+    if env in ("scan", "pallas", "lanes"):
+        return env
+    if batch >= hash_crossover_batch(backend, probe):
+        return "lanes"
+    return "scan"
+
+
+@functools.lru_cache(maxsize=None)
+def measure_verify_probe(backend: Optional[str] = None) -> Tuple[float, float]:
+    """(vpu_seconds, mxu_seconds) for one ``_PROBE_VERIFY_BATCH`` verify
+    wave on the real device."""
+    from .ed25519 import NUM_LIMBS, ed25519_verify_kernel
+
+    batch = _PROBE_VERIFY_BATCH
+    ax = np.zeros((batch, NUM_LIMBS), dtype=np.int32)
+    ay = np.zeros((batch, NUM_LIMBS), dtype=np.int32)
+    r_bytes = np.zeros((batch, NUM_LIMBS), dtype=np.int32)
+    s_bits = np.zeros((batch, 256), dtype=np.int32)
+    h_bits = np.zeros((batch, 256), dtype=np.int32)
+    vpu_t = _time_call(
+        lambda: ed25519_verify_kernel(
+            ax, ay, r_bytes, s_bits, h_bits, backend="vpu"
+        )
+    )
+    mxu_t = _time_call(
+        lambda: ed25519_verify_kernel(
+            ax, ay, r_bytes, s_bits, h_bits, backend="mxu"
+        )
+    )
+    return vpu_t, mxu_t
+
+
+def resolve_verify_backend(
+    requested: str,
+    backend: Optional[str] = None,
+    probe: Optional[Tuple[float, float]] = None,
+) -> str:
+    """Resolve a verifier's ``kernel`` setting: explicit names pass
+    through; ``auto`` is "vpu" off-TPU and the measured winner on TPU (the
+    MXU formulation becomes the default exactly when it wins the probe —
+    PERFORMANCE.md §7 recorded it losing on v5e, but the formulation is
+    chip-dependent and the probe re-decides per rig)."""
+    if requested != "auto":
+        return requested
+    env = os.environ.get("MIRBFT_TPU_VERIFY_KERNEL")
+    if env in ("vpu", "mxu"):
+        return env
+    backend = backend or _default_backend()
+    if backend != "tpu":
+        return "vpu"
+    if probe is None:
+        probe = measure_verify_probe(backend)
+    vpu_t, mxu_t = probe
+    return "mxu" if mxu_t < vpu_t else "vpu"

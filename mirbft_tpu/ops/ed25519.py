@@ -595,9 +595,20 @@ class Ed25519BatchVerifier:
         self,
         min_device_batch: int = 16,
         key_cache_size: int = 65536,
-        kernel: str = "vpu",
+        kernel: str = "auto",
         mesh=None,
     ):
+        # ``kernel``: "vpu" / "mxu" pick the field-multiply formulation
+        # explicitly; "auto" (the default) resolves through the measured
+        # crossover probe (``ops/crossover.py``) — "vpu" off-TPU, the
+        # faster of the two formulations on the real chip.
+        if kernel not in ("auto", "vpu", "mxu"):
+            raise ValueError(f"unknown ed25519 kernel backend {kernel!r}")
+        if kernel == "auto" and mesh is not None:
+            # The mesh kernel binds its backend at construction.
+            from .crossover import resolve_verify_backend
+
+            kernel = resolve_verify_backend(kernel)
         # ``mesh``: a jax.sharding.Mesh — dispatches then run the
         # batch-sharded multi-chip kernel (parallel.sharded_ed25519_verify)
         # with verdicts produced across the mesh and the byzantine count
@@ -624,6 +635,13 @@ class Ed25519BatchVerifier:
         # cold-start crypto cost).
         self._key_cache = _SHARED_KEY_CACHE
         self._limb_cache = _SHARED_LIMB_CACHE
+
+    def resolved_kernel(self) -> str:
+        """The field-multiply backend dispatches actually run: explicit
+        settings pass through, "auto" applies the measured crossover."""
+        from .crossover import resolve_verify_backend
+
+        return resolve_verify_backend(self.kernel)
 
     def _decompress_pub(self, pub: bytes) -> Optional[Tuple[int, int]]:
         cached = self._key_cache.get(pub)
@@ -807,8 +825,19 @@ class Ed25519BatchVerifier:
             metrics.counter("mesh_verify_dispatches").inc()
             metrics.counter("mesh_verified_signatures").inc(n_real)
         else:
+            if jax.default_backend() == "tpu":
+                # Asynchronous input staging: device_put enqueues the
+                # transfers and returns, so pipelined verify waves overlap
+                # their host→device copies with the previous wave's kernel
+                # instead of each jit call blocking on its own numpy
+                # arguments (the same serial-RTT shape the hash dispatch
+                # path had).
+                ax, ay, r_bytes, s_bits, h_bits = (
+                    jax.device_put(a)
+                    for a in (ax, ay, r_bytes, s_bits, h_bits)
+                )
             ok = ed25519_verify_kernel(
-                ax, ay, r_bytes, s_bits, h_bits, backend=self.kernel
+                ax, ay, r_bytes, s_bits, h_bits, backend=self.resolved_kernel()
             )
         metrics.histogram("verify_device_dispatch_seconds").observe(
             _time.perf_counter() - start

@@ -1,0 +1,375 @@
+"""Fused hash → verify → quorum-accumulate device wave.
+
+Round 5's dispatch anatomy (docs/PERFORMANCE.md §13) split one crypto wave
+into pack → enqueue → collect; this module removes the remaining host
+round-trips BETWEEN stages.  The unfused pipeline pays three dispatches and
+three collects per wave — hash digests come home, get fed to the ed25519
+verify wave, whose verdicts come home and drive quorum accumulation — and
+on a tunnel-attached chip each hop is a full RTT.  The fused wave runs all
+three stages inside ONE jitted program:
+
+    blocks ──sha256──► digests ─┐ (device-resident, never leave HBM)
+                                ├─► digest-gated quorum accumulate
+    sigs ───ed25519──► verdicts ┘       masks/counts donated through
+
+* **Digest handoff** is real, not just co-scheduling: the quorum stage's
+  touch rows can be *gated* on the wave's own digests — ``digest_rows[n,k]``
+  names a digest row of this wave and ``claimed[n,k,:]`` the digest words
+  the ack claims; a touch only counts when the freshly computed digest
+  matches.  That is the protocol's invalid-digest ingress check
+  (``replicas.py on_forward``) executed on-device against content the
+  device just hashed, with no host in the loop.
+* **One collect** materializes digests, verdicts and post-counts together
+  (a single blocking sync instead of three).
+* **Donated buffers throughout** on real TPUs: the packed block slab and
+  the quorum masks/counts are donated into the program, so the masks live
+  device-resident across waves and each in-flight wave holds one slab.
+
+``host_fused_reference`` is the bit-exactness oracle: hashlib + the
+pure-Python RFC 8032 verifier + ``quorum.host_accumulate`` with identical
+gating, pinned against the device path in tests/test_fused_wave.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .quorum import MASK_WORDS, accumulate_body, host_accumulate
+from .sha256 import (
+    PackedWave,
+    TpuHasher,
+    _sha256_padded,
+    digests_from_words,
+)
+
+
+def _metrics():
+    from .. import metrics
+
+    return metrics
+
+
+def _hash_stage(blocks, n_blocks, layout: str, interpret: bool):
+    """Digest words [B, 8] for either packed layout, device-resident."""
+    if layout == "lanes":
+        from .sha256_pallas_lanes import TILE, sha256_lanes_kernel
+
+        out = sha256_lanes_kernel(blocks, n_blocks, interpret=interpret)
+        tiles = out.shape[0]
+        # [tiles, 8, 8, 128] -> [tiles*1024, 8] so the quorum gate can index
+        # digests by message row.  A device transpose, but it replaces a
+        # host round-trip + re-upload; the lanes layout stays on the wire
+        # side where it matters (the packed input).
+        return out.transpose(0, 2, 3, 1).reshape(tiles * TILE, 8)
+    return jax.vmap(_sha256_padded)(blocks, n_blocks)
+
+
+def _fused_body(
+    blocks,
+    n_blocks,
+    ax,
+    ay,
+    r_bytes,
+    s_bits,
+    h_bits,
+    masks,
+    counts,
+    sources,
+    touches,
+    valid,
+    digest_rows,
+    claimed,
+    *,
+    layout: str,
+    backend: str,
+    interpret: bool,
+):
+    from .ed25519 import _mul_mxu, _mul_vpu, _verify_kernel_body
+
+    digests = _hash_stage(blocks, n_blocks, layout, interpret)
+    mul = _mul_mxu if backend == "mxu" else _mul_vpu
+    ok = _verify_kernel_body(ax, ay, r_bytes, s_bits, h_bits, mul)
+
+    # Digest gate: rows < 0 are ungated; gated rows compare the claimed
+    # digest words against the wave's freshly computed digest.
+    gate = digest_rows >= 0
+    rows = jnp.clip(digest_rows, 0, digests.shape[0] - 1)
+    eq = jnp.all(digests[rows] == claimed, axis=-1)
+    gated_valid = valid & (~gate | eq)
+    masks, counts, posts, newbits = accumulate_body(
+        masks, counts, sources, touches, gated_valid
+    )
+    return digests, ok, masks, counts, posts, newbits
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_fused(layout: str, backend: str, interpret: bool, donate: bool):
+    fn = functools.partial(
+        _fused_body, layout=layout, backend=backend, interpret=interpret
+    )
+    if donate:
+        # blocks, n_blocks, masks, counts: the packed slab dies with the
+        # dispatch; masks/counts are threaded — the outputs alias the
+        # donated inputs, keeping quorum state device-resident across waves.
+        return jax.jit(fn, donate_argnums=(0, 1, 7, 8))
+    return jax.jit(fn)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+class FusedDispatch:
+    """One in-flight fused wave.  ``words`` mirrors ``HashDispatch.words``
+    (so plane polling code treats either handle identically); ``ok`` /
+    ``posts`` / ``newbits`` are the verify and quorum outputs, all still
+    device-resident until ``FusedCryptoPipeline.collect``."""
+
+    __slots__ = (
+        "words", "count", "layout", "lease",
+        "ok", "valid", "verify_count",
+        "posts", "newbits", "auth_keys", "auth_items",
+    )
+
+    def __init__(self, words, count, layout, lease, ok, valid, verify_count,
+                 posts, newbits):
+        self.words = words
+        self.count = count
+        self.layout = layout
+        self.lease = lease
+        self.ok = ok
+        self.valid = valid
+        self.verify_count = verify_count
+        self.posts = posts
+        self.newbits = newbits
+        # Auth-plane bookkeeping attached by DeviceHashPlane's fused path.
+        self.auth_keys = None
+        self.auth_items = None
+
+
+class FusedResult:
+    __slots__ = ("digests", "verdicts", "posts", "newbits")
+
+    def __init__(self, digests, verdicts, posts, newbits):
+        self.digests = digests  # List[bytes], input order
+        self.verdicts = verdicts  # np.bool_ [V]
+        self.posts = posts  # np.int32 [N, K]
+        self.newbits = newbits  # np.bool_ [N, K]
+
+
+class FusedCryptoPipeline:
+    """Device-resident crypto pipeline: one dispatch + one collect per wave.
+
+    Owns the quorum plane state (``masks [W, D, 8]`` / ``counts [W, D]``)
+    as device arrays threaded through every dispatch with donation, the
+    pooled hash packer (via an internal ``TpuHasher``) and the verify
+    packer (via an ``Ed25519BatchVerifier``).  Wave inputs that are absent
+    pad to minimal fixed shapes so the jitted program count stays bounded:
+    a signed-free wave carries one invalid verify row, a quorum-free wave
+    one all-invalid touch wave.
+    """
+
+    def __init__(
+        self,
+        n_slots: int = 256,
+        n_digest_slots: int = 4,
+        kernel: str = "auto",
+        touch_k: int = 8,
+    ):
+        self.touch_k = touch_k
+        self.hasher = TpuHasher(min_device_batch=1, kernel=kernel)
+        from .ed25519 import Ed25519BatchVerifier
+
+        self.verifier = Ed25519BatchVerifier(min_device_batch=1)
+        self.masks = jnp.zeros(
+            (n_slots, n_digest_slots, MASK_WORDS), dtype=jnp.uint32
+        )
+        self.counts = jnp.zeros((n_slots, n_digest_slots), dtype=jnp.int32)
+        self._interpret = jax.default_backend() != "tpu"
+        self._donate = jax.default_backend() == "tpu"
+
+    # -- host-side packing helpers ------------------------------------------
+
+    def _pack_quorum(self, quorum, batch_rows: int):
+        """(sources, touches, valid, digest_rows, claimed) fixed-shape
+        arrays from [(source, [(w, d, digest_row, claimed_digest|None)])]."""
+        k = self.touch_k
+        n = _next_pow2(len(quorum)) if quorum else 1
+        sources = np.zeros(n, dtype=np.int32)
+        touches = np.zeros((n, k, 2), dtype=np.int32)
+        valid = np.zeros((n, k), dtype=bool)
+        digest_rows = np.full((n, k), -1, dtype=np.int32)
+        claimed = np.zeros((n, k, 8), dtype=np.uint32)
+        for i, (source, rows) in enumerate(quorum):
+            if len(rows) > k:
+                raise ValueError(f"wave {i} exceeds K={k} touches")
+            sources[i] = source
+            for j, (w, d, row, claim) in enumerate(rows):
+                touches[i, j] = (w, d)
+                valid[i, j] = True
+                if row is not None and row >= 0:
+                    if row >= batch_rows:
+                        raise ValueError(
+                            f"digest row {row} outside wave of {batch_rows}"
+                        )
+                    digest_rows[i, j] = row
+                    claimed[i, j] = np.frombuffer(
+                        claim, dtype=">u4"
+                    ).astype(np.uint32)
+        return sources, touches, valid, digest_rows, claimed
+
+    def _stage(self, arr):
+        if self._donate:
+            return jax.device_put(arr)
+        return arr
+
+    # -- dispatch / collect --------------------------------------------------
+
+    def dispatch_wave(
+        self,
+        messages: Sequence[bytes],
+        signed: Optional[Tuple[Sequence[bytes], Sequence[bytes], Sequence[bytes]]] = None,
+        quorum: Optional[Sequence] = None,
+        block_bucket: Optional[int] = None,
+        batch_bucket: Optional[int] = None,
+        packed: Optional[PackedWave] = None,
+    ) -> FusedDispatch:
+        """ONE device dispatch covering all three stages.
+
+        ``messages`` (or a pre-``pack``ed wave) feed the hash stage;
+        ``signed`` is the verify stage's (pubs, msgs, sigs); ``quorum`` is a
+        wave stream ``[(source, [(slot, digest_slot, digest_row|None,
+        claimed_digest)])]`` whose gated touches compare against this very
+        wave's digests.  Returns without blocking on the device."""
+        if packed is None:
+            packed = self.hasher.pack(messages, block_bucket, batch_bucket)
+        if packed.layout == "lanes":
+            from .sha256_pallas_lanes import TILE
+
+            batch_rows = packed.blocks.shape[0] * TILE
+        else:
+            batch_rows = packed.blocks.shape[0]
+
+        if signed and len(signed[0]):
+            pubs, vmsgs, sigs = signed
+            ax, ay, r_bytes, s_bits, h_bits, valid = self.verifier.pack_inputs(
+                pubs, vmsgs, sigs
+            )
+            verify_count = len(pubs)
+        else:
+            from .ed25519 import NUM_LIMBS
+
+            ax = np.zeros((1, NUM_LIMBS), dtype=np.int32)
+            ay = np.zeros((1, NUM_LIMBS), dtype=np.int32)
+            r_bytes = np.zeros((1, NUM_LIMBS), dtype=np.int32)
+            s_bits = np.zeros((1, 256), dtype=np.int32)
+            h_bits = np.zeros((1, 256), dtype=np.int32)
+            valid = np.zeros(1, dtype=bool)
+            verify_count = 0
+
+        sources, touches, tvalid, digest_rows, claimed = self._pack_quorum(
+            quorum or [], batch_rows
+        )
+
+        backend = self.verifier.resolved_kernel()
+        fn = _compiled_fused(
+            packed.layout, backend, self._interpret, self._donate
+        )
+        start = time.perf_counter()
+        digests, ok, self.masks, self.counts, posts, newbits = fn(
+            self._stage(packed.blocks),
+            self._stage(packed.n_blocks),
+            self._stage(ax),
+            self._stage(ay),
+            self._stage(r_bytes),
+            self._stage(s_bits),
+            self._stage(h_bits),
+            self.masks,
+            self.counts,
+            self._stage(sources),
+            self._stage(touches),
+            self._stage(tvalid),
+            self._stage(digest_rows),
+            self._stage(claimed),
+        )
+        m = _metrics()
+        m.histogram("hash_device_dispatch_seconds").observe(
+            time.perf_counter() - start
+        )
+        m.counter("fused_wave_dispatches").inc()
+        m.counter("fused_wave_messages").inc(packed.count)
+        return FusedDispatch(
+            digests, packed.count, packed.layout, packed.lease,
+            ok, valid, verify_count, posts, newbits,
+        )
+
+    def collect(self, handle: FusedDispatch) -> FusedResult:
+        """ONE blocking sync for all three stages' outputs; releases the
+        pooled packing lease."""
+        words = np.asarray(handle.words)  # digests, batch-major rows
+        verdicts = (
+            np.asarray(handle.ok)[: handle.verify_count]
+            & handle.valid[: handle.verify_count]
+        )
+        posts = np.asarray(handle.posts)
+        newbits = np.asarray(handle.newbits)
+        digests = digests_from_words(words[: handle.count])
+        if handle.lease is not None:
+            self.hasher._pool.release(handle.lease)
+            handle.lease = None
+        return FusedResult(digests, verdicts, posts, newbits)
+
+    def quorum_state(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Materialize the device-resident (masks, counts) — a blocking
+        sync; steady-state consumers should read per-wave ``posts``."""
+        return np.asarray(self.masks), np.asarray(self.counts)
+
+
+def host_fused_reference(
+    messages: Sequence[bytes],
+    signed: Optional[Tuple[Sequence[bytes], Sequence[bytes], Sequence[bytes]]],
+    quorum: Optional[Sequence],
+    masks: np.ndarray,
+    counts: np.ndarray,
+    touch_k: int = 8,
+) -> Tuple[List[bytes], np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Pure-host oracle for the fused wave: hashlib digests, RFC 8032
+    verdicts, and numpy quorum accumulation with identical digest gating.
+    Returns (digests, verdicts, masks, counts, posts, newbits)."""
+    import hashlib
+
+    from .ed25519 import verify_one
+
+    digests = [hashlib.sha256(m).digest() for m in messages]
+    if signed and len(signed[0]):
+        verdicts = np.array(
+            [verify_one(p, m, s) for p, m, s in zip(*signed)], dtype=bool
+        )
+    else:
+        verdicts = np.zeros(0, dtype=bool)
+
+    quorum = quorum or []
+    k = touch_k
+    n = _next_pow2(len(quorum)) if quorum else 1
+    sources = np.zeros(n, dtype=np.int32)
+    touches = np.zeros((n, k, 2), dtype=np.int32)
+    valid = np.zeros((n, k), dtype=bool)
+    for i, (source, rows) in enumerate(quorum):
+        sources[i] = source
+        for j, (w, d, row, claim) in enumerate(rows):
+            touches[i, j] = (w, d)
+            gate_ok = True
+            if row is not None and row >= 0:
+                gate_ok = digests[row] == claim
+            valid[i, j] = gate_ok
+    masks, counts, posts, newbits = host_accumulate(
+        masks, counts, sources, touches, valid
+    )
+    return digests, verdicts, masks, counts, posts, newbits

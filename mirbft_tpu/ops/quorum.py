@@ -93,6 +93,12 @@ def _kernel(masks, counts, sources, touches, valid):
     return masks, counts, posts, newbits
 
 
+# Public alias for composition: the fused hash→verify→quorum wave
+# (ops/fused.py) inlines this body inside its own jit so the accumulate
+# stage runs in the same dispatch as the hash and verify stages — masks and
+# counts never leave the device between them.
+accumulate_body = _kernel
+
 _jitted_kernel = None
 
 

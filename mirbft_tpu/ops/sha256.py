@@ -392,9 +392,11 @@ class TpuHasher:
     dispatch overhead dominates for tiny batches (the testengine's default
     traffic) while large batches (the throughput path) go to the device.
 
-    ``kernel``: "scan" (vmapped lax.scan, the default), "pallas"
-    (batch-major explicit VMEM tiling; see ``ops/sha256_pallas.py``), or
-    "lanes" (lanes-major pallas, the round-5 experiment winner at large
+    ``kernel``: "auto" (the default — the measured crossover of
+    ``ops/crossover.py`` resolves each wave to "lanes" on TPU at production
+    wave sizes and "scan" everywhere else), "scan" (vmapped lax.scan),
+    "pallas" (batch-major explicit VMEM tiling; see ``ops/sha256_pallas.py``),
+    or "lanes" (lanes-major pallas, the round-5 experiment winner at large
     device-resident batches; see ``ops/sha256_pallas_lanes.py`` — the host
     packs lanes-major directly so no relayout is paid on either side).
 
@@ -416,12 +418,12 @@ class TpuHasher:
         self,
         min_device_batch: int = 32,
         max_block_bucket: int = 1 << 14,
-        kernel: str = "scan",
+        kernel: str = "auto",
         mesh=None,
     ):
         self.min_device_batch = min_device_batch
         self.max_block_bucket = max_block_bucket
-        if kernel not in ("scan", "pallas", "lanes"):
+        if kernel not in ("auto", "scan", "pallas", "lanes"):
             raise ValueError(f"unknown sha256 kernel {kernel!r}")
         self.kernel = kernel
         self._cpu = None
@@ -446,6 +448,30 @@ class TpuHasher:
             return _sha256_batch_kernel_donated
         return sha256_batch_kernel
 
+    def kernel_for_batch(self, batch: int) -> str:
+        """The kernel one wave of ``batch`` messages will actually run:
+        explicit settings pass through; ``auto`` applies the measured
+        crossover (``ops/crossover.py`` — "lanes" on TPU above the probe's
+        break-even wave, "scan" otherwise)."""
+        from .crossover import resolve_hash_kernel
+
+        return resolve_hash_kernel(self.kernel, batch)
+
+    def _stage(self, arr):
+        """Asynchronously start the host→device transfer of a packed array.
+
+        ``jax.device_put`` enqueues the copy and returns immediately, so the
+        transfers of wave k+1 overlap the kernel of wave k — without this,
+        each jit call entered with numpy arguments blocks on its own input
+        staging and a pipelined dispatch loop degenerates to one serial
+        RTT+transfer per wave (the measured shape of the r05 500x gap).  On
+        non-TPU backends the array is passed through untouched: the CPU
+        backend zero-copy aliases numpy inputs, which staging would break
+        for the pooled-buffer lease discipline."""
+        if _donation_pays():
+            return jax.device_put(arr)
+        return arr
+
     def pack(
         self,
         messages: Sequence[bytes],
@@ -453,12 +479,15 @@ class TpuHasher:
         batch_bucket: Optional[int] = None,
     ) -> PackedWave:
         """Phase 1 of a dispatch: vectorized packing into pooled buffers,
-        shaped for this hasher's kernel (lanes-major for ``kernel="lanes"``).
-        Pure host CPU work — callers may overlap it with in-flight device
-        execution of the previous wave."""
+        shaped for the kernel this wave resolves to (lanes-major for
+        "lanes").  Pure host CPU work — callers may overlap it with
+        in-flight device execution of the previous wave."""
         start = time.perf_counter()
+        batch_hint = max(len(messages), batch_bucket or 0)
         layout = (
-            "lanes" if self.kernel == "lanes" and self._mesh_fn is None
+            "lanes"
+            if self.kernel_for_batch(batch_hint) == "lanes"
+            and self._mesh_fn is None
             else "batch"
         )
         packed = pack_messages(
@@ -487,11 +516,17 @@ class TpuHasher:
             from .sha256_pallas_lanes import sha256_lanes_kernel
 
             interpret = jax.default_backend() != "tpu"
+            donate = _donation_pays()
             words = sha256_lanes_kernel(
-                packed.blocks, packed.n_blocks, interpret=interpret
+                self._stage(packed.blocks),
+                self._stage(packed.n_blocks),
+                interpret=interpret,
+                donate=donate,
             )
         else:
-            words = self._kernel_fn()(packed.blocks, packed.n_blocks)
+            words = self._kernel_fn()(
+                self._stage(packed.blocks), self._stage(packed.n_blocks)
+            )
         _metrics().histogram("hash_device_dispatch_seconds").observe(
             time.perf_counter() - start
         )

@@ -119,6 +119,44 @@ def _compiled(tiles: int, n_block_bucket: int, interpret: bool):
     return call if interpret else jax.jit(call)
 
 
+@functools.lru_cache(maxsize=None)
+def _compiled_donated(tiles: int, n_block_bucket: int):
+    """Donated variant for the device-resident pipeline: the packed block
+    slab's device copy is handed to the kernel and freed as soon as it is
+    consumed, so pipelined waves hold one slab each instead of two.  Only
+    built on real TPU backends (interpret mode has nothing to donate)."""
+    kernel = functools.partial(_kernel, n_block_bucket=n_block_bucket)
+    call = pl.pallas_call(
+        kernel,
+        grid=(tiles, n_block_bucket),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 16, SUB, LANES),
+                lambda i, b: (i, b, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, SUB, LANES),
+                lambda i, b: (i, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 8, SUB, LANES),
+            lambda i, b: (i, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((tiles, 8, SUB, LANES), jnp.uint32),
+        scratch_shapes=[pltpu.VMEM((8, SUB, LANES), jnp.uint32)],
+        compiler_params=getattr(
+            pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+        )(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+    )
+    return jax.jit(call, donate_argnums=(0, 1))
+
+
 def pack_lanes_major(blocks, n_blocks):
     """HOST-side lanes-major packing shared by the adapter, the bench, and
     tests: [B, L, 16] batch-major -> ([tiles, L, 16, 8, 128],
@@ -139,12 +177,19 @@ def pack_lanes_major(blocks, n_blocks):
     return lanes, nb
 
 
-def sha256_lanes_kernel(blocks, n_blocks, *, interpret: bool = False):
+def sha256_lanes_kernel(
+    blocks, n_blocks, *, interpret: bool = False, donate: bool = False
+):
     """Lanes-major entry: blocks [tiles, L, 16, 8, 128] and n_blocks
     [tiles, 1, 8, 128] as produced by ``pack_messages(layout="lanes")`` (or
     ``pack_lanes_major``) -> [tiles, 8, 8, 128] digest words.  No relayout
-    on either side — the packer writes the kernel's native layout."""
+    on either side — the packer writes the kernel's native layout.
+
+    ``donate=True`` (real-TPU only) hands the inputs' device buffers to the
+    kernel; callers must not reuse them after the call."""
     tiles, bucket = blocks.shape[0], blocks.shape[1]
+    if donate and not interpret:
+        return _compiled_donated(tiles, bucket)(blocks, n_blocks)
     return _compiled(tiles, bucket, interpret)(blocks, n_blocks)
 
 

@@ -630,10 +630,12 @@ class Client:
                 self.next_ready_mark = i + 1
                 break
 
-    def advance_acks(self) -> Actions:
-        """Reference :878-895 — but acks generated in one pass are aggregated
-        into a single AckBatch broadcast (see messages.AckBatch)."""
-        actions = Actions()
+    def advance_acks(self) -> List[RequestAck]:
+        """Reference :878-895 — returns the freshly generated acks instead
+        of broadcasting them: the disseminator's flush_acks merges acks
+        across ALL dirty clients into one AckBatch per event batch (the
+        reference broadcasts one AckMsg per ack; one batch per client was
+        the first aggregation step, cross-client coalescing the second)."""
         acks: List[RequestAck] = []
         for i in range(self.next_ack_mark, self.high_watermark + 1):
             crn = self.req_no(i)
@@ -647,11 +649,7 @@ class Client:
             self._schedule_resend(crn, self.tick_count + ACK_RESEND_TICKS + 1)
             self._update_attention(crn)
             self.next_ack_mark = i + 1
-        if len(acks) == 1:
-            actions.send(self.network_config.nodes, AckMsg(ack=acks[0]))
-        elif acks:
-            actions.send(self.network_config.nodes, AckBatch(acks=tuple(acks)))
-        return actions
+        return acks
 
     def _update_attention(self, crn: ClientReqNo) -> None:
         if not crn.committed and crn.needs_attention():
@@ -737,6 +735,7 @@ class ClientHashDisseminator:
         "plane",
         "_mask_bytes",
         "_ack_dirty",
+        "coalesce_acks",
     )
 
     def __init__(
@@ -761,9 +760,12 @@ class ClientHashDisseminator:
         self._mask_bytes = 0
         # Clients with persisted-but-not-yet-acked requests; drained by
         # flush_acks() at each event-batch boundary (EventActionsReceived),
-        # so one processing batch emits one aggregated AckBatch per client
-        # instead of one ack broadcast per persisted request.
+        # which coalesces every dirty client's acks into one AckBatch per
+        # processing batch instead of one broadcast per persisted request
+        # (or per client).  False restores the per-client shape for the
+        # differential test.
         self._ack_dirty: Set[int] = set()
+        self.coalesce_acks = True
 
     def reinitialize(self, seq_no: int, network_state: NetworkState) -> Actions:
         """Reference :143-180."""
@@ -1133,16 +1135,37 @@ class ClientHashDisseminator:
         return Actions()
 
     def flush_acks(self) -> Actions:
-        """Generate deferred ack broadcasts (deterministic client order)."""
+        """Generate deferred ack broadcasts (deterministic client order).
+
+        All dirty clients' fresh acks coalesce into ONE AckBatch per flush
+        — one broadcast per event batch instead of one per client.  The
+        receive side classifies per ack (step's AckBatch arm), so
+        cross-client batches need no special handling there.  Setting
+        ``coalesce_acks=False`` restores the one-batch-per-client shape
+        (the differential test pins the two to identical client state)."""
         if not self._ack_dirty:
             return Actions()
         actions = Actions()
+        merged: List[RequestAck] = []
         for client_id in sorted(self._ack_dirty):
             client = self.clients.get(client_id)
-            if client is not None:
-                actions.concat(client.advance_acks())
+            if client is None:
+                continue
+            acks = client.advance_acks()
+            if self.coalesce_acks:
+                merged.extend(acks)
+            elif acks:
+                self._send_acks(actions, acks)
         self._ack_dirty.clear()
+        if merged:
+            self._send_acks(actions, merged)
         return actions
+
+    def _send_acks(self, actions: Actions, acks: List[RequestAck]) -> None:
+        if len(acks) == 1:
+            actions.send(self.network_config.nodes, AckMsg(ack=acks[0]))
+        else:
+            actions.send(self.network_config.nodes, AckBatch(acks=tuple(acks)))
 
     def allocate(self, seq_no: int, network_state: NetworkState) -> Actions:
         """Advance client windows after a checkpoint (reference :260-278)."""

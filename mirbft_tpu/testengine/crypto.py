@@ -84,6 +84,61 @@ def _host_fast(parts: Sequence[bytes]) -> bool:
     return len(parts) == 1 and len(parts[0]) < 512
 
 
+class WaveController:
+    """Adaptive wave sizing: grow when the queue outruns the wave, shrink
+    when waves launch half-empty, and never grow past the point where
+    per-message dispatch latency stops improving.
+
+    Replaces the fixed ``wave_size=192``: small interactive runs keep
+    latency (waves shrink back to ``floor``), loaded runs amortize dispatch
+    (waves grow toward ``ceiling`` while the backlog sustains them).  The
+    inputs are exactly the signals the plane already measures — queue depth
+    at launch (the ``hash_wave_queue_depth`` gauge's value) and the
+    dispatch-phase latency (the ``hash_device_dispatch_seconds``
+    histogram's samples) — so the controller adds no new instrumentation
+    cost.  Wave grouping affects neither digests nor the simulated
+    schedule, so determinism pins hold at any size trajectory.
+    """
+
+    def __init__(
+        self, initial: int = 192, floor: int = 64, ceiling: int = 2048
+    ):
+        self.size = initial
+        self.floor = max(1, min(floor, initial))
+        self.ceiling = max(ceiling, initial)
+        self._idle_waves = 0
+        self._best_per_msg = float("inf")
+
+    def observe(
+        self, queue_depth: int, dispatched: int, dispatch_seconds: float
+    ) -> int:
+        """Account one launched wave; returns the size for the next wave."""
+        if dispatched > 0 and dispatch_seconds > 0:
+            per_msg = dispatch_seconds / dispatched
+            if per_msg < self._best_per_msg:
+                self._best_per_msg = per_msg
+            elif (
+                self.size > self.floor and per_msg > 4 * self._best_per_msg
+            ):
+                # Growth stopped paying: per-message dispatch cost has
+                # regressed well past the best observed — back off one step.
+                self.size = max(self.floor, self.size // 2)
+                metrics.gauge("hash_wave_autotune_size").set(self.size)
+                return self.size
+        if queue_depth >= 2 * self.size:
+            self.size = min(self.ceiling, self.size * 2)
+            self._idle_waves = 0
+        elif queue_depth < self.size // 2:
+            self._idle_waves += 1
+            if self._idle_waves >= 4 and self.size > self.floor:
+                self.size = max(self.floor, self.size // 2)
+                self._idle_waves = 0
+        else:
+            self._idle_waves = 0
+        metrics.gauge("hash_wave_autotune_size").set(self.size)
+        return self.size
+
+
 class DeviceHashPlane:
     """Cross-node SHA-256 service: content-memoized, wave-batched, async.
 
@@ -108,11 +163,22 @@ class DeviceHashPlane:
         kernel: str = "scan",
         defer_unready: bool = False,
         mesh_devices: int = 0,
+        adaptive: bool = True,
     ):
         self.device = device
         self.wave_size = wave_size
         self.device_floor = device_floor
         self.max_block_bucket = max_block_bucket
+        # Adaptive wave sizing: the controller starts at the configured
+        # wave_size (so explicit small sizes in tests keep their launch
+        # threshold) and only moves on observed load.
+        self._controller = WaveController(initial=wave_size) if (
+            device and adaptive
+        ) else None
+        # Fused pipeline (ops/fused.py), attached via attach_fused: when
+        # set, waves run hash→verify→quorum in one dispatch.
+        self._fused = None
+        self._fused_auth = None
         # When True the scheduler re-schedules (in simulated time) hash
         # events whose device dispatch is still in flight, instead of
         # blocking the host loop.  Trades bit-pinned step counts (which
@@ -144,6 +210,18 @@ class DeviceHashPlane:
                 kernel=kernel,
                 mesh=mesh,
             )
+
+    def attach_fused(self, pipeline, auth_plane=None) -> None:
+        """Route waves through a ``FusedCryptoPipeline``: each hash wave
+        becomes ONE fused dispatch that also carries the auth plane's
+        pending signatures (its verify stage) — one dispatch and one
+        collect instead of three.  The pipeline owns the packing pool for
+        fused waves (its collect releases the lease), so the plane's own
+        hasher keeps serving only the unfused straggler path."""
+        if not self.device:
+            raise ValueError("fused pipeline requires device=True")
+        self._fused = pipeline
+        self._fused_auth = auth_plane
 
     # -- scheduler-side -----------------------------------------------------
 
@@ -180,6 +258,7 @@ class DeviceHashPlane:
         Block buckets are quantized (min 4, powers of two) and the batch
         dimension is pinned to the wave's power-of-two, bounding the set of
         compiled kernel shapes."""
+        queue_depth = len(self._pending)
         pending, self._pending = self._pending, OrderedDict()
         groups: Dict[int, List[tuple]] = {}
         for key, (refs, message) in pending.items():
@@ -192,6 +271,8 @@ class DeviceHashPlane:
                 continue
             groups.setdefault(bucket, []).append((key, refs, message))
         batch_bucket = _next_pow2(self.wave_size)
+        dispatched = 0
+        dispatch_seconds = 0.0
         for bucket in sorted(groups):
             all_entries = groups[bucket]
             for start in range(0, len(all_entries), self.wave_size):
@@ -204,7 +285,8 @@ class DeviceHashPlane:
                 # the device executes chunk k the host is already packing
                 # chunk k+1 of this loop.
                 pack_start = time.perf_counter()
-                packed = self._hasher.pack(
+                packer = self._fused.hasher if self._fused else self._hasher
+                packed = packer.pack(
                     [m for (_, _, m) in entries],
                     block_bucket=bucket,
                     batch_bucket=batch_bucket,
@@ -213,10 +295,25 @@ class DeviceHashPlane:
                     time.perf_counter() - pack_start
                 )
                 dispatch_start = time.perf_counter()
-                handle = self._hasher.dispatch_packed(packed)
-                metrics.counter("device_dispatch_seconds").inc(
-                    time.perf_counter() - dispatch_start
-                )
+                if self._fused is not None:
+                    # Fused wave: this dispatch also carries whatever the
+                    # auth plane has pending — hash + verify (+ quorum
+                    # padding) execute in one program, one collect.
+                    auth_keys = auth_items = signed = None
+                    if self._fused_auth is not None:
+                        auth_keys, auth_items, signed = (
+                            self._fused_auth.take_pending()
+                        )
+                    handle = self._fused.dispatch_wave(
+                        [], signed=signed, packed=packed
+                    )
+                    handle.auth_keys = auth_keys
+                    handle.auth_items = auth_items
+                else:
+                    handle = self._hasher.dispatch_packed(packed)
+                step = time.perf_counter() - dispatch_start
+                dispatch_seconds += step
+                metrics.counter("device_dispatch_seconds").inc(step)
                 self._inflight.append(
                     (
                         [k for (k, _, _) in entries],
@@ -227,8 +324,13 @@ class DeviceHashPlane:
                 )
                 for key, refs, _ in entries:
                     self._issued[key] = (refs, handle)
+                dispatched += len(entries)
                 metrics.counter("device_hash_dispatches").inc()
                 metrics.counter("device_hashed_messages").inc(len(entries))
+        if self._controller is not None:
+            self.wave_size = self._controller.observe(
+                queue_depth, dispatched, dispatch_seconds
+            )
         metrics.gauge("hash_waves_in_flight").set(len(self._inflight))
 
     def poll(self, batches: Sequence[Sequence[bytes]]) -> bool:
@@ -333,7 +435,22 @@ class DeviceHashPlane:
             ):
                 self._inflight.append((keys, refs, handle, dispatch_ts))
                 continue
-            digests = self._hasher.collect(handle)
+            if self._fused is not None and hasattr(handle, "verify_count"):
+                # Fused handle: ONE sync yields digests, verdicts and
+                # quorum posts together; verdicts flow straight into the
+                # auth plane's memo — no separate verify collect.
+                result = self._fused.collect(handle)
+                digests = result.digests
+                if handle.auth_keys:
+                    auth = self._fused_auth
+                    for akey, item, verdict in zip(
+                        handle.auth_keys, handle.auth_items, result.verdicts
+                    ):
+                        if item[0] in auth.keys:
+                            auth._memo_put(akey, item[2], bool(verdict))
+                    auth.verified_count += len(handle.auth_keys)
+            else:
+                digests = self._hasher.collect(handle)
             for key, ref, digest in zip(keys, refs, digests):
                 self._memo_put(key, ref, digest)
                 self._issued.pop(key, None)
@@ -488,6 +605,26 @@ class DeviceAuthPlane:
             else:
                 self._verify_host(keys, items, packed)
         metrics.gauge("auth_waves_in_flight").set(len(self._inflight))
+
+    def take_pending(self):
+        """Drain the pending set into a fused wave (``ops/fused.py``):
+        returns ``(keys, items, (pubs, msgs, sigs))``, or three ``None``s
+        when nothing is pending.  The caller's fused collect writes the
+        verdicts back through ``_memo_put``; entries are NOT marked issued
+        — an ``authenticate`` racing the fused wave just re-verifies on
+        host, which memoizes the identical verdict."""
+        if not self._pending:
+            return None, None, None
+        pending, self._pending = self._pending, OrderedDict()
+        keys = list(pending.keys())
+        items = [pending[k] for k in keys]
+        start = time.perf_counter()
+        packed = self._pack(items)
+        metrics.counter("host_crypto_seconds").inc(time.perf_counter() - start)
+        metrics.gauge("auth_wave_queue_depth").set(0)
+        metrics.counter("device_verify_dispatches").inc()
+        metrics.counter("device_verified_signatures").inc(len(items))
+        return keys, items, packed
 
     def _pack(self, items) -> Tuple[List[bytes], List[bytes], List[bytes]]:
         from ..processor.verify import signing_payload, unseal

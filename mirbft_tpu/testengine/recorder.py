@@ -320,7 +320,17 @@ class CryptoConfig:
     auth_wave: int = 128
     auth_floor: int = 16
     lookahead: int = 128
-    kernel: str = "scan"  # sha256 backend: "scan" | "pallas"
+    # sha256 backend: "auto" (measured crossover, ops/crossover.py) |
+    # "scan" | "pallas" | "lanes"
+    kernel: str = "auto"
+    # Adaptive wave sizing (testengine.crypto.WaveController): hash_wave is
+    # the starting size; the controller grows/shrinks it from observed
+    # queue depth and dispatch latency.  False pins the size.
+    adaptive_wave: bool = True
+    # Route waves through the fused hash→verify→quorum pipeline
+    # (ops/fused.py): one device dispatch and one collect per wave instead
+    # of three.  Digests and verdicts stay bit-identical.
+    fused: bool = False
     # > 0: build a jax.sharding.Mesh over this many devices and route BOTH
     # crypto planes' waves through the batch-sharded multi-chip kernels
     # (parallel.sharded_ed25519_verify for verify waves, sharded_sha256 for
@@ -491,6 +501,7 @@ class Recorder:
                 kernel=crypto.kernel,
                 defer_unready=crypto.defer_unready,
                 mesh_devices=crypto.mesh_devices,
+                adaptive=crypto.adaptive_wave,
             )
         else:
             hash_plane = _SHARED_CPU_PLANE
@@ -522,6 +533,13 @@ class Recorder:
             )
             for client_id, pub in signed_pubs.items():
                 auth_plane.register(client_id, pub)
+
+        if crypto.fused and crypto.device:
+            from ..ops.fused import FusedCryptoPipeline
+
+            hash_plane.attach_fused(
+                FusedCryptoPipeline(kernel=crypto.kernel), auth_plane
+            )
 
         nodes = []
         for i, node_config in enumerate(self.node_configs):

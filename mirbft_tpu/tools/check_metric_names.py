@@ -59,6 +59,13 @@ REQUIRED_NAMES = (
     "net_reconnects_total",
     "net_peer_queue_depth",
     "net_peer_up",
+    # Fused device pipeline (ops/fused.py) and adaptive wave sizing
+    # (testengine/crypto.py WaveController): the dispatch counters prove
+    # fused waves actually run, the gauge is the controller's only
+    # externally visible state.
+    "fused_wave_dispatches",
+    "fused_wave_messages",
+    "hash_wave_autotune_size",
 )
 
 

@@ -1,0 +1,221 @@
+"""Fused hash→verify→quorum device wave (ops/fused.py): bit-exactness
+against the pure-host oracle, pool-lease discipline across pipelined waves,
+the adaptive WaveController policy, and the fused plane wired into the
+consensus engine (CryptoConfig(fused=True)).
+
+Under pytest the "device" is the XLA CPU backend (see conftest): the fused
+program, donation gating, staging and collect paths are identical; only the
+lanes-layout case needs a real chip (interpret-mode pallas is pathologically
+slow on CPU, same gate as tests/test_sha256_tpu.py).
+"""
+
+import hashlib
+
+import jax as _jax
+import numpy as np
+import pytest
+
+from mirbft_tpu import metrics
+from mirbft_tpu.ops.ed25519 import keypair_from_seed
+from mirbft_tpu.ops.fused import FusedCryptoPipeline, host_fused_reference
+from mirbft_tpu.testengine import CryptoConfig, Spec
+from mirbft_tpu.testengine.crypto import WaveController
+
+# SHA-256 padding boundaries: 55/56 straddle the one-block limit, 119/120
+# the two-block limit, and so on every 64 bytes.
+BOUNDARY_LENGTHS = (0, 1, 55, 56, 63, 64, 119, 120, 183, 184, 247, 248)
+
+
+def _fresh_states(n_slots, n_digest_slots):
+    return (
+        np.zeros((n_slots, n_digest_slots, 8), dtype=np.uint32),
+        np.zeros((n_slots, n_digest_slots), dtype=np.int32),
+    )
+
+
+def _parity(msgs, signed=None, quorum=None, kernel="auto", n_slots=16,
+            n_digest_slots=2):
+    """One fused dispatch vs the host oracle; asserts every output equal."""
+    pipe = FusedCryptoPipeline(
+        n_slots=n_slots, n_digest_slots=n_digest_slots, kernel=kernel
+    )
+    res = pipe.collect(pipe.dispatch_wave(msgs, signed=signed, quorum=quorum))
+    masks0, counts0 = _fresh_states(n_slots, n_digest_slots)
+    rd, rv, rm, rc, rp, rn = host_fused_reference(
+        msgs, signed, quorum, masks0, counts0
+    )
+    assert res.digests == rd
+    assert list(res.verdicts) == list(rv)
+    dm, dc = pipe.quorum_state()
+    assert (dm == rm).all()
+    assert (dc == rc).all()
+    if quorum:
+        nq = len(quorum)
+        assert (res.posts[:nq] == rp[:nq]).all()
+        assert (res.newbits[:nq] == rn[:nq]).all()
+    return res
+
+
+def test_fused_parity_boundary_lengths():
+    msgs = [
+        bytes([97 + i % 26]) * length
+        for i, length in enumerate(BOUNDARY_LENGTHS)
+    ]
+    _parity(msgs)
+
+
+@pytest.mark.parametrize("batch", [1, 2, 3, 7, 16, 33])
+def test_fused_parity_mixed_batch_sizes(batch):
+    msgs = [b"fused-%d" % i + b"x" * (i * 29 % 200) for i in range(batch)]
+    _parity(msgs)
+
+
+def test_fused_parity_verify_and_gated_quorum():
+    """Signed rows (incl. a forged one) and digest-gated touches (incl. a
+    mismatched claimed digest) match the host oracle exactly."""
+    msgs = [bytes([i + 1]) * (50 + 37 * i) for i in range(7)]
+    pub, sign = keypair_from_seed(b"\x01" * 32)
+    payloads = [b"payload-%d" % i for i in range(3)]
+    sigs = [sign(m) for m in payloads]
+    sigs[1] = b"\x00" * 64  # forged
+    signed = ([pub] * 3, payloads, sigs)
+    good_claim = hashlib.sha256(msgs[2]).digest()
+    quorum = [
+        (5, [(0, 0, 2, good_claim), (1, 0, None, None)]),  # gate passes
+        (9, [(0, 0, 2, b"\xff" * 32)]),  # gate rejects: wrong claim
+        (9, [(1, 0, None, None)]),  # ungated from the rejected source
+    ]
+    res = _parity(msgs, signed=signed, quorum=quorum, n_slots=8)
+    assert list(res.verdicts) == [True, False, True]
+
+
+def test_fused_parity_batch_layout_explicit():
+    """kernel="scan" pins the batch layout regardless of crossover."""
+    msgs = [b"layout-%d" % i * 10 for i in range(12)]
+    _parity(msgs, kernel="scan")
+
+
+@pytest.mark.skipif(
+    _jax.default_backend() != "tpu",
+    reason="interpret-mode pallas is pathologically slow on CPU; the "
+    "lanes-layout fused parity runs compiled on a real chip",
+)
+def test_fused_parity_lanes_layout():
+    msgs = [b"lanes-%d" % i + b"z" * (i % 120) for i in range(100)]
+    pub, sign = keypair_from_seed(b"\x02" * 32)
+    signed = ([pub], [b"m"], [sign(b"m")])
+    quorum = [(3, [(0, 0, 5, hashlib.sha256(msgs[5]).digest())])]
+    _parity(msgs, signed=signed, quorum=quorum, kernel="lanes")
+
+
+def test_fused_lease_discipline_across_pipelined_waves():
+    """Every pipelined wave holds its own pool lease until ITS collect;
+    collects return every lease, and a fresh same-shape dispatch reuses a
+    pooled buffer instead of allocating a fifth one."""
+    pipe = FusedCryptoPipeline(n_slots=4, n_digest_slots=1, kernel="scan")
+    pool = pipe.hasher._pool
+
+    def msgs(k):
+        return [b"lease-%d-%d" % (k, i) for i in range(8)]
+
+    handles = [pipe.dispatch_wave(msgs(k)) for k in range(4)]
+    assert all(h.lease is not None for h in handles)
+    # Four concurrent leases of one shape: four distinct buffers.
+    assert len({id(h.lease.flat) for h in handles}) == 4
+    results = [pipe.collect(h) for h in handles]
+    assert all(h.lease is None for h in handles)
+    for k, res in enumerate(results):
+        assert res.digests == [hashlib.sha256(m).digest() for m in msgs(k)]
+    (key, free), = pool._free.items()
+    assert len(free) == 4  # every lease came back
+    pipe.collect(pipe.dispatch_wave(msgs(9)))
+    assert len(pool._free[key]) == 4  # reused, not grown
+
+
+def test_wave_controller_grows_on_backlog_and_shrinks_when_idle():
+    wc = WaveController(initial=64, floor=16, ceiling=512)
+    assert wc.observe(200, 64, 64e-5) == 128  # queue ≥ 2× size: grow
+    assert wc.observe(600, 128, 128e-5) == 256
+    assert wc.observe(2000, 256, 256e-5) == 512  # ceiling
+    assert wc.observe(9000, 512, 512e-5) == 512  # capped
+    for _ in range(3):
+        assert wc.observe(10, 8, 8e-5) == 512  # idle, but not yet 4 in a row
+    assert wc.observe(10, 8, 8e-5) == 256  # 4th idle wave: shrink
+    assert metrics.gauge("hash_wave_autotune_size").value == 256
+
+
+def test_wave_controller_latency_guard_backs_off():
+    wc = WaveController(initial=64, floor=16, ceiling=512)
+    wc.observe(64, 64, 64e-5)  # per-message best: 1e-5 s
+    # Dispatch latency regressed 5× past best: back off even though the
+    # queue is deep enough to grow.
+    assert wc.observe(512, 128, 128 * 5e-5) == 32
+
+
+def test_wave_controller_respects_floor():
+    wc = WaveController(initial=16, floor=16, ceiling=64)
+    for _ in range(20):
+        wc.observe(0, 0, 0.0)
+    assert wc.size == 16
+
+
+def _run(spec: Spec):
+    metrics.default_registry.reset()
+    recording = spec.recorder().recording()
+    steps = recording.drain_clients(timeout=200_000)
+    finals = sorted(
+        (node.state.checkpoint_seq_no, node.state.checkpoint_hash)
+        for node in recording.nodes
+    )
+    return steps, finals, metrics.snapshot()
+
+
+def test_fused_plane_engine_parity_and_engagement():
+    """CryptoConfig(fused=True): same steps and final hashes as the host
+    path, with fused dispatches actually carrying the traffic."""
+    base = dict(node_count=4, client_count=4, reqs_per_client=20, batch_size=5)
+    steps_host, finals_host, _ = _run(Spec(**base))
+    steps_f, finals_f, snap = _run(
+        Spec(
+            **base,
+            crypto=CryptoConfig(
+                device=True, hash_wave=4, hash_floor=1, fused=True,
+                defer_unready=False,
+            ),
+        )
+    )
+    assert steps_f == steps_host
+    assert finals_f == finals_host
+    assert snap.get("fused_wave_dispatches", 0) > 0
+    assert snap.get("fused_wave_messages", 0) > 0
+
+
+def test_fused_plane_signed_engine_parity():
+    """Signed requests through the fused plane: verify verdicts riding the
+    fused waves agree with the host path's consensus outcome."""
+    base = dict(
+        node_count=4,
+        client_count=2,
+        reqs_per_client=10,
+        batch_size=5,
+        signed_requests=True,
+    )
+    steps_host, finals_host, _ = _run(Spec(**base))
+    steps_f, finals_f, snap = _run(
+        Spec(
+            **base,
+            crypto=CryptoConfig(
+                device=True,
+                hash_wave=4,
+                hash_floor=1,
+                auth_wave=64,  # above the traffic: acc. drains via fused waves
+                auth_floor=4,
+                lookahead=16,
+                fused=True,
+                defer_unready=False,
+            ),
+        )
+    )
+    assert steps_f == steps_host
+    assert finals_f == finals_host
+    assert snap.get("fused_wave_dispatches", 0) > 0

@@ -1,0 +1,84 @@
+"""Measured kernel crossover (ops/crossover.py): the resolution logic is
+pinned on this CPU-only container by injecting backend names and probe
+timings; the actual probe measurements run on the TPU rig (bench).
+
+Load-bearing defaults (tier-1 smoke per the dispatch-gap issue): a default
+``TpuHasher`` resolves to the scan kernel on CPU and to the lanes kernel on
+TPU at production wave sizes; the default verifier is "vpu" off-chip and
+the probe winner on-chip.
+"""
+
+import jax as _jax
+import pytest
+
+from mirbft_tpu.ops.crossover import (
+    hash_crossover_batch,
+    resolve_hash_kernel,
+    resolve_verify_backend,
+)
+from mirbft_tpu.ops.ed25519 import Ed25519BatchVerifier
+from mirbft_tpu.ops.sha256 import TpuHasher
+
+# A probe where one lanes tile costs as much as 300 scan messages:
+# crossover lands at 300 (inside the [TILE/8, TILE] clamp).
+PROBE = (300e-5, 1e-5)
+
+
+def test_default_hasher_resolves_scan_on_cpu():
+    hasher = TpuHasher(min_device_batch=1)
+    assert hasher.kernel == "auto"
+    if _jax.default_backend() == "tpu":
+        assert hasher.kernel_for_batch(4096) == "lanes"
+    else:
+        assert hasher.kernel_for_batch(4096) == "scan"
+        assert hasher.kernel_for_batch(1) == "scan"
+
+
+def test_default_verifier_resolves_vpu_on_cpu():
+    verifier = Ed25519BatchVerifier(min_device_batch=1)
+    assert verifier.kernel == "auto"
+    if _jax.default_backend() != "tpu":
+        assert verifier.resolved_kernel() == "vpu"
+
+
+def test_crossover_batch_off_tpu_is_never():
+    assert hash_crossover_batch(backend="cpu") == 1 << 30
+
+
+def test_crossover_batch_from_injected_probe():
+    assert hash_crossover_batch(backend="tpu", probe=PROBE) == 300
+    # Clamped below an eighth of a tile (padding waste dominates) ...
+    assert hash_crossover_batch(backend="tpu", probe=(1e-5, 1e-5)) == 128
+    # ... and above one tile (lanes amortizes by construction).
+    assert hash_crossover_batch(backend="tpu", probe=(1.0, 1e-9)) == 1024
+
+
+def test_resolve_hash_kernel_applies_crossover():
+    assert resolve_hash_kernel("auto", 300, backend="tpu", probe=PROBE) == "lanes"
+    assert resolve_hash_kernel("auto", 299, backend="tpu", probe=PROBE) == "scan"
+    assert resolve_hash_kernel("auto", 4096, backend="cpu") == "scan"
+
+
+@pytest.mark.parametrize("explicit", ["scan", "pallas", "lanes"])
+def test_resolve_hash_kernel_explicit_passthrough(explicit):
+    assert resolve_hash_kernel(explicit, 1, backend="tpu", probe=PROBE) == explicit
+    assert resolve_hash_kernel(explicit, 1 << 20, backend="cpu") == explicit
+
+
+def test_resolve_hash_kernel_env_override(monkeypatch):
+    monkeypatch.setenv("MIRBFT_TPU_HASH_KERNEL", "lanes")
+    assert resolve_hash_kernel("auto", 1, backend="cpu") == "lanes"
+    monkeypatch.setenv("MIRBFT_TPU_HASH_KERNEL", "scan")
+    assert resolve_hash_kernel("auto", 1 << 20, backend="tpu", probe=PROBE) == "scan"
+
+
+def test_resolve_verify_backend_from_injected_probe():
+    assert resolve_verify_backend("auto", backend="cpu") == "vpu"
+    assert resolve_verify_backend("auto", backend="tpu", probe=(2.0, 1.0)) == "mxu"
+    assert resolve_verify_backend("auto", backend="tpu", probe=(1.0, 2.0)) == "vpu"
+    assert resolve_verify_backend("mxu", backend="cpu") == "mxu"
+
+
+def test_resolve_verify_backend_env_override(monkeypatch):
+    monkeypatch.setenv("MIRBFT_TPU_VERIFY_KERNEL", "mxu")
+    assert resolve_verify_backend("auto", backend="cpu") == "mxu"
