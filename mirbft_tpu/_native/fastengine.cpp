@@ -5358,6 +5358,16 @@ struct EpochTarget {
                 const EpochCfgS &epoch_config = network_new_epoch
                                                     ? network_new_epoch->config
                                                     : resume_epoch_config;
+                if (commit_state->low_watermark >=
+                    epoch_config.planned_expiration) {
+                    // The epoch expired while we were down or state
+                    // transferring past it: no window left to resume
+                    // (activating would assert in advance()).  End it so
+                    // the tracker rolls to an epoch change targeting
+                    // max_correct_epoch (epoch_target.py READY arm).
+                    state = ETS::DONE;
+                    continue;
+                }
                 active_epoch = std::make_shared<ActiveEpoch>(
                     ctx, epoch_config, persisted, node_buffers, commit_state,
                     client_tracker, my_config);

@@ -46,7 +46,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass, field, fields
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import metrics as metrics_mod
@@ -117,6 +117,19 @@ class HealthThresholds:
     buffer_growth_observations: int = 5
     # ...counted only above this floor (small transients are normal).
     buffer_growth_floor_bytes: int = 256 * 1024
+
+    def as_dict(self) -> Dict[str, int]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, int]) -> "HealthThresholds":
+        """Build from a JSON dict, ignoring unknown keys — the shape
+        ``tools/mirnet.py`` ships in ``cluster.json`` so wire deployments
+        (one observation per 20 ms tick, not per sim event) can scale the
+        observation counts, and the offline doctor can judge the recorded
+        run by the very thresholds the live run used."""
+        known = {f.name for f in fields(cls)}
+        return cls(**{k: int(v) for k, v in d.items() if k in known})
 
 
 @dataclass
@@ -198,6 +211,19 @@ class HealthMonitor:
         self._growth_count = 0
         self._growth_since: Optional[float] = None
         self._growth_flagged = False
+
+    def configure(
+        self,
+        thresholds: Optional[HealthThresholds] = None,
+        num_nodes: Optional[int] = None,
+    ) -> None:
+        """Late-bind thresholds/num_nodes on an already-constructed monitor
+        (``Node`` builds its monitor with defaults; ``tools/mirnet.py``
+        reconfigures it from ``cluster.json`` before processing starts)."""
+        if thresholds is not None:
+            self.thresholds = thresholds
+        if num_nodes is not None:
+            self.num_nodes = num_nodes
 
     # --- emission (all three channels) ---
 

@@ -116,6 +116,7 @@ class TcpTransport:
         tracer: Optional[tracing.Tracer] = None,
         health_monitor=None,
         logger=None,
+        fault_injector=None,
     ):
         self.node_id = node_id
         self.fingerprint = fingerprint
@@ -128,6 +129,13 @@ class TcpTransport:
         self.tracer = tracer if tracer is not None else tracing.default_tracer
         self.health_monitor = health_monitor
         self.logger = logger
+        # Optional wire-fault injector (net/faults.py): when set, every
+        # outbound frame routes through its per-link schedule before the
+        # peer queue, and partitioned links refuse to dial/drain so the
+        # outage is a real TCP outage (docs/FAULTS.md).
+        self.fault_injector = fault_injector
+        if fault_injector is not None:
+            fault_injector.bind(self._enqueue_frame)
         self._rng = random.Random(node_id)  # jitter only; never protocol-visible
 
         self._peers: Dict[int, _Peer] = {
@@ -190,6 +198,8 @@ class TcpTransport:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.fault_injector is not None:
+            self.fault_injector.stop()
         for peer in self._peers.values():
             with peer.cond:
                 peer.cond.notify_all()
@@ -214,10 +224,18 @@ class TcpTransport:
 
     def send(self, dest: int, msg) -> None:
         """Non-blocking enqueue; drops on overflow (Link contract)."""
-        peer = self._peers.get(dest)
-        if peer is None:
+        if dest not in self._peers:
             return  # self or unknown peer: nothing to do
         frame = encode_frame(KIND_MSG, wire.encode(msg))
+        if self.fault_injector is not None:
+            self.fault_injector.submit(dest, frame)
+        else:
+            self._enqueue_frame(dest, frame)
+
+    def _enqueue_frame(self, dest: int, frame: bytes) -> None:
+        peer = self._peers.get(dest)
+        if peer is None:
+            return
         with peer.cond:
             if peer.queued_bytes + len(frame) > self.queue_budget_bytes:
                 self._tx_dropped.inc()
@@ -267,6 +285,10 @@ class TcpTransport:
             self._enter_backoff(peer, up_gauge, was_up=True)
 
     def _dial(self, peer: _Peer) -> Optional[socket.socket]:
+        if self.fault_injector is not None and self.fault_injector.link_blocked(
+            peer.peer_id
+        ):
+            return None  # partitioned: behaves exactly like a dead network
         try:
             sock = socket.create_connection(
                 peer.addr, timeout=self.dial_timeout_s
@@ -289,6 +311,14 @@ class TcpTransport:
             "net_peer_queue_depth", labels={"peer": str(peer.peer_id)}
         )
         while not self._stop.is_set():
+            if (
+                self.fault_injector is not None
+                and self.fault_injector.link_blocked(peer.peer_id)
+            ):
+                # A partition starting mid-connection severs the link the
+                # way a cable pull would: the sender reconnects into the
+                # (refused) dial path and enters backoff.
+                raise OSError("link partitioned (fault injection)")
             with peer.cond:
                 if not peer.frames:
                     peer.cond.wait(timeout=0.2)

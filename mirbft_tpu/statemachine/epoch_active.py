@@ -279,11 +279,19 @@ class ActiveEpoch:
             buffer = self.preprepare_buffers[bucket]
             next_msg: Optional[Msg] = msg
             while next_msg is not None:
+                own = self.sequence(next_msg.seq_no).owner == self.my_config.id
+                before = self.lowest_unallocated[bucket]
                 actions.concat(
                     self.apply_preprepare_msg(
                         source, next_msg.seq_no, list(next_msg.batch)
                     )
                 )
+                if not own and self.lowest_unallocated[bucket] == before:
+                    # Rejected (leader demoted, apply_preprepare_msg): the
+                    # slot is still unallocated, so the cursor must not move
+                    # past it — a later valid Preprepare for this seq_no has
+                    # to remain CURRENT, not trip the in-order guard.
+                    break
                 buffer.next_seq_no += len(self.buckets)
                 next_msg = buffer.buffer.next(self.filter)
         elif isinstance(msg, Prepare):
@@ -426,12 +434,33 @@ class ActiveEpoch:
             raise AssertionError(
                 "step should defer all but the next expected preprepare"
             )
-        self.lowest_unallocated[bucket] += len(self.buckets)
 
-        # Validates in-order request consumption and allocates the sequence;
-        # ValueError here means a protocol-invalid batch from a byzantine
-        # leader (the reference panics with a TODO to suspect instead).
-        return self.outstanding_reqs.apply_acks(bucket, seq, batch)
+        # Validates in-order request consumption and allocates the sequence.
+        # ValueError means a protocol-invalid batch (unknown client,
+        # out-of-order req_no) from the bucket's leader: the reference
+        # panics here with a "TODO to suspect instead" — this emits the
+        # Suspect.  apply_acks is validate-then-apply, so the rejected
+        # batch left no partial state; the sequence stays unallocated and
+        # the view change demotes the leader instead of the crash demoting
+        # this node.
+        try:
+            actions = self.outstanding_reqs.apply_acks(bucket, seq, batch)
+        except ValueError as err:
+            suspect = Suspect(epoch=self.epoch_config.number)
+            actions = Actions()
+            actions.send(self.network_config.nodes, suspect)
+            actions.concat(self.persisted.add_suspect(suspect))
+            if self.logger is not None:
+                self.logger.warn(
+                    "suspecting epoch: protocol-invalid preprepare from leader",
+                    epoch=self.epoch_config.number,
+                    leader=source,
+                    seq_no=seq_no,
+                    error=str(err),
+                )
+            return actions
+        self.lowest_unallocated[bucket] += len(self.buckets)
+        return actions
 
     def apply_prepare_msg(self, source: int, seq_no: int, digest: bytes) -> Actions:
         return self.sequence(seq_no).apply_prepare_msg(source, digest)

@@ -715,6 +715,18 @@ class EpochTarget:
                     if self.network_new_epoch is not None
                     else self.resume_epoch_config
                 )
+                if (
+                    self.commit_state.low_watermark
+                    >= epoch_config.planned_expiration
+                ):
+                    # The epoch expired while we were down or state
+                    # transferring past it: there is no window left to
+                    # resume (activating would assert in advance()).  End
+                    # it so the tracker rolls to an epoch change — which
+                    # targets max_correct_epoch, rejoining the cluster's
+                    # current epoch instead of replaying the dead one.
+                    self.state = EpochTargetState.DONE
+                    continue
                 self.active_epoch = ActiveEpoch(
                     epoch_config,
                     self.persisted,

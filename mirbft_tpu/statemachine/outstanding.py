@@ -100,23 +100,40 @@ class AllOutstandingReqs:
         and allocate the sequence (reference outstanding.go:120-151).
 
         Raises ValueError for protocol-invalid batches (unknown client,
-        out-of-order req_no) — the caller treats that as a byzantine leader.
+        out-of-order req_no) — the caller treats that as a byzantine leader
+        and emits a Suspect (epoch_active.apply_preprepare_msg).  Validation
+        runs as a separate pass over simulated cursors so a rejected batch
+        leaves the bookkeeping untouched: the node keeps running on exactly
+        the state it had before the bad Preprepare arrived.
         """
         clients = self.buckets.get(bucket)
         if clients is None:
             raise AssertionError(f"no such bucket {bucket}")
 
-        outstanding: Set[RequestAck] = set()
+        # Validate pass: no mutation.  Simulated per-client cursors advance
+        # the same way the apply pass does (+num_buckets, then skip
+        # already-committed req_nos).
+        cursors: Dict[int, int] = {}
         for req in batch:
             co = clients.get(req.client_id)
             if co is None:
                 raise ValueError(f"no such client {req.client_id}")
-            if co.next_req_no != req.req_no:
+            expected = cursors.get(req.client_id, co.next_req_no)
+            if expected != req.req_no:
                 raise ValueError(
                     f"expected client {req.client_id} next request for bucket "
-                    f"{bucket} to have req_no {co.next_req_no} but got "
+                    f"{bucket} to have req_no {expected} but got "
                     f"{req.req_no}"
                 )
+            nxt = expected + co.num_buckets
+            while is_committed(nxt, co.client):
+                nxt += co.num_buckets
+            cursors[req.client_id] = nxt
+
+        # Apply pass: cannot fail.
+        outstanding: Set[RequestAck] = set()
+        for req in batch:
+            co = clients[req.client_id]
             if req in self.correct_requests:
                 del self.correct_requests[req]
             else:

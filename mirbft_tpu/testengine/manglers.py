@@ -417,6 +417,99 @@ class DropMessages:
         return [MangleResult(event)]
 
 
+# ---------------------------------------------------------------------------
+# Spec serialization: the kind/params descriptors are JSON round-trippable,
+# so a DSL-built program can be shipped to another process (tools/mirnet.py
+# sends Byzantine wire programs to node children via cluster.json) and
+# rebuilt bit-identically (net/byzantine.py compiles the result to wire
+# faults).  crash_and_restart_after and .do(custom) carry live objects and
+# are refused.
+# ---------------------------------------------------------------------------
+
+_MSG_TYPE_BY_NAME = {
+    cls.__name__: cls
+    for cls in (
+        AckBatch,
+        AckMsg,
+        CheckpointMsg,
+        Commit,
+        EpochChange,
+        EpochChangeAck,
+        FetchBatch,
+        ForwardBatch,
+        MsgBatch,
+        NewEpoch,
+        NewEpochEcho,
+        NewEpochReady,
+        Preprepare,
+        Prepare,
+        Suspect,
+    )
+}
+
+_SPEC_ACTIONS = ("drop", "jitter", "duplicate", "delay")
+_ENTRY_PREDICATES = ("msgs", "node_startup", "client_proposal")
+
+
+def spec_from_mangler(mangler: EventMangling) -> dict:
+    """JSON-ready descriptor of a DSL-built mangler (inverse:
+    :func:`mangler_from_spec`)."""
+    if mangler.action_kind not in _SPEC_ACTIONS:
+        raise ValueError(
+            f"mangler action {mangler.action_kind!r} is not serializable"
+        )
+    predicates = []
+    for p in mangler.matcher._predicates:
+        params = p.params
+        if p.kind == "of_type":
+            params = tuple(t.__name__ for t in params)
+        predicates.append({"kind": p.kind, "params": list(params)})
+    return {
+        "wrap": mangler.wrap,
+        "predicates": predicates,
+        "action": {
+            "kind": mangler.action_kind,
+            "params": list(mangler.action_params),
+        },
+    }
+
+
+def mangler_from_spec(spec: dict) -> EventMangling:
+    """Rebuild a mangler from :func:`spec_from_mangler` output (fresh latch
+    state — Until/After start unmatched)."""
+    cond: Optional[Conditional] = None
+    for pd in spec["predicates"]:
+        kind, params = pd["kind"], list(pd["params"])
+        if cond is None:
+            if kind not in _ENTRY_PREDICATES:
+                raise ValueError(
+                    f"spec must start with one of {_ENTRY_PREDICATES}, "
+                    f"got {kind!r}"
+                )
+            cond = getattr(matching, kind)()
+            continue
+        if kind == "of_type":
+            try:
+                types = tuple(_MSG_TYPE_BY_NAME[name] for name in params)
+            except KeyError as err:
+                raise ValueError(f"unknown message type {err.args[0]!r}")
+            cond = cond.of_type(*types)
+        elif kind in ("from_self", "from_nodes", "to_nodes", "at_percent",
+                      "with_sequence", "with_epoch", "from_client"):
+            cond = getattr(cond, kind)(*params)
+        else:
+            raise ValueError(f"unknown predicate kind {kind!r}")
+    if cond is None:
+        raise ValueError("spec has no predicates")
+    wrap = {"for": For, "until": Until, "after": After}.get(spec["wrap"])
+    if wrap is None:
+        raise ValueError(f"unknown wrap {spec['wrap']!r}")
+    action = spec["action"]
+    if action["kind"] not in _SPEC_ACTIONS:
+        raise ValueError(f"unknown action kind {action['kind']!r}")
+    return getattr(wrap(cond), action["kind"])(*action["params"])
+
+
 def For(matcher: Conditional) -> _Mangling:
     """Apply whenever the condition matches (reference manglers.go:74-79)."""
     return _Mangling(matcher, "for")

@@ -66,6 +66,14 @@ REQUIRED_NAMES = (
     "fused_wave_dispatches",
     "fused_wave_messages",
     "hash_wave_autotune_size",
+    # Fault-injection plane (net/faults.py, net/byzantine.py,
+    # tools/mirnet.py scenarios): the injected-fault ledger is one half of
+    # the doctor-judgment contract (docs/FAULTS.md), the verdict gauge is
+    # how soak results surface — a refactor dropping either breaks the
+    # machine-checkable injected-vs-attributed accounting.
+    "net_faults_injected_total",
+    "net_frames_corrupted_total",
+    "scenario_verdict",
 )
 
 

@@ -20,27 +20,41 @@ fault attribution — for any recorded run, long after it happened.  Exits 1
 when anomalies were found (0 on a clean bill), so it doubles as a CI gate.
 ``--doctor-json OUT.json`` additionally writes the full report as JSON.
 
+Pointing ``--doctor`` at a **mirnet deployment directory** (instead of one
+log file) runs :func:`doctor_deployment`: every node's per-boot event logs
+(``node-<i>/events-*.gz``) are replayed through a fresh state machine per
+boot and one monitor per node, using the thresholds the live run shipped in
+``cluster.json``; the replay ledger is then merged with each node's final
+``metrics.prom`` fault counters (which cover transport-only faults like
+``peer_unreachable`` that never enter the event log).  Truncated logs —
+a SIGKILLed node leaves a torn gzip — are tolerated and reported, never
+fatal.  This is the judge ``tools/mirnet.py --scenario`` runs verdicts
+against (docs/FAULTS.md "Doctor-judgment contract").
+
 Usage:
     python -m mirbft_tpu.tools.mircat LOG.gz [--node N ...]
         [--event-type TYPE ...] [--step-type TYPE ...]
         [--interactive] [--status-index IDX ...] [--verbose-text]
         [--trace OUT.json] [--doctor] [--doctor-json OUT.json]
+    python -m mirbft_tpu.tools.mircat DEPLOY_DIR --doctor
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
 from collections import defaultdict
+from pathlib import Path
 from typing import Dict, List, Optional, Set, Tuple
 
 from .. import metrics, tracing
 from .. import state as st
 from .. import status as status_mod
 from ..eventlog import read_event_log
-from ..health import HealthMonitor
+from ..health import HealthMonitor, HealthThresholds
 from ..statemachine.machine import MachineState, StateMachine
 from .textmarshal import compact_text
 
@@ -130,8 +144,204 @@ def _matches(record: st.RecordedEvent, args: argparse.Namespace) -> bool:
     return True
 
 
+# ---------------------------------------------------------------------------
+# Deployment doctor: judge a whole mirnet run directory
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s+(-?[0-9.eE+]+|NaN)\s*$"
+)
+_PROM_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse_prom_samples(
+    text: str, name: str
+) -> List[Tuple[Dict[str, str], float]]:
+    """Parse a Prometheus text snapshot into ``[(labels, value), ...]`` for
+    one metric name (label-aware, unlike a prefix-sum; used by the doctor
+    and the mirnet scenario judge)."""
+    out: List[Tuple[Dict[str, str], float]] = []
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        m = _PROM_LINE.match(line)
+        if m is None or m.group(1) != name:
+            continue
+        try:
+            value = float(m.group(3))
+        except ValueError:
+            continue
+        out.append((dict(_PROM_LABEL.findall(m.group(2) or "")), value))
+    return out
+
+
+def _node_prom(node_dir: Path, name: str) -> List[Tuple[Dict[str, str], float]]:
+    path = node_dir / "metrics.prom"
+    if not path.exists():
+        return []
+    return parse_prom_samples(path.read_text(), name)
+
+
+def doctor_deployment(
+    root, thresholds: Optional[HealthThresholds] = None
+) -> dict:
+    """Judge a mirnet deployment directory (module docstring).
+
+    Two evidence streams, merged per node:
+
+    * **Replay** — each boot's event log (``node-<i>/events-*.gz``) through
+      a fresh state machine and the node's monitor, clock pinned to record
+      timestamps.  Gives anomalies, the fault ledger for everything that
+      crossed the state machine (suspicion votes, invalid digests), and the
+      epoch timeline.
+    * **Live counters** — the node's last ``metrics.prom``: covers faults
+      the transport attributed without a state-machine event
+      (``peer_unreachable``) and live anomalies.  Merged with the replay
+      ledger by max per (peer, kind) — the streams overlap on
+      state-machine-visible kinds, so summing would double count.
+
+    A torn log (SIGKILL mid-write) terminates that boot's replay and is
+    listed in ``truncated_logs``; it never fails the doctor.
+    """
+    root = Path(root)
+    cluster = {}
+    cluster_path = root / "cluster.json"
+    if cluster_path.exists():
+        cluster = json.loads(cluster_path.read_text())
+    if thresholds is None:
+        thresholds = HealthThresholds.from_dict(cluster.get("thresholds") or {})
+    num_nodes = cluster.get("node_count")
+
+    per_node: Dict[int, dict] = {}
+    aggregate_faults: Dict[str, float] = {}
+    truncated: List[str] = []
+    total_anomalies = 0
+
+    for node_dir in sorted(root.glob("node-*")):
+        try:
+            node_id = int(node_dir.name.split("-", 1)[1])
+        except ValueError:
+            continue
+        clock = {"t": 0.0}
+        monitor = HealthMonitor(
+            node_id,
+            registry=metrics.Registry(),
+            clock=lambda: clock["t"],
+            thresholds=thresholds,
+            num_nodes=num_nodes,
+        )
+        timeline: List[Tuple[float, int]] = []
+        boots = 0
+        for log_path in sorted(node_dir.glob("events-*.gz")):
+            boots += 1
+            sm = StateMachine()
+            try:
+                with open(log_path, "rb") as f:
+                    for record in read_event_log(f):
+                        clock["t"] = float(record.time)
+                        actions = sm.apply_event(record.state_event)
+                        monitor.observe_events((record.state_event,), actions)
+                        if sm.state == MachineState.INITIALIZED:
+                            epoch = sm.epoch_tracker.current_epoch.number
+                            if not timeline or timeline[-1][1] != epoch:
+                                timeline.append((float(record.time), epoch))
+                        if isinstance(record.state_event, st.EventTickElapsed):
+                            monitor.observe_snapshot(
+                                status_mod.snapshot(sm), now=float(record.time)
+                            )
+            except Exception as exc:  # torn gzip / partial frame after SIGKILL
+                truncated.append(f"{log_path}: {exc!r}")
+
+        live_faults: Dict[Tuple[int, str], float] = {}
+        for labels, value in _node_prom(node_dir, "peer_faults_total"):
+            if "peer" in labels and "kind" in labels:
+                key = (int(labels["peer"]), labels["kind"])
+                live_faults[key] = live_faults.get(key, 0.0) + value
+        live_anomalies: Dict[str, float] = {}
+        for labels, value in _node_prom(node_dir, "anomalies_total"):
+            if "kind" in labels and value:
+                live_anomalies[labels["kind"]] = (
+                    live_anomalies.get(labels["kind"], 0.0) + value
+                )
+
+        merged: Dict[Tuple[int, str], float] = {}
+        for key in set(monitor.faults) | set(live_faults):
+            merged[key] = max(
+                float(monitor.faults.get(key, 0)), live_faults.get(key, 0.0)
+            )
+        report = monitor.report()
+        node_anomalies = max(
+            report["anomaly_count"], int(sum(live_anomalies.values()))
+        )
+        total_anomalies += node_anomalies
+        for (peer, kind), count in merged.items():
+            agg_key = f"{peer}:{kind}"
+            aggregate_faults[agg_key] = aggregate_faults.get(agg_key, 0.0) + count
+        per_node[node_id] = {
+            "healthy": node_anomalies == 0 and not merged,
+            "anomaly_count": node_anomalies,
+            "anomaly_kinds": sorted(
+                {a.kind for a in monitor.anomalies} | set(live_anomalies)
+            ),
+            "faults": {f"{p}:{k}": c for (p, k), c in sorted(merged.items())},
+            "max_epoch": max((e for _, e in timeline), default=0),
+            "epoch_timeline": [{"time": t, "epoch": e} for t, e in timeline],
+            "boots": boots,
+            "stall_windows": report["stall_windows"],
+            "observations": report["observations"],
+        }
+
+    healthy = total_anomalies == 0 and not aggregate_faults
+    return {
+        "root": str(root),
+        "healthy": healthy,
+        "anomaly_count": total_anomalies,
+        "faults": dict(sorted(aggregate_faults.items())),
+        "per_node": per_node,
+        "truncated_logs": truncated,
+    }
+
+
+def _print_deployment_report(report: dict) -> None:
+    for node_id in sorted(report["per_node"]):
+        node = report["per_node"][node_id]
+        print(
+            f"node {node_id}: "
+            f"{'HEALTHY' if node['healthy'] else 'UNHEALTHY'} "
+            f"({node['anomaly_count']} anomalies, {node['boots']} boots, "
+            f"max_epoch={node['max_epoch']})"
+        )
+        for kind in node["anomaly_kinds"]:
+            print(f"  anomaly kind: {kind}")
+        for key, count in node["faults"].items():
+            peer, kind = key.split(":", 1)
+            print(f"  fault: peer {peer} {kind} x{count:g}")
+    for line in report["truncated_logs"]:
+        print(f"truncated log (tolerated): {line}")
+    print(
+        f"verdict: {'HEALTHY' if report['healthy'] else 'UNHEALTHY'} "
+        f"({report['anomaly_count']} anomalies, "
+        f"{len(report['faults'])} fault keys across "
+        f"{len(report['per_node'])} nodes)"
+    )
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _parse_args(argv)
+
+    if Path(args.log).is_dir():
+        if not (args.doctor or args.doctor_json):
+            print(
+                "mircat: directory input requires --doctor", file=sys.stderr
+            )
+            return 2
+        report = doctor_deployment(args.log)
+        _print_deployment_report(report)
+        if args.doctor_json:
+            with open(args.doctor_json, "w") as f:
+                json.dump(report, f, indent=2)
+            print(f"doctor report -> {args.doctor_json}")
+        return 0 if report["healthy"] else 1
 
     machines: Dict[int, StateMachine] = defaultdict(StateMachine)
     replay_time: Dict[int, float] = defaultdict(float)

@@ -1,6 +1,6 @@
 """mirnet: multi-process deployment harness over real localhost TCP.
 
-One module, two roles:
+One module, three roles:
 
 * **Parent (default)** — reserves N ports, writes ``cluster.json``, spawns
   one OS process per node (``python -m mirbft_tpu.tools.mirnet --node i``),
@@ -17,22 +17,35 @@ One module, two roles:
   over :class:`~mirbft_tpu.net.tcp.TcpTransport` with durable WAL +
   request store under ``<dir>/node-<i>/``, appends every applied batch to
   ``commits.log``, snapshots ``metrics.prom`` twice a second, and exits
-  cleanly on SIGTERM.
+  cleanly on SIGTERM.  When the cluster config asks for it, the child also
+  wires a :class:`~mirbft_tpu.net.faults.FaultInjector` into its transport
+  (polling ``<dir>/faults.json`` for mid-run schedule changes), wraps its
+  link in a :class:`~mirbft_tpu.net.byzantine.ByzantineLink`, and records
+  its event stream to ``events-<boot>.gz`` for the doctor.
+* **Scenario (``--scenario name``)** — fault-injection choreography
+  (docs/FAULTS.md): the parent drives partition/heal/flap/byzantine/kill
+  scripts against a fully instrumented cluster, then judges the outcome
+  with the deployment doctor (``mircat --doctor``): bit-identical
+  agreement, anomaly budget, and injected-fault-to-attributed-fault
+  accounting, written to ``scenario.json`` and the ``scenario_verdict``
+  gauge.
 
 The harness is also importable: tests and ``bench.py`` call
-:func:`run_deployment` directly (see tests/test_mirnet.py and the
-``net_loopback_4n_commit_s`` bench key).
+:func:`run_deployment` and :func:`run_scenario` directly (see
+tests/test_mirnet.py and the ``net_loopback_4n_commit_s`` bench key).
 
 Usage::
 
     python -m mirbft_tpu.tools.mirnet --nodes 4 --reqs 20 --kill-restart
+    python -m mirbft_tpu.tools.mirnet --scenario partition-minority
+    python -m mirbft_tpu.tools.mirnet --list-scenarios
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import os
+import random
 import signal
 import socket
 import struct
@@ -42,7 +55,7 @@ import tempfile
 import threading
 import time
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 # Client-frame payloads: 8-byte big-endian req_no + opaque request body.
 # Replies are a 1-byte status.
@@ -52,14 +65,95 @@ CLIENT_BUSY = b"\x00"
 
 _METRICS_SNAPSHOT_S = 0.5
 _PROPOSE_RETRY_S = 10.0
+# How often a child re-reads faults.json for choreography changes.
+_FAULT_POLL_S = 0.1
+
+# Health thresholds for wire scenarios: the live tick period is 0.02s (one
+# observation per tick), so the simulator-calibrated defaults (~6
+# observations) would flag sub-200ms hiccups.  These scale the windows to
+# ~3-4s of wall clock, which is noise-immune on a loaded CI host while
+# still far below any real stall.
+_WIRE_THRESHOLDS = {
+    "stall_observations": 150,
+    "checkpoint_stalled_observations": 150,
+    "starvation_observations": 200,
+    "buffer_growth_observations": 125,
+}
+
+# Default node config for steady-state scenarios: suspicion exists but is
+# slow enough (200 ticks = 4s) that a healthy wire run never trips it.
+_STEADY_CONFIG = {"suspect_ticks": 200}
+# Scenarios that *want* a view change: suspect fast, but give the epoch
+# change itself room to complete.
+_VIEWCHANGE_CONFIG = {"suspect_ticks": 25, "new_epoch_timeout_ticks": 100}
 
 
 def _cluster_path(root: Path) -> Path:
     return root / "cluster.json"
 
 
+def _faults_path(root: Path) -> Path:
+    return root / "faults.json"
+
+
 def _node_dir(root: Path, node_id: int) -> Path:
     return root / f"node-{node_id}"
+
+
+def _write_json_atomic(path: Path, obj: dict) -> None:
+    """Readers (polling children) never see a torn file."""
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(json.dumps(obj))
+    tmp.replace(path)
+
+
+def _write_cluster(
+    root: Path,
+    node_count: int,
+    ports: List[int],
+    client_ids: List[int],
+    *,
+    seed: int = 0,
+    faults: bool = False,
+    record_events: bool = False,
+    thresholds: Optional[dict] = None,
+    node_config: Optional[dict] = None,
+    byzantine: Optional[dict] = None,
+    unreachable_after_s: float = 5.0,
+) -> None:
+    """``cluster.json``: everything a child needs to boot.  The fault
+    plane keys are optional — plain deployments (``run_deployment``) leave
+    them at their inert defaults."""
+    _write_json_atomic(
+        _cluster_path(root),
+        {
+            "node_count": node_count,
+            "client_ids": client_ids,
+            "ports": {str(i): ports[i] for i in range(node_count)},
+            "seed": seed,
+            "faults": faults,
+            "record_events": record_events,
+            "thresholds": thresholds,
+            "node_config": node_config,
+            "byzantine": {
+                str(k): v for k, v in (byzantine or {}).items()
+            },
+            "unreachable_after_s": unreachable_after_s,
+        },
+    )
+
+
+def _load_fault_plan(root: Path, node_id: int):
+    """``(version, FaultPlan)`` for one node from ``faults.json``;
+    tolerant of a missing or half-written file (returns an inert plan)."""
+    from mirbft_tpu.net.faults import FaultPlan
+
+    try:
+        doc = json.loads(_faults_path(root).read_text())
+        plan = doc.get("plans", {}).get(str(node_id), {})
+        return int(doc.get("version", 0)), FaultPlan.from_dict(plan)
+    except (OSError, ValueError):
+        return -1, FaultPlan()
 
 
 def _reserve_ports(count: int) -> List[int]:
@@ -130,6 +224,7 @@ def run_node(root: Path, node_id: int) -> int:
     ``<root>/cluster.json``, serving protocol traffic and client frames
     until SIGTERM."""
     from mirbft_tpu.config import Config, standard_initial_network_state
+    from mirbft_tpu.health import HealthThresholds
     from mirbft_tpu.net.tcp import TcpTransport, config_fingerprint
     from mirbft_tpu.node import Node, ProcessorConfig
     from mirbft_tpu.ops import CpuHasher
@@ -147,23 +242,73 @@ def run_node(root: Path, node_id: int) -> int:
     marker = ndir / "initialized"
     restarting = marker.exists()
 
+    injector = None
+    faults_version = -1
+    if cluster.get("faults"):
+        from mirbft_tpu.net.faults import FaultInjector
+
+        faults_version, plan = _load_fault_plan(root, node_id)
+        injector = FaultInjector(node_id, plan)
+
     transport = TcpTransport(
         node_id,
         peers={pid: ("127.0.0.1", port) for pid, port in ports.items()},
         listen_port=ports[node_id],
         fingerprint=config_fingerprint(network_state),
+        unreachable_after_s=float(cluster.get("unreachable_after_s", 5.0)),
+        fault_injector=injector,
     )
+
+    link = transport
+    byz_link = None
+    byz_spec = (cluster.get("byzantine") or {}).get(str(node_id))
+    if byz_spec is not None:
+        from mirbft_tpu.net.byzantine import ByzantineBehaviors, ByzantineLink
+
+        byz_link = ByzantineLink(
+            transport,
+            node_id,
+            ByzantineBehaviors.from_dict(byz_spec),
+            seed=int(cluster.get("seed", 0)),
+        )
+        link = byz_link
+
+    recorder = None
+    events_file = None
+    if cluster.get("record_events"):
+        from mirbft_tpu.eventlog.record import Recorder
+
+        boot = len(list(ndir.glob("events-*.gz")))
+        events_file = open(ndir / f"events-{boot:03d}.gz", "wb")
+        recorder = Recorder(
+            node_id,
+            events_file,
+            # Monotonic ms: the doctor pins its replay clock to these.
+            time_source=lambda: time.monotonic_ns() // 1_000_000,
+            retain_request_data=True,
+        )
+
+    cfg = {"id": node_id, "batch_size": 1}
+    cfg.update(cluster.get("node_config") or {})
     app = _CommitLogApp(ndir / "commits.log")
     node = Node(
         node_id,
-        Config(id=node_id, batch_size=1),
+        Config(**cfg),
         ProcessorConfig(
-            link=transport,
+            link=link,
             hasher=CpuHasher(),
             app=app,
             wal=WAL(str(ndir / "wal")),
             request_store=Store(str(ndir / "reqs.db")),
+            interceptor=recorder,
         ),
+    )
+    thresholds = cluster.get("thresholds")
+    node.health_monitor.configure(
+        thresholds=(
+            HealthThresholds.from_dict(thresholds) if thresholds else None
+        ),
+        num_nodes=node_count,
     )
     transport.health_monitor = node.health_monitor
 
@@ -198,19 +343,44 @@ def run_node(root: Path, node_id: int) -> int:
         marker.write_text("1")
 
     metrics_path = ndir / "metrics.prom"
-    while not stop.is_set():
+
+    def snapshot_metrics() -> None:
         # Atomic snapshot: readers (the parent) never see a torn file.
         tmp = metrics_path.with_suffix(".prom.tmp")
         tmp.write_text(node.metrics_text())
         tmp.replace(metrics_path)
-        err = node.notifier.err()
-        if err is not None:
-            print(f"node {node_id} failed: {err!r}", file=sys.stderr)
-            break
-        stop.wait(_METRICS_SNAPSHOT_S)
+
+    next_snapshot = 0.0
+    while not stop.is_set():
+        now = time.monotonic()
+        if now >= next_snapshot:
+            snapshot_metrics()
+            next_snapshot = now + _METRICS_SNAPSHOT_S
+            err = node.notifier.err()
+            if err is not None:
+                print(f"node {node_id} failed: {err!r}", file=sys.stderr)
+                break
+        if injector is not None:
+            version, plan = _load_fault_plan(root, node_id)
+            if version != faults_version:
+                faults_version = version
+                injector.reconfigure(plan)
+        stop.wait(_FAULT_POLL_S)
 
     node.stop()
     transport.stop()
+    if byz_link is not None:
+        byz_link.stop()
+    if recorder is not None:
+        try:
+            recorder.stop()
+        except RuntimeError:
+            pass  # writer already failed; the log tail is simply torn
+        events_file.close()
+    try:
+        snapshot_metrics()  # final ledger for the doctor's live stream
+    except Exception:
+        pass
     app.close()
     return 0
 
@@ -222,22 +392,54 @@ def run_node(root: Path, node_id: int) -> int:
 
 class SocketClient:
     """Real-socket client handle: submits requests as KIND_CLIENT frames
-    and waits for the node's acknowledgement on the same connection."""
+    and waits for the node's acknowledgement on the same connection.
 
-    def __init__(self, addr: Tuple[str, int], timeout_s: float = 15.0):
+    ``submit`` survives a connection loss mid-request (node restarting,
+    partition window closing its TCP link): bounded attempts with jittered
+    exponential backoff, reconnecting and **resubmitting the same frame**.
+    Resubmission is idempotent by protocol construction — a duplicate
+    ``propose`` with an identical (req_no, digest) is a no-op at the node
+    — so a reply lost in flight cannot double-commit."""
+
+    def __init__(
+        self,
+        addr: Tuple[str, int],
+        timeout_s: float = 15.0,
+        attempts: int = 6,
+        backoff_base_s: float = 0.1,
+        backoff_max_s: float = 2.0,
+    ):
+        self.addr = addr
+        self.timeout_s = timeout_s
+        self.attempts = attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self._rng = random.Random(addr[1])  # retry jitter only
+        self._sock: Optional[socket.socket] = None
+        self._decoder = None
+        self._pending: List[bytes] = []
+        self._connect()  # eager: boot loops catch OSError and retry
+
+    def _connect(self) -> None:
         from mirbft_tpu.net.framing import FrameDecoder
 
-        self._sock = socket.create_connection(addr, timeout=timeout_s)
+        self._sock = socket.create_connection(self.addr, timeout=self.timeout_s)
         self._decoder = FrameDecoder()
-        self._pending: List[bytes] = []
+        self._pending = []
 
-    def submit(self, req_no: int, data: bytes) -> bool:
-        """Submit and await the ack; True iff the node accepted."""
-        from mirbft_tpu.net.framing import KIND_CLIENT, encode_frame
+    def _teardown(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._decoder = None
 
-        self._sock.sendall(
-            encode_frame(KIND_CLIENT, _CLIENT_REQ.pack(req_no) + data)
-        )
+    def _roundtrip(self, frame: bytes) -> bytes:
+        from mirbft_tpu.net.framing import KIND_CLIENT
+
+        self._sock.sendall(frame)
         while not self._pending:
             chunk = self._sock.recv(65536)
             if not chunk:
@@ -245,13 +447,35 @@ class SocketClient:
             for kind, payload in self._decoder.feed(chunk):
                 if kind == KIND_CLIENT:
                     self._pending.append(payload)
-        return self._pending.pop(0) == CLIENT_OK
+        return self._pending.pop(0)
+
+    def submit(self, req_no: int, data: bytes) -> bool:
+        """Submit and await the ack; True iff the node accepted.  Raises
+        ConnectionError only after every attempt failed."""
+        from mirbft_tpu.net.framing import KIND_CLIENT, encode_frame
+
+        frame = encode_frame(KIND_CLIENT, _CLIENT_REQ.pack(req_no) + data)
+        last_err: Optional[Exception] = None
+        for attempt in range(self.attempts):
+            if attempt:
+                delay = min(
+                    self.backoff_max_s,
+                    self.backoff_base_s * (2 ** (attempt - 1)),
+                )
+                time.sleep(delay * (1.0 + 0.3 * self._rng.random()))
+            try:
+                if self._sock is None:
+                    self._connect()
+                return self._roundtrip(frame) == CLIENT_OK
+            except (OSError, ConnectionError) as err:
+                last_err = err
+                self._teardown()
+        raise ConnectionError(
+            f"node at {self.addr} unreachable after {self.attempts} attempts"
+        ) from last_err
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._teardown()
 
 
 def _spawn(root: Path, node_id: int) -> subprocess.Popen:
@@ -322,6 +546,38 @@ def _diff_commit_logs(root: Path, node_ids: List[int]) -> List[str]:
     return problems
 
 
+def _agreement_by_seq(root: Path, node_ids: List[int]) -> List[str]:
+    """Bit-identical agreement tolerant of catch-up gaps: a node that
+    state-transferred over a missed window skips sequence numbers it never
+    applied, so logs are compared *by sequence number*, not by line index.
+    Every seq committed anywhere must be byte-identical everywhere it
+    appears, and each log must be strictly ascending (state transfer skips
+    forward, never rewinds or rewrites)."""
+    problems: List[str] = []
+    per_seq: Dict[int, Tuple[int, str]] = {}
+    for i in node_ids:
+        last = -1
+        for line in _read_commits(root, i):
+            try:
+                seq = int(line.split(" ", 1)[0])
+            except ValueError:
+                problems.append(f"node {i} unparseable commit line {line!r}")
+                break
+            if seq <= last:
+                problems.append(
+                    f"node {i} commit log not ascending at seq {seq}"
+                )
+                break
+            last = seq
+            first = per_seq.setdefault(seq, (i, line))
+            if first[1] != line:
+                problems.append(
+                    f"nodes {first[0]}/{i} diverge at seq {seq}: "
+                    f"{first[1]!r} vs {line!r}"
+                )
+    return problems
+
+
 def run_deployment(
     root_dir: Optional[str] = None,
     node_count: int = 4,
@@ -340,15 +596,7 @@ def run_deployment(
     root = Path(root_dir)
     root.mkdir(parents=True, exist_ok=True)
     ports = _reserve_ports(node_count)
-    _cluster_path(root).write_text(
-        json.dumps(
-            {
-                "node_count": node_count,
-                "client_ids": [client_id],
-                "ports": {str(i): ports[i] for i in range(node_count)},
-            }
-        )
-    )
+    _write_cluster(root, node_count, ports, [client_id])
     for i in range(node_count):
         _node_dir(root, i).mkdir(parents=True, exist_ok=True)
 
@@ -459,8 +707,9 @@ def _wait_commits(
     reqs: int,
     quorum: int,
     timeout_s: float,
+    first_req: int = 0,
 ) -> None:
-    expect = {(client_id, r) for r in range(reqs)}
+    expect = {(client_id, r) for r in range(first_req, reqs)}
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         done = sum(
@@ -509,6 +758,814 @@ def _kill_restart_drill(
     procs[victim] = _spawn(root, victim)
 
 
+# --------------------------------------------------------------------------
+# Scenario plane: fault choreography + doctor-judged verdicts
+# --------------------------------------------------------------------------
+
+
+class _Cluster:
+    """Parent-side choreography handle for fault scenarios: owns the
+    deployment directory, the child processes, and the ``faults.json``
+    version counter the children poll (docs/FAULTS.md)."""
+
+    def __init__(
+        self,
+        root: Path,
+        *,
+        node_count: int = 4,
+        seed: int = 7,
+        client_id: int = 0,
+        node_config: Optional[dict] = None,
+        byzantine: Optional[dict] = None,
+        unreachable_after_s: float = 5.0,
+        thresholds: Optional[dict] = None,
+        initial_plans: Optional[dict] = None,
+        timeout_s: float = 60.0,
+    ):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.node_count = node_count
+        self.seed = seed
+        self.client_id = client_id
+        self.timeout_s = timeout_s
+        self.ports = _reserve_ports(node_count)
+        merged_thresholds = dict(_WIRE_THRESHOLDS)
+        merged_thresholds.update(thresholds or {})
+        _write_cluster(
+            self.root,
+            node_count,
+            self.ports,
+            [client_id],
+            seed=seed,
+            faults=True,
+            record_events=True,
+            thresholds=merged_thresholds,
+            node_config=dict(
+                _STEADY_CONFIG if node_config is None else node_config
+            ),
+            byzantine=byzantine,
+            unreachable_after_s=unreachable_after_s,
+        )
+        self._faults_version = 0
+        _write_json_atomic(
+            _faults_path(self.root),
+            {
+                "version": 0,
+                "plans": {
+                    str(i): p.as_dict()
+                    for i, p in (initial_plans or {}).items()
+                },
+            },
+        )
+        for i in range(node_count):
+            _node_dir(self.root, i).mkdir(parents=True, exist_ok=True)
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self._stopped = False
+
+    def __enter__(self) -> "_Cluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def start(self) -> None:
+        for i in range(self.node_count):
+            self.procs[i] = _spawn(self.root, i)
+
+    # --- choreography ---
+
+    def set_faults(self, plans: dict) -> None:
+        """Ship ``{node_id: FaultPlan}`` to the children; blocks one poll
+        cycle so every child has observed the new version before the
+        caller's next move."""
+        self._faults_version += 1
+        _write_json_atomic(
+            _faults_path(self.root),
+            {
+                "version": self._faults_version,
+                "plans": {str(i): p.as_dict() for i, p in plans.items()},
+            },
+        )
+        time.sleep(3 * _FAULT_POLL_S)
+
+    def partition(self, victims: Iterable[int]) -> None:
+        """Block every link that crosses the victim/survivor cut, in both
+        directions — a real netsplit, not a one-way mute."""
+        from mirbft_tpu.net.faults import FaultPlan, FaultProfile
+
+        cut = set(victims)
+        plans = {}
+        for i in range(self.node_count):
+            links = {}
+            for j in range(self.node_count):
+                if j != i and (i in cut) != (j in cut):
+                    links[(i, j)] = FaultProfile(partition=True)
+            plans[i] = FaultPlan(seed=self.seed, links=links)
+        self.set_faults(plans)
+
+    def heal(self) -> None:
+        self.set_faults({})
+
+    # --- traffic ---
+
+    def submit(self, start: int, stop: int,
+               timeout_s: Optional[float] = None) -> None:
+        _submit_range(self.root, self.ports, start, stop,
+                      timeout_s if timeout_s is not None else self.timeout_s)
+
+    def wait_commits(
+        self,
+        reqs: int,
+        quorum: Optional[int] = None,
+        node_ids: Optional[List[int]] = None,
+        timeout_s: Optional[float] = None,
+        first_req: int = 0,
+    ) -> None:
+        ids = node_ids if node_ids is not None else list(range(self.node_count))
+        _wait_commits(
+            self.root,
+            self.procs,
+            ids,
+            self.client_id,
+            reqs,
+            quorum if quorum is not None else len(ids),
+            timeout_s if timeout_s is not None else self.timeout_s,
+            first_req=first_req,
+        )
+
+    # --- observability ---
+
+    def _samples(self, node_id: int, name: str):
+        from mirbft_tpu.tools.mircat import parse_prom_samples
+
+        path = _node_dir(self.root, node_id) / "metrics.prom"
+        if not path.exists():
+            return []
+        return parse_prom_samples(path.read_text(), name)
+
+    def injected(self, node_id: int) -> Dict[str, float]:
+        """``net_faults_injected_total`` by kind from the node's last
+        metrics snapshot."""
+        out: Dict[str, float] = {}
+        for labels, value in self._samples(node_id, "net_faults_injected_total"):
+            kind = labels.get("kind", "")
+            out[kind] = out.get(kind, 0.0) + value
+        return out
+
+    def faults(self, node_id: int) -> Dict[Tuple[int, str], float]:
+        """Live ``peer_faults_total`` ledger keyed ``(peer, kind)``."""
+        out: Dict[Tuple[int, str], float] = {}
+        for labels, value in self._samples(node_id, "peer_faults_total"):
+            if "peer" in labels and "kind" in labels:
+                key = (int(labels["peer"]), labels["kind"])
+                out[key] = out.get(key, 0.0) + value
+        return out
+
+    def reconnects(self, node_id: int) -> float:
+        return _metric_value(self.root, node_id, "net_reconnects_total")
+
+    def last_seq(self, node_id: int) -> int:
+        """Highest sequence number in the node's commit log (0 if none)."""
+        lines = _read_commits(self.root, node_id)
+        return int(lines[-1].split(" ", 1)[0]) if lines else 0
+
+    def wait_rejoin(
+        self, node_id: int, past_seq: int, timeout_s: float = 30.0
+    ) -> None:
+        """Block until the node's commit head passes ``past_seq`` — proof
+        it crossed an outage window (live replay or state transfer) and is
+        tracking the cluster again.  A healed node may legitimately jump
+        the exact sequences it missed (state transfer never replays them
+        to the app), so head progress, not request presence, is the
+        rejoin criterion."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.last_seq(node_id) > past_seq:
+                return
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"node {node_id} never advanced past seq {past_seq} "
+            f"(stuck at {self.last_seq(node_id)})"
+        )
+
+    def wait_fault(
+        self,
+        observers: Iterable[int],
+        peer: int,
+        kind: str,
+        timeout_s: float = 25.0,
+    ) -> None:
+        """Block until every observer's live ledger attributes ``kind`` to
+        ``peer`` (metrics snapshots lag by up to 0.5s)."""
+        obs = list(observers)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(self.faults(i).get((peer, kind), 0.0) > 0 for i in obs):
+                return
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"nodes {obs} never attributed {kind!r} to peer {peer}: "
+            f"{ {i: self.faults(i) for i in obs} }"
+        )
+
+    # --- process control ---
+
+    def kill(self, node_id: int) -> None:
+        self.procs[node_id].kill()
+        self.procs[node_id].wait(timeout=10)
+
+    def restart(self, node_id: int) -> None:
+        self.procs[node_id] = _spawn(self.root, node_id)
+
+    def stop_all(self) -> None:
+        """Graceful SIGTERM stop so event recorders flush and the final
+        metrics snapshots land before judging."""
+        if self._stopped:
+            return
+        self._stopped = True
+        for process in self.procs.values():
+            if process.poll() is None:
+                process.terminate()
+        for process in self.procs.values():
+            try:
+                process.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                process.kill()
+                process.wait(timeout=5)
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        for process in self.procs.values():
+            if process.poll() is None:
+                process.terminate()
+        for process in self.procs.values():
+            try:
+                process.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                try:
+                    process.kill()
+                    process.wait(timeout=5)
+                except Exception:
+                    pass
+
+    # --- judgment ---
+
+    def judge(self) -> dict:
+        """Stop everything, then run the full verdict stack: seq-keyed
+        bit-identical agreement plus the deployment doctor over event logs
+        and live counters."""
+        self.stop_all()
+        from mirbft_tpu.tools.mircat import doctor_deployment
+
+        node_ids = list(range(self.node_count))
+        return {
+            "agreement_problems": _agreement_by_seq(self.root, node_ids),
+            "doctor": doctor_deployment(self.root),
+            "injected": {i: self.injected(i) for i in node_ids},
+            "reconnects": {i: self.reconnects(i) for i in node_ids},
+        }
+
+
+def _sum_injected(res: dict) -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for kinds in res["injected"].values():
+        for kind, value in kinds.items():
+            total[kind] = total.get(kind, 0.0) + value
+    return total
+
+
+def _check_anomalies(
+    failures: List[str], doctor: dict, node_ids: Iterable[int], allowed: set
+) -> None:
+    for i in node_ids:
+        extra = set(doctor["per_node"][i]["anomaly_kinds"]) - allowed
+        if extra:
+            failures.append(
+                f"node {i} unexpected anomaly kinds {sorted(extra)} "
+                f"(allowed: {sorted(allowed)})"
+            )
+
+
+def _verdict(root: Path, name: str, data: dict, failures: List[str]) -> dict:
+    """Publish the scenario outcome: the ``scenario_verdict`` gauge
+    (1 pass / 0 fail), a ``scenario.json`` verdict file next to the
+    deployment, and an AssertionError carrying every failed check."""
+    from mirbft_tpu import metrics as metrics_mod
+
+    metrics_mod.default_registry.gauge(
+        "scenario_verdict", labels={"scenario": name}
+    ).set(0.0 if failures else 1.0)
+    doc = {
+        "scenario": name,
+        "verdict": "fail" if failures else "pass",
+        "failures": list(failures),
+        "data": data,
+    }
+    (Path(root) / "scenario.json").write_text(
+        json.dumps(doc, indent=2, sort_keys=True, default=str)
+    )
+    if failures:
+        raise AssertionError(
+            f"scenario {name} failed:\n" + "\n".join(failures)
+        )
+    return doc
+
+
+def _scenario_control(root: Path, seed: int) -> dict:
+    """Zero-rate control: the injector is wired on every link with all
+    rates zero — the run must be indistinguishable from no injector at
+    all.  Doctor healthy, zero anomalies, zero peer faults, zero injected
+    frames."""
+    from mirbft_tpu.net.faults import FaultPlan
+
+    with _Cluster(
+        root,
+        seed=seed,
+        initial_plans={i: FaultPlan(seed=seed) for i in range(4)},
+    ) as cluster:
+        cluster.start()
+        cluster.submit(0, 6)
+        cluster.wait_commits(6, quorum=4)
+        res = cluster.judge()
+
+    failures: List[str] = []
+    doctor = res["doctor"]
+    if not doctor["healthy"]:
+        failures.append(
+            f"doctor unhealthy: faults={doctor['faults']} "
+            f"anomalies={doctor['anomaly_count']}"
+        )
+    if doctor["anomaly_count"]:
+        failures.append(f"{doctor['anomaly_count']} anomalies in control run")
+    if doctor["faults"]:
+        failures.append(f"peer faults in control run: {doctor['faults']}")
+    for i, kinds in res["injected"].items():
+        hot = {k: v for k, v in kinds.items() if v}
+        if hot:
+            failures.append(
+                f"node {i} injected faults under a zero-rate plan: {hot}"
+            )
+    if res["agreement_problems"]:
+        failures.append("; ".join(res["agreement_problems"]))
+    return _verdict(root, "control", res, failures)
+
+
+def _scenario_partition_minority(root: Path, seed: int) -> dict:
+    """Partition a minority node, wait until every survivor attributes
+    ``peer_unreachable`` to it, heal, and require the full cluster (the
+    healed node included) to commit fresh traffic.  View changes stay
+    enabled: the protocol has no preprepare retransmission, so suspicion
+    and a fresh epoch are the only way to refill the victim's bucket
+    after its in-flight frames were dropped.  ``peer_unreachable`` may
+    only ever target the victim; suspicion votes are legitimate recovery
+    (blame diffuses over the epochs walked through during the outage)."""
+    survivors, victim = [0, 1, 2], 3
+    with _Cluster(
+        root,
+        seed=seed,
+        node_config=dict(_VIEWCHANGE_CONFIG),
+        unreachable_after_s=0.8,
+        timeout_s=45.0,
+    ) as cluster:
+        cluster.start()
+        cluster.submit(0, 4)
+        cluster.wait_commits(4, quorum=4)
+        cluster.partition({victim})
+        cluster.wait_fault(survivors, victim, "peer_unreachable",
+                           timeout_s=20.0)
+        cluster.heal()
+        time.sleep(1.0)  # let reconnects land before fresh traffic
+        cluster.submit(4, 8)
+        # The victim may state-transfer over the exact seqs carrying the
+        # fresh requests, so the full-log bar applies to survivors only;
+        # the healed node instead proves rejoin by committing *past* the
+        # survivors' head.
+        cluster.wait_commits(8, quorum=3, node_ids=survivors, timeout_s=45.0)
+        cluster.wait_rejoin(
+            victim, max(cluster.last_seq(i) for i in survivors)
+        )
+        res = cluster.judge()
+
+    failures: List[str] = []
+    doctor = res["doctor"]
+    for i in survivors:
+        node_faults = doctor["per_node"][i]["faults"]
+        if node_faults.get(f"{victim}:peer_unreachable", 0) <= 0:
+            failures.append(
+                f"survivor {i} never attributed peer_unreachable to "
+                f"{victim}: {node_faults}"
+            )
+        # The victim legitimately sees every survivor as unreachable from
+        # its side of the cut; survivors must only ever blame the victim.
+        innocent = {
+            key
+            for key in node_faults
+            if key.endswith(":peer_unreachable")
+            and not key.startswith(f"{victim}:")
+        }
+        if innocent:
+            failures.append(
+                f"survivor {i} attributed peer_unreachable to an innocent "
+                f"peer: {sorted(innocent)}"
+            )
+    fault_kinds = {key.split(":", 1)[1] for key in doctor["faults"]}
+    if fault_kinds - {"peer_unreachable", "suspicion_vote"}:
+        failures.append(
+            f"unexpected fault kinds attributed: {sorted(fault_kinds)}"
+        )
+    _check_anomalies(
+        failures, doctor, range(4),
+        {"peer_fault", "epoch_thrash", "watermark_stall",
+         "checkpoint_stagnation"},
+    )
+    injected = _sum_injected(res)
+    if injected.get("partition", 0) <= 0:
+        failures.append("no partition frames were ever injected")
+    noise = {k: v for k, v in injected.items() if k != "partition" and v}
+    if noise:
+        failures.append(f"unexpected injected kinds: {noise}")
+    if res["agreement_problems"]:
+        failures.append("; ".join(res["agreement_problems"]))
+    return _verdict(root, "partition-minority", res, failures)
+
+
+def _scenario_partition_leader(root: Path, seed: int) -> dict:
+    """Partition the current primary (the genesis epoch activates as
+    epoch 1, so the steady-state primary is node 1): the survivors must
+    suspect it — attributing ``suspicion_vote`` to the *correct* node —
+    move past its epoch, and keep committing without it; after the heal
+    the old primary rejoins and the whole cluster converges."""
+    victim, survivors = 1, [0, 2, 3]
+    with _Cluster(
+        root,
+        seed=seed,
+        node_config=dict(_VIEWCHANGE_CONFIG),
+        unreachable_after_s=0.8,
+        timeout_s=60.0,
+    ) as cluster:
+        cluster.start()
+        cluster.submit(0, 4)
+        cluster.wait_commits(4, quorum=4)
+        cluster.partition({victim})
+        cluster.wait_fault(survivors, victim, "peer_unreachable",
+                           timeout_s=20.0)
+        cluster.submit(4, 8)
+        # The 3-node majority is exactly 2f+1: it must commit alone.
+        cluster.wait_commits(8, quorum=3, node_ids=survivors, timeout_s=60.0)
+        cluster.heal()
+        # The demoted primary proves rejoin by committing past the
+        # survivors' head (it may state-transfer over what it missed).
+        cluster.wait_rejoin(
+            victim, max(cluster.last_seq(i) for i in survivors)
+        )
+        res = cluster.judge()
+
+    failures: List[str] = []
+    doctor = res["doctor"]
+    suspecting = sum(
+        1
+        for i in survivors
+        if doctor["per_node"][i]["faults"].get(f"{victim}:suspicion_vote", 0)
+        > 0
+    )
+    if suspecting < 2:
+        failures.append(
+            f"only {suspecting} survivors attributed suspicion_vote to the "
+            f"partitioned primary {victim}"
+        )
+    for i in survivors:
+        if doctor["per_node"][i]["faults"].get(
+            f"{victim}:peer_unreachable", 0
+        ) <= 0:
+            failures.append(
+                f"survivor {i} never attributed peer_unreachable to {victim}"
+            )
+        if doctor["per_node"][i]["max_epoch"] < 2:
+            failures.append(
+                f"survivor {i} never left the partitioned primary's epoch"
+            )
+        # Suspicion blame diffuses over the epochs walked through while
+        # the primary is dark, so only non-suspicion kinds must stay
+        # pinned on the victim.
+        bad_peer = {
+            key
+            for key in doctor["per_node"][i]["faults"]
+            if not key.startswith(f"{victim}:")
+            and not key.endswith(":suspicion_vote")
+        }
+        if bad_peer:
+            failures.append(
+                f"survivor {i} blamed an innocent peer: {sorted(bad_peer)}"
+            )
+    _check_anomalies(
+        failures, doctor, survivors,
+        {"peer_fault", "epoch_thrash", "watermark_stall",
+         "checkpoint_stagnation"},
+    )
+    if _sum_injected(res).get("partition", 0) <= 0:
+        failures.append("no partition frames were ever injected")
+    if res["agreement_problems"]:
+        failures.append("; ".join(res["agreement_problems"]))
+    return _verdict(root, "partition-leader", res, failures)
+
+
+def _scenario_flap(root: Path, seed: int) -> dict:
+    """Link flapping: three short partition/heal pulses against one node,
+    each well below the unreachable threshold.  Reconnects happen, and
+    dropped in-flight frames may force suspicion-based recovery (the
+    protocol never retransmits consensus traffic), but no flap may ever
+    be escalated to a ``peer_unreachable`` outage — and the cluster must
+    then commit fresh traffic, the flapped node rejoining past the
+    others' head (it may state-transfer over the frames it lost)."""
+    victim = 2
+    with _Cluster(
+        root,
+        seed=seed,
+        node_config=dict(_VIEWCHANGE_CONFIG),
+        # Whole flap phase < 10s: cumulative outage can never cross it.
+        unreachable_after_s=10.0,
+        timeout_s=60.0,
+    ) as cluster:
+        cluster.start()
+        cluster.submit(0, 3)
+        cluster.wait_commits(3, quorum=4)
+        for _ in range(3):
+            cluster.partition({victim})
+            time.sleep(0.7)
+            cluster.heal()
+            time.sleep(1.3)  # poll cycle + reconnect before the next pulse
+        cluster.submit(3, 8)
+        steady = [i for i in range(4) if i != victim]
+        cluster.wait_commits(8, quorum=3, node_ids=steady, timeout_s=60.0)
+        cluster.wait_rejoin(
+            victim, max(cluster.last_seq(i) for i in steady)
+        )
+        res = cluster.judge()
+
+    failures: List[str] = []
+    doctor = res["doctor"]
+    unreachable = [
+        key for key in doctor["faults"] if key.endswith(":peer_unreachable")
+    ]
+    if unreachable:
+        failures.append(
+            "flaps below the unreachable threshold must never be "
+            f"attributed as an outage: {sorted(unreachable)}"
+        )
+    fault_kinds = {key.split(":", 1)[1] for key in doctor["faults"]}
+    if fault_kinds - {"suspicion_vote"}:
+        failures.append(
+            f"flaps attributed unexpected fault kinds: {sorted(fault_kinds)}"
+        )
+    _check_anomalies(
+        failures, doctor, range(4),
+        {"peer_fault", "epoch_thrash", "watermark_stall",
+         "checkpoint_stagnation"},
+    )
+    injected = _sum_injected(res)
+    if injected.get("partition", 0) <= 0:
+        failures.append("no partition frames were ever injected")
+    noise = {k: v for k, v in injected.items() if k != "partition" and v}
+    if noise:
+        failures.append(f"unexpected injected kinds: {noise}")
+    if not any(v > 0 for v in res["reconnects"].values()):
+        failures.append("no node ever reconnected across three flaps")
+    if res["agreement_problems"]:
+        failures.append("; ".join(res["agreement_problems"]))
+    return _verdict(root, "flap", res, failures)
+
+
+def _scenario_lossy_wan(root: Path, seed: int) -> dict:
+    """Every link degraded at once — latency, jitter, drops, duplicates,
+    reorders, corruption, truncation — netem's lossy-WAN shape.  The
+    protocol may ride through view changes (suspicion is legitimate
+    recovery under loss), but corruption must stay at the framing layer:
+    no invalid_digest / ingress_reject attribution, and the logs agree."""
+    from mirbft_tpu.net.faults import FaultPlan, FaultProfile
+
+    wan = FaultProfile(
+        delay_ms=10.0,
+        jitter_ms=10.0,
+        drop_pct=2.0,
+        duplicate_pct=2.0,
+        reorder_pct=2.0,
+        corrupt_pct=0.5,
+        truncate_pct=0.5,
+    )
+    with _Cluster(
+        root,
+        seed=seed,
+        node_config={"suspect_ticks": 100, "new_epoch_timeout_ticks": 200},
+        thresholds={
+            "stall_observations": 400,
+            "checkpoint_stalled_observations": 400,
+            "starvation_observations": 500,
+        },
+        initial_plans={
+            i: FaultPlan(seed=seed + i, default=wan) for i in range(4)
+        },
+        timeout_s=90.0,
+    ) as cluster:
+        cluster.start()
+        cluster.submit(0, 8, timeout_s=90.0)
+        cluster.wait_commits(8, quorum=4, timeout_s=90.0)
+        res = cluster.judge()
+
+    failures: List[str] = []
+    doctor = res["doctor"]
+    injected = _sum_injected(res)
+    for kind in ("drop", "delay", "duplicate", "reorder", "corrupt",
+                 "truncate"):
+        if injected.get(kind, 0) <= 0:
+            failures.append(f"lossy-WAN profile never injected {kind!r}")
+    corrupted = sum(
+        _metric_value(Path(res["doctor"]["root"]), i,
+                      "net_frames_corrupted_total")
+        for i in range(4)
+    )
+    if corrupted <= 0:
+        failures.append("net_frames_corrupted_total never moved")
+    fault_kinds = {
+        key.split(":", 1)[1] for key in doctor["faults"]
+    }
+    forbidden = fault_kinds - {"suspicion_vote", "peer_unreachable"}
+    if forbidden:
+        failures.append(
+            "corruption leaked past the framing layer: "
+            f"{sorted(forbidden)} (CRC must reject before the protocol "
+            "ever sees a damaged byte)"
+        )
+    _check_anomalies(
+        failures, doctor, range(4),
+        {"peer_fault", "watermark_stall", "checkpoint_stagnation",
+         "epoch_thrash"},
+    )
+    if res["agreement_problems"]:
+        failures.append("; ".join(res["agreement_problems"]))
+    return _verdict(root, "lossy-wan", res, failures)
+
+
+def _scenario_byzantine_leader(root: Path, seed: int) -> dict:
+    """The current primary actively lies (the genesis epoch activates as
+    epoch 1, primary node 1): every epoch-1 Preprepare it sends is
+    rewritten with a different protocol-invalid batch per destination
+    (equivocation), and its Suspect/EpochChange messages are replayed
+    stale.  Honest nodes must demote it — Suspect + attribution, never a
+    crash — move to a new epoch, and commit everything with bit-identical
+    logs; nothing poisoned can ever reach quorum because no two honest
+    nodes even saw the same lie."""
+    from mirbft_tpu.net.byzantine import ByzantineBehaviors
+
+    byz, honest = 1, [0, 2, 3]
+    behaviors = ByzantineBehaviors(
+        equivocate_epoch=1,
+        replay_kinds=("Suspect", "EpochChange"),
+        replay_ms=150.0,
+        replay_copies=2,
+    )
+    with _Cluster(
+        root,
+        seed=seed,
+        node_config=dict(_VIEWCHANGE_CONFIG),
+        byzantine={byz: behaviors.as_dict()},
+        timeout_s=60.0,
+    ) as cluster:
+        cluster.start()
+        cluster.submit(0, 6, timeout_s=60.0)
+        cluster.wait_commits(6, quorum=3, node_ids=honest, timeout_s=60.0)
+        cluster.wait_commits(6, quorum=4, timeout_s=60.0)
+        res = cluster.judge()
+
+    failures: List[str] = []
+    doctor = res["doctor"]
+    byz_injected = res["injected"].get(byz, {})
+    if byz_injected.get("equivocate", 0) <= 0:
+        failures.append("byzantine node never equivocated")
+    if byz_injected.get("replay", 0) <= 0:
+        failures.append("byzantine node never replayed a stale message")
+    suspecting = sum(
+        1
+        for i in honest
+        if doctor["per_node"][i]["faults"].get(f"{byz}:suspicion_vote", 0) > 0
+    )
+    if suspecting < 2:
+        failures.append(
+            f"only {suspecting} honest nodes attributed suspicion_vote to "
+            f"the byzantine leader {byz}"
+        )
+    for i in honest:
+        if doctor["per_node"][i]["max_epoch"] < 2:
+            failures.append(f"honest node {i} never left the poisoned epoch")
+        innocent = {
+            key
+            for key in doctor["per_node"][i]["faults"]
+            if not key.startswith(f"{byz}:")
+            and not key.endswith(":suspicion_vote")
+        }
+        if innocent:
+            failures.append(
+                f"honest node {i} blamed an innocent peer: {sorted(innocent)}"
+            )
+    _check_anomalies(
+        failures, doctor, honest,
+        {"peer_fault", "epoch_thrash", "watermark_stall",
+         "checkpoint_stagnation"},
+    )
+    if res["agreement_problems"]:
+        failures.append("; ".join(res["agreement_problems"]))
+    return _verdict(root, "byzantine-leader", res, failures)
+
+
+def _scenario_rolling_kill(root: Path, seed: int) -> dict:
+    """Soak: SIGKILL each non-zero node in turn, wait for the survivors to
+    attribute the outage, restart it from its durable stores, and keep
+    committing.  Every victim must be attributed ``peer_unreachable``;
+    suspicion votes are legitimate recovery (a kill drops in-flight
+    frames, and only a view change can refill the gap); torn event logs
+    from the SIGKILLs are tolerated by the doctor, never fatal."""
+    with _Cluster(
+        root,
+        seed=seed,
+        node_config=dict(_VIEWCHANGE_CONFIG),
+        unreachable_after_s=0.6,
+        timeout_s=60.0,
+    ) as cluster:
+        cluster.start()
+        cluster.submit(0, 2)
+        cluster.wait_commits(2, quorum=4)
+        reqs = 2
+        for victim in (1, 2, 3):
+            survivors = [i for i in range(4) if i != victim]
+            cluster.kill(victim)
+            cluster.wait_fault(survivors, victim, "peer_unreachable",
+                               timeout_s=25.0)
+            cluster.restart(victim)
+            cluster.submit(reqs, reqs + 2, timeout_s=60.0)
+            reqs += 2
+            # Any rebooted node may have state-transferred over reqs it
+            # missed while down, so each cycle only demands its own
+            # requests of the survivors; the fresh victim proves rejoin
+            # by committing past their head.
+            cluster.wait_commits(reqs, quorum=3, node_ids=survivors,
+                                 timeout_s=60.0, first_req=reqs - 2)
+            cluster.wait_rejoin(
+                victim, max(cluster.last_seq(i) for i in survivors)
+            )
+        res = cluster.judge()
+
+    failures: List[str] = []
+    doctor = res["doctor"]
+    for victim in (1, 2, 3):
+        if doctor["faults"].get(f"{victim}:peer_unreachable", 0) <= 0:
+            failures.append(
+                f"victim {victim} was never attributed peer_unreachable"
+            )
+        if doctor["per_node"][victim]["boots"] < 2:
+            failures.append(
+                f"victim {victim} recorded "
+                f"{doctor['per_node'][victim]['boots']} boots, expected >= 2"
+            )
+    fault_kinds = {key.split(":", 1)[1] for key in doctor["faults"]}
+    if fault_kinds - {"peer_unreachable", "suspicion_vote"}:
+        failures.append(
+            f"rolling kills attributed unexpected kinds: {sorted(fault_kinds)}"
+        )
+    _check_anomalies(
+        failures, doctor, range(4),
+        {"peer_fault", "watermark_stall", "epoch_thrash",
+         "checkpoint_stagnation"},
+    )
+    if res["agreement_problems"]:
+        failures.append("; ".join(res["agreement_problems"]))
+    return _verdict(root, "rolling-kill", res, failures)
+
+
+SCENARIOS = {
+    "control": _scenario_control,
+    "partition-minority": _scenario_partition_minority,
+    "partition-leader": _scenario_partition_leader,
+    "flap": _scenario_flap,
+    "lossy-wan": _scenario_lossy_wan,
+    "byzantine-leader": _scenario_byzantine_leader,
+    "rolling-kill": _scenario_rolling_kill,
+}
+
+
+def run_scenario(name: str, root_dir: Optional[str] = None,
+                 seed: int = 7) -> dict:
+    """Run one choreographed fault scenario; returns the verdict document
+    (also written to ``<dir>/scenario.json``) or raises AssertionError
+    listing every failed check."""
+    if name not in SCENARIOS:
+        raise KeyError(
+            f"unknown scenario {name!r} "
+            f"(have: {', '.join(sorted(SCENARIOS))})"
+        )
+    if root_dir is None:
+        root_dir = tempfile.mkdtemp(prefix=f"mirnet-{name}-")
+    return SCENARIOS[name](Path(root_dir), seed)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="mirnet", description=__doc__.split("\n", 1)[0]
@@ -522,12 +1579,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--kill-restart", action="store_true",
                         help="SIGKILL+restart one node mid-run")
     parser.add_argument("--timeout", type=float, default=90.0)
+    parser.add_argument("--scenario", default=None,
+                        help="run a choreographed fault scenario "
+                             "(see --list-scenarios)")
+    parser.add_argument("--seed", type=int, default=7,
+                        help="fault-injection seed for --scenario")
+    parser.add_argument("--list-scenarios", action="store_true")
     args = parser.parse_args(argv)
+
+    if args.list_scenarios:
+        for name in sorted(SCENARIOS):
+            print(name)
+        return 0
 
     if args.node is not None:
         if args.dir is None:
             parser.error("--node requires --dir")
         return run_node(Path(args.dir), args.node)
+
+    if args.scenario is not None:
+        try:
+            doc = run_scenario(args.scenario, root_dir=args.dir,
+                               seed=args.seed)
+        except AssertionError as err:
+            print(str(err), file=sys.stderr)
+            return 1
+        print(json.dumps(doc, indent=2, sort_keys=True, default=str))
+        return 0
 
     result = run_deployment(
         root_dir=args.dir,

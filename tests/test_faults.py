@@ -1,0 +1,672 @@
+"""Wire-level fault-injection plane (docs/FAULTS.md): the frame injector
+(net/faults.py), the byzantine link (net/byzantine.py), the framing
+corruption-corpus regression, the SocketClient retry path, and the
+epoch-leader demote-not-crash unit in statemachine/epoch_active.py."""
+
+import hashlib
+import json
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from mirbft_tpu import metrics as metrics_mod
+from mirbft_tpu import processor as proc
+from mirbft_tpu.config import Config, standard_initial_network_state
+from mirbft_tpu.messages import Preprepare, QEntry, RequestAck, Suspect
+from mirbft_tpu.net.byzantine import (
+    ByzantineBehaviors,
+    ByzantineLink,
+    WireMangler,
+)
+from mirbft_tpu.net.faults import (
+    CORRUPTION_KINDS,
+    FaultInjector,
+    FaultPlan,
+    FaultProfile,
+    corrupt_frame,
+)
+from mirbft_tpu.net.framing import (
+    KIND_CLIENT,
+    KIND_MSG,
+    FrameDecoder,
+    FrameError,
+    encode_frame,
+)
+from mirbft_tpu.ops import CpuHasher
+from mirbft_tpu.statemachine.actions import Actions, Events
+from mirbft_tpu.statemachine.machine import StateMachine
+from mirbft_tpu.testengine.manglers import (
+    For,
+    mangler_from_spec,
+    matching,
+    spec_from_mangler,
+)
+from mirbft_tpu.tools.mirnet import CLIENT_OK, SocketClient
+
+
+# ---------------------------------------------------------------------------
+# Corruption corpus vs the framing poison contract (docs/TRANSPORT.md
+# "Failure containment"): every corruption kind at every split point must
+# yield a dropped connection (FrameError) or a legitimately starved decoder
+# — never a cleanly decoded frame, never any other exception.
+# ---------------------------------------------------------------------------
+
+_PAYLOAD = b"corpus-payload-" + bytes(range(48))
+
+
+@pytest.mark.parametrize("kind", CORRUPTION_KINDS)
+def test_corruption_corpus_every_split_point(kind):
+    rng = random.Random(0xC0FFEE)
+    good = encode_frame(KIND_MSG, _PAYLOAD)
+    trailer = encode_frame(KIND_MSG, b"trailing-frame")
+    for trial in range(4):
+        bad = corrupt_frame(kind, good, rng)
+        assert bad != good
+        stream = bad + trailer
+        for split in range(len(bad) + 1):
+            decoder = FrameDecoder()
+            dropped = False
+            frames = []
+            try:
+                frames.extend(decoder.feed(stream[:split]))
+                frames.extend(decoder.feed(stream[split:]))
+            except FrameError:
+                dropped = True
+            # The connection dropped, or the decoder starved waiting for
+            # bytes that never come; the trailing valid frame must never
+            # decode cleanly behind damage (no in-stream resync).
+            assert dropped or frames == [], (kind, trial, split, frames)
+            if dropped:
+                with pytest.raises(FrameError):
+                    decoder.feed(trailer)  # poisoned: every feed re-raises
+
+
+def test_corrupt_frame_unknown_kind():
+    with pytest.raises(ValueError):
+        corrupt_frame("melt", encode_frame(KIND_MSG, b"x"), random.Random(0))
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+
+def _make_injector(plan, node_id=0):
+    registry = metrics_mod.Registry()
+    injector = FaultInjector(node_id, plan, registry=registry)
+    delivered = []
+    injector.bind(lambda dest, frame: delivered.append((dest, frame)))
+    return injector, delivered, registry
+
+
+def _injected(registry, kind):
+    return registry.counter(
+        "net_faults_injected_total", labels={"kind": kind}
+    ).value
+
+
+def test_injector_schedule_is_deterministic():
+    frames = [encode_frame(KIND_MSG, b"frame-%03d" % i) for i in range(300)]
+    profile = FaultProfile(
+        drop_pct=30, reorder_pct=20, truncate_pct=10, corrupt_pct=10
+    )
+    runs = []
+    for _ in range(2):
+        injector, delivered, registry = _make_injector(
+            FaultPlan(seed=42, default=profile)
+        )
+        for frame in frames:
+            injector.submit(2, frame)
+        injector.stop()
+        counts = {
+            k: _injected(registry, k)
+            for k in ("drop", "reorder", "truncate", "corrupt")
+        }
+        runs.append((list(delivered), counts))
+    assert runs[0] == runs[1]
+    assert all(v > 0 for v in runs[0][1].values())
+    assert runs[0][1]["corrupt"] == registry.counter(
+        "net_frames_corrupted_total"
+    ).value - runs[0][1]["truncate"]
+
+
+def test_injector_drop_all():
+    injector, delivered, registry = _make_injector(
+        FaultPlan(seed=1, default=FaultProfile(drop_pct=100))
+    )
+    for i in range(20):
+        injector.submit(1, b"frame-%d" % i)
+    injector.stop()
+    assert delivered == []
+    assert _injected(registry, "drop") == 20
+
+
+def test_injector_delay_defers_delivery():
+    injector, delivered, registry = _make_injector(
+        FaultPlan(seed=2, default=FaultProfile(delay_ms=80))
+    )
+    injector.submit(1, b"late")
+    assert delivered == []  # handed to the scheduler, not delivered inline
+    deadline = time.monotonic() + 5.0
+    while not delivered and time.monotonic() < deadline:
+        time.sleep(0.005)
+    injector.stop()
+    assert delivered == [(1, b"late")]
+    assert _injected(registry, "delay") == 1
+
+
+def test_injector_duplicate_delivers_twice():
+    injector, delivered, registry = _make_injector(
+        FaultPlan(seed=3, default=FaultProfile(duplicate_pct=100))
+    )
+    injector.submit(1, b"payload")
+    deadline = time.monotonic() + 5.0
+    while len(delivered) < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    injector.stop()
+    assert delivered == [(1, b"payload")] * 2
+    assert _injected(registry, "duplicate") == 1
+
+
+def test_injector_reorder_holds_one_and_heal_flushes():
+    injector, delivered, registry = _make_injector(
+        FaultPlan(seed=5, default=FaultProfile(reorder_pct=100))
+    )
+    frames = [b"frame-%d" % i for i in range(4)]
+    for frame in frames:
+        injector.submit(1, frame)
+    # Every frame rides behind its successor; the newest is still held.
+    assert [f for _, f in delivered] == frames[:3]
+    assert _injected(registry, "reorder") == 4
+    injector.reconfigure(FaultPlan(seed=5))  # heal: nothing strands
+    assert [f for _, f in delivered] == frames
+    injector.submit(1, b"clean")
+    injector.stop()
+    assert delivered[-1] == (1, b"clean")
+
+
+def test_injector_partition_blocks_link_and_heals():
+    plan = FaultPlan(seed=9, links={(0, 3): FaultProfile(partition=True)})
+    injector, delivered, registry = _make_injector(plan, node_id=0)
+    assert injector.link_blocked(3)
+    assert not injector.link_blocked(1)
+    injector.submit(3, b"lost")
+    injector.submit(1, b"through")
+    assert delivered == [(1, b"through")]
+    assert _injected(registry, "partition") == 1
+    injector.reconfigure(FaultPlan(seed=9))
+    assert not injector.link_blocked(3)
+    injector.submit(3, b"after-heal")
+    injector.stop()
+    assert delivered[-1] == (3, b"after-heal")
+
+
+def test_fault_plan_json_round_trip():
+    plan = FaultPlan(
+        seed=11,
+        default=FaultProfile(drop_pct=2.5, delay_ms=10, jitter_ms=5),
+        links={
+            (0, 3): FaultProfile(partition=True),
+            (2, 1): FaultProfile(corrupt_pct=1.0),
+        },
+    )
+    wire = json.loads(json.dumps(plan.as_dict()))
+    assert FaultPlan.from_dict(wire) == plan
+    assert FaultPlan.from_dict({}) == FaultPlan()
+
+
+# ---------------------------------------------------------------------------
+# Mangler DSL specs and the byzantine link
+# ---------------------------------------------------------------------------
+
+
+def test_mangler_spec_round_trip_and_refusals():
+    program = For(matching.msgs().of_type(Suspect).at_percent(50)).drop()
+    spec = spec_from_mangler(program)
+    rebuilt = mangler_from_spec(json.loads(json.dumps(spec)))
+    assert spec_from_mangler(rebuilt) == spec
+    # Actions carrying live objects are refused at spec time.
+    crash = For(matching.msgs()).crash_and_restart_after(
+        10, Config(id=0, batch_size=1).initial_parameters()
+    )
+    with pytest.raises(ValueError):
+        spec_from_mangler(crash)
+
+
+def test_wire_mangler_drop_and_duplicate():
+    registry = metrics_mod.Registry()
+    drop = mangler_from_spec(
+        spec_from_mangler(For(matching.msgs().of_type(Suspect)).drop())
+    )
+    mangler = WireMangler(0, [drop], seed=1, registry=registry)
+    assert mangler.apply(2, Suspect(epoch=0)) == []
+    passthrough = Preprepare(seq_no=1, epoch=0, batch=())
+    assert mangler.apply(2, passthrough) == [(0.0, passthrough)]
+    assert (
+        registry.counter(
+            "net_faults_injected_total", labels={"kind": "mangler_drop"}
+        ).value
+        == 1
+    )
+
+    dup = mangler_from_spec(
+        spec_from_mangler(For(matching.msgs().of_type(Suspect)).duplicate(10))
+    )
+    mangler = WireMangler(0, [dup], seed=1, registry=registry)
+    out = mangler.apply(2, Suspect(epoch=0))
+    assert len(out) == 2
+    assert all(m == Suspect(epoch=0) for _, m in out)
+
+
+def test_byzantine_behaviors_round_trip():
+    behaviors = ByzantineBehaviors(
+        equivocate_epoch=0,
+        replay_kinds=("Suspect", "EpochChange"),
+        replay_ms=25.0,
+        replay_copies=2,
+    )
+    wire = json.loads(json.dumps(behaviors.as_dict()))
+    assert ByzantineBehaviors.from_dict(wire) == behaviors
+    with pytest.raises(ValueError):
+        ByzantineBehaviors.from_dict({"replay_kinds": ["Preprepare"]})
+
+
+class _RecordingLink:
+    def __init__(self):
+        self.sent = []
+        self.cond = threading.Condition()
+
+    def send(self, dest, msg):
+        with self.cond:
+            self.sent.append((dest, msg))
+            self.cond.notify_all()
+
+    def wait_sends(self, count, timeout_s=5.0):
+        deadline = time.monotonic() + timeout_s
+        with self.cond:
+            while len(self.sent) < count and time.monotonic() < deadline:
+                self.cond.wait(0.05)
+            return list(self.sent)
+
+
+def test_byzantine_link_equivocates_per_destination():
+    registry = metrics_mod.Registry()
+    inner = _RecordingLink()
+    link = ByzantineLink(
+        inner,
+        node_id=0,
+        behaviors=ByzantineBehaviors(equivocate_epoch=0),
+        registry=registry,
+    )
+    ack = RequestAck(client_id=0, req_no=0, digest=b"\x11" * 32)
+    preprepare = Preprepare(seq_no=1, epoch=0, batch=(ack,))
+    link.send(2, preprepare)
+    link.send(3, preprepare)
+    later = Preprepare(seq_no=5, epoch=1, batch=(ack,))
+    link.send(2, later)
+    link.stop()
+
+    (d2, lie2), (d3, lie3), (_, clean) = inner.sent
+    assert (d2, d3) == (2, 3)
+    # Same slot, a different protocol-invalid batch per destination.
+    for lie in (lie2, lie3):
+        assert (lie.seq_no, lie.epoch) == (1, 0)
+        assert lie.batch[0].client_id >= 1 << 20
+    assert lie2.batch != lie3.batch
+    assert clean == later  # other epochs pass untouched
+    assert (
+        registry.counter(
+            "net_faults_injected_total", labels={"kind": "equivocate"}
+        ).value
+        == 2
+    )
+
+
+def test_byzantine_link_replays_stale_messages():
+    registry = metrics_mod.Registry()
+    inner = _RecordingLink()
+    link = ByzantineLink(
+        inner,
+        node_id=0,
+        behaviors=ByzantineBehaviors(
+            replay_kinds=("Suspect",), replay_ms=10.0, replay_copies=2
+        ),
+        registry=registry,
+    )
+    link.send(1, Suspect(epoch=3))
+    link.send(1, Preprepare(seq_no=1, epoch=0, batch=()))
+    sent = inner.wait_sends(4)
+    link.stop()
+    assert sent.count((1, Suspect(epoch=3))) == 3  # original + 2 stale copies
+    assert sent.count((1, Preprepare(seq_no=1, epoch=0, batch=()))) == 1
+    assert (
+        registry.counter(
+            "net_faults_injected_total", labels={"kind": "replay"}
+        ).value
+        == 2
+    )
+
+
+# ---------------------------------------------------------------------------
+# SocketClient bounded retry (tools/mirnet.py): a connection lost
+# mid-request reconnects and resubmits the same frame; attempts are bounded.
+# ---------------------------------------------------------------------------
+
+
+def test_socket_client_resubmits_across_connection_loss():
+    server = socket.socket()
+    server.bind(("127.0.0.1", 0))
+    server.listen(4)
+    port = server.getsockname()[1]
+    got = {}
+
+    def serve():
+        conn, _ = server.accept()
+        conn.recv(4)  # read part of the request...
+        conn.close()  # ...then drop the connection mid-frame
+        conn, _ = server.accept()
+        decoder = FrameDecoder()
+        frames = []
+        while not frames:
+            data = conn.recv(65536)
+            if not data:
+                return
+            frames.extend(decoder.feed(data))
+        got["kind"], got["payload"] = frames[0]
+        conn.sendall(encode_frame(KIND_CLIENT, CLIENT_OK))
+        conn.close()
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    client = SocketClient(
+        ("127.0.0.1", port), attempts=4, backoff_base_s=0.01, backoff_max_s=0.1
+    )
+    try:
+        assert client.submit(7, b"retry-me") is True
+    finally:
+        client.close()
+        server.close()
+    thread.join(5.0)
+    assert got["kind"] == KIND_CLIENT
+    assert got["payload"].endswith(b"retry-me")
+
+
+def test_socket_client_attempts_are_bounded():
+    server = socket.socket()
+    server.bind(("127.0.0.1", 0))
+    server.listen(1)
+    addr = ("127.0.0.1", server.getsockname()[1])
+    client = SocketClient(
+        addr, timeout_s=1.0, attempts=2, backoff_base_s=0.01, backoff_max_s=0.05
+    )
+    server.close()  # every queued and future connection now dies
+    try:
+        with pytest.raises(ConnectionError, match="after 2 attempts"):
+            client.submit(0, b"nobody-home")
+    finally:
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# Epoch-leader demote-not-crash (statemachine/epoch_active.py): a
+# protocol-invalid Preprepare from another bucket's leader must emit a
+# Suspect (attributed misbehavior), never take the replica down, and must
+# not burn the sequence slot.
+# ---------------------------------------------------------------------------
+
+
+class _MemWAL:
+    def __init__(self):
+        self.entries = {}
+        self.low = 1
+
+    def write(self, index, entry):
+        self.entries[index] = entry
+
+    def truncate(self, index):
+        for i in list(self.entries):
+            if i < index:
+                del self.entries[i]
+        self.low = index
+
+    def sync(self):
+        pass
+
+    def load_all(self, for_each):
+        for index in sorted(self.entries):
+            for_each(index, self.entries[index])
+
+
+class _MemReqStore:
+    def __init__(self):
+        self.allocations = {}
+        self.requests = {}
+
+    def get_allocation(self, client_id, req_no):
+        return self.allocations.get((client_id, req_no))
+
+    def put_allocation(self, client_id, req_no, digest):
+        self.allocations[(client_id, req_no)] = digest
+
+    def get_request(self, ack):
+        return self.requests.get((ack.client_id, ack.req_no, ack.digest))
+
+    def put_request(self, ack, data):
+        self.requests[(ack.client_id, ack.req_no, ack.digest)] = data
+
+    def sync(self):
+        pass
+
+
+class _NullLink:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, dest, msg):
+        self.sent.append((dest, msg))
+
+
+class _ChainApp:
+    def __init__(self):
+        self.chain = b"\x00" * 32
+        self.committed = []
+
+    def apply(self, entry: QEntry):
+        h = hashlib.sha256(self.chain)
+        for req in entry.requests:
+            h.update(req.digest)
+        self.chain = h.digest()
+        self.committed.append(entry.seq_no)
+
+    def snap(self, network_config, client_states):
+        return self.chain, ()
+
+    def transfer_to(self, seq_no, snap):
+        raise NotImplementedError
+
+
+class _ReplicaHarness:
+    """One replica of an N-node network, pumped synchronously (the
+    tests/test_single_node_slice.py pipeline over a multi-node config):
+    peer traffic arrives only via injected ``Events().step``."""
+
+    def __init__(self, node_id=1, node_count=4):
+        # Huge suspicion timeout: the only Suspect a replica may emit is
+        # one the test injects a reason for.  new_epoch_timeout_ticks stays
+        # moderate — its half-interval paces the PREPENDING EpochChange
+        # broadcast that bootstraps the genesis epoch.
+        self.config = Config(
+            id=node_id,
+            batch_size=1,
+            suspect_ticks=10**6,
+            new_epoch_timeout_ticks=20,
+        )
+        self.node_id = node_id
+        self.hasher = CpuHasher()
+        self.wal = _MemWAL()
+        self.req_store = _MemReqStore()
+        self.link = _NullLink()
+        self.app = _ChainApp()
+        self.clients = proc.Clients(self.hasher, self.req_store)
+        self.sm = StateMachine()
+        self.work = proc.WorkItems()
+
+        ns = standard_initial_network_state(node_count, 0)
+        events = proc.initialize_wal_for_new_node(
+            self.wal, self.config.initial_parameters(), ns, b"genesis"
+        )
+        self.work.result_events.concat(events)
+        self.settle()
+
+    def active_epoch(self):
+        target = self.sm.epoch_tracker.current_epoch
+        return None if target is None else target.active_epoch
+
+    def inject(self, events: Events):
+        self.work.result_events.concat(events)
+        self.settle()
+
+    def tick(self):
+        self.inject(Events().tick_elapsed())
+
+    def run_until(self, cond, max_ticks=100):
+        for _ in range(max_ticks):
+            if cond():
+                return
+            self.tick()
+        assert cond(), f"condition not reached within {max_ticks} ticks"
+
+    def settle(self, max_iters=1000):
+        work = self.work
+        for _ in range(max_iters):
+            progressed = False
+            if work.result_events:
+                events, work.result_events = work.result_events, Events()
+                actions = proc.process_state_machine_events(
+                    self.sm, None, events
+                )
+                work.add_state_machine_results(actions)
+                progressed = True
+            if work.wal_actions:
+                actions, work.wal_actions = work.wal_actions, Actions()
+                work.add_wal_results(
+                    proc.process_wal_actions(self.wal, actions)
+                )
+                progressed = True
+            if work.net_actions:
+                actions, work.net_actions = work.net_actions, Actions()
+                work.add_net_results(
+                    proc.process_net_actions(self.node_id, self.link, actions)
+                )
+                progressed = True
+            if work.hash_actions:
+                actions, work.hash_actions = work.hash_actions, Actions()
+                work.add_hash_results(
+                    proc.process_hash_actions(self.hasher, actions)
+                )
+                progressed = True
+            if work.app_actions:
+                actions, work.app_actions = work.app_actions, Actions()
+                work.add_app_results(
+                    proc.process_app_actions(self.app, actions)
+                )
+                progressed = True
+            if work.client_actions:
+                actions, work.client_actions = work.client_actions, Actions()
+                work.add_client_results(
+                    self.clients.process_client_actions(actions)
+                )
+                progressed = True
+            if work.req_store_events:
+                events, work.req_store_events = work.req_store_events, Events()
+                work.add_req_store_results(
+                    proc.process_reqstore_events(self.req_store, events)
+                )
+                progressed = True
+            if not progressed:
+                return
+        raise AssertionError("work queues did not quiesce")
+
+
+class _Net:
+    """Four pumped replicas wired link-to-link in memory: enough real
+    peer traffic to activate the genesis epoch, after which a test can
+    isolate one replica and feed it hand-crafted messages."""
+
+    def __init__(self, node_count=4):
+        self.nodes = [
+            _ReplicaHarness(node_id=i, node_count=node_count)
+            for i in range(node_count)
+        ]
+        self.route()
+
+    def route(self, max_rounds=1000):
+        for _ in range(max_rounds):
+            moved = False
+            for src, h in enumerate(self.nodes):
+                sent, h.link.sent = h.link.sent, []
+                for dest, msg in sent:
+                    self.nodes[dest].inject(Events().step(src, msg))
+                    moved = True
+            if not moved:
+                return
+        raise AssertionError("network did not quiesce")
+
+    def tick_all(self):
+        for h in self.nodes:
+            h.tick()
+        self.route()
+
+
+def test_invalid_preprepare_demotes_leader_not_crash():
+    net = _Net(node_count=4)
+    h = net.nodes[1]
+    for _ in range(50):
+        if h.active_epoch() is not None:
+            break
+        net.tick_all()
+    assert h.active_epoch() is not None, "genesis epoch never activated"
+    ea = h.active_epoch()
+
+    epoch = ea.epoch_config.number
+    # A bucket this replica follows (so the message takes the peer path).
+    bucket = next(
+        b for b in range(len(ea.buckets)) if ea.buckets[b] != h.node_id
+    )
+    owner = ea.buckets[bucket]
+    seq_no = ea.lowest_unallocated[bucket]
+    before = list(ea.lowest_unallocated)
+    h.link.sent.clear()
+
+    poisoned = Preprepare(
+        seq_no=seq_no,
+        epoch=epoch,
+        batch=(
+            RequestAck(client_id=999_999, req_no=0, digest=b"\x5a" * 32),
+        ),
+    )
+    h.inject(Events().step(owner, poisoned))  # must not raise
+
+    suspects = [m for _, m in h.link.sent if isinstance(m, Suspect)]
+    assert suspects, "invalid Preprepare did not emit a Suspect"
+    assert all(s.epoch == epoch for s in suspects)
+    # The lie burned nothing: the slot is still open...
+    assert ea.lowest_unallocated == before
+    assert h.active_epoch() is ea  # ...and one vote changed no epoch
+
+    # ...so the real leader's next valid Preprepare still allocates it.
+    next_req_no = ea.outstanding_reqs.buckets[bucket][0].next_req_no
+    valid = Preprepare(
+        seq_no=seq_no,
+        epoch=epoch,
+        batch=(
+            RequestAck(
+                client_id=0, req_no=next_req_no, digest=b"\x11" * 32
+            ),
+        ),
+    )
+    h.inject(Events().step(owner, valid))
+    assert ea.lowest_unallocated[bucket] == seq_no + len(ea.buckets)
