@@ -294,29 +294,32 @@ def config3_pdes(detail):
     spec = Spec(node_count=64, client_count=64, reqs_per_client=100,
                 batch_size=100)
     unique = spec.client_count * spec.reqs_per_client
-    # The PDES envelope runs the classic (per-receiver) ack path — the
-    # cluster-shared ledger does not partition.  Record that cost next to
-    # the ledger row so the decomposition is honest: a ledger-off
-    # sequential run is the PDES rows' true single-core baseline.
+    # The ack ledger is sharded per partition now, so the PDES rows run
+    # ledger-ON (asserted below) and the honest single-core baseline is a
+    # 1-partition PDES run of the same ledger-on configuration — no more
+    # comparing a ledger-off partitioned schedule against a ledger-on
+    # sequential one.
     start = _time.perf_counter()
-    classic = FastRecording(spec, pdes_partitions=1)
-    classic_steps = classic.drain_clients_pdes(
+    baseline = FastRecording(spec, pdes_partitions=1)
+    baseline_steps = baseline.drain_clients_pdes(
         timeout=100_000_000, exact=False
     )
-    classic_wall = _time.perf_counter() - start
-    detail["c3classic_64n_wall_s"] = round(classic_wall, 2)
-    detail["c3classic_64n_unique_req_per_s"] = round(
-        unique / classic_wall, 1
+    baseline_wall = _time.perf_counter() - start
+    detail["c3pdes1_64n_wall_s"] = round(baseline_wall, 2)
+    detail["c3pdes1_64n_unique_req_per_s"] = round(
+        unique / baseline_wall, 1
     )
-    detail["c3_pdes_steps"] = classic_steps
+    detail["c3_pdes_steps"] = baseline_steps
+    detail["c3_pdes_ledger_on"] = baseline.pdes_stats["ledger_on"]
     best_projection = None
-    for parts in (2, 4, 8):
+    for parts in (2, 4, 8, 16, 32):
         start = _time.perf_counter()
         rec = FastRecording(spec, pdes_partitions=parts)
         steps = rec.drain_clients_pdes(timeout=100_000_000, exact=False)
         wall = _time.perf_counter() - start
-        assert steps == classic_steps, "pdes partition-count divergence"
+        assert steps == baseline_steps, "pdes partition-count divergence"
         st = rec.pdes_stats
+        assert st["ledger_on"] == 1, "pdes ran dishonestly ledger-off"
         work = st["sum_part_cycles"]
         crit = st["max_part_cycles"]
         barrier = st["barrier_cycles"]
@@ -342,6 +345,98 @@ def config3_pdes(detail):
         detail["c3_pdes_cores_for_100k"] = round(
             parts * BASELINE_REQ_PER_S / max(projected, 1), 1
         )
+
+
+def pdes_envelope_coverage(detail):
+    """``c3_pdes_envelope``: which BASELINE config shapes run under PDES
+    vs fall back, via the no-run eligibility probe — an envelope
+    regression (a config silently dropping out) shows up as a changed
+    reason code in the BENCH trajectory.  Device modes are orthogonal
+    (always sequential), so the probes use each config's simulation shape
+    with device off."""
+    from mirbft_tpu.testengine import Spec
+    from mirbft_tpu.testengine.fastengine import FastRecording
+    from mirbft_tpu.testengine.manglers import DropMessages
+
+    def c4_tweak(recorder):
+        for nc in recorder.node_configs:
+            nc.runtime_parms.link_latency = 1000
+        recorder.mangler = DropMessages(from_nodes=(0,))
+
+    shapes = {
+        "c1": Spec(node_count=4, client_count=4, reqs_per_client=10,
+                   batch_size=10),
+        "c2": Spec(node_count=16, client_count=4, reqs_per_client=10,
+                   batch_size=10, signed_requests=True),
+        "c3": Spec(node_count=64, client_count=8, reqs_per_client=5,
+                   batch_size=100),
+        "c4": Spec(node_count=128, client_count=8, reqs_per_client=5,
+                   batch_size=20, tweak_recorder=c4_tweak),
+        "c5": _config5_spec()[0],
+    }
+    coverage = {}
+    for name, spec in shapes.items():
+        try:
+            reason = FastRecording(spec).pdes_check(4)
+        except Exception as exc:
+            reason = f"{type(exc).__name__}: {exc}"[:80]
+        coverage[name] = "ok" if reason is None else str(reason)[:80]
+    detail["c3_pdes_envelope"] = coverage
+
+
+def config4_pdes(detail):
+    """``c4_pdes_*``: the 128-node WAN view-change cascade (BASELINE
+    config 4's simulation shape, device off) runs PARTITIONED — the
+    per-directed-link lookahead admits the non-green topology that the
+    uniform-latency envelope excluded.  Step identity against the
+    sequential run is asserted inline (the cascade's epoch changes cross
+    many lookahead barriers)."""
+    import time as _time
+
+    from mirbft_tpu.testengine import Spec
+    from mirbft_tpu.testengine.fastengine import FastRecording
+    from mirbft_tpu.testengine.manglers import DropMessages
+
+    def tweak(recorder):
+        # Four 32-node latency regions: intra-region 100, inter-region
+        # 1000 (the WAN matrix).  Region-aligned partitions get lookahead
+        # from the wide inter-region bound.
+        n = len(recorder.node_configs)
+        region = lambda i: i * 4 // n  # noqa: E731
+        for i, nc in enumerate(recorder.node_configs):
+            nc.runtime_parms.link_latency_to = tuple(
+                100 if region(i) == region(d) else 1000 for d in range(n)
+            )
+        recorder.mangler = DropMessages(from_nodes=(0,))
+
+    spec = Spec(node_count=128, client_count=8, reqs_per_client=5,
+                batch_size=20, signed_requests=True, tweak_recorder=tweak)
+    seq = FastRecording(spec)
+    seq_steps = seq.drain_clients(timeout=30_000_000)
+    start = _time.perf_counter()
+    rec = FastRecording(spec, pdes_partitions=4)
+    steps = rec.drain_clients_pdes(timeout=30_000_000, exact=False)
+    wall = _time.perf_counter() - start
+    assert steps == seq_steps, "c4 pdes step divergence"
+    st = rec.pdes_stats
+    work, crit = st["sum_part_cycles"], st["max_part_cycles"]
+    barrier = st["barrier_cycles"]
+    detail["c4_pdes_parts"] = 4
+    detail["c4_pdes_wall_s"] = round(wall, 2)
+    detail["c4_pdes_windows"] = st["windows"]
+    detail["c4_pdes_lookahead"] = st["lookahead"]
+    detail["c4_pdes_repartitions"] = st["repartitions"]
+    detail["c4_pdes_barrier_share"] = round(
+        barrier / max(work + barrier, 1), 3
+    )
+    detail["c4_pdes_critical_path_frac"] = round(
+        (crit + barrier) / max(work + barrier, 1), 3
+    )
+    # Measured per-window utilization: mean partition busy share of the
+    # critical path across the run (1.0 = perfectly balanced windows).
+    detail["c4_pdes_window_utilization"] = round(
+        work / max(4 * crit, 1), 3
+    )
 
 
 def config4_wan_epoch_change(detail):
@@ -1238,6 +1333,14 @@ def main():
         config3_pdes(detail)
     except Exception as exc:
         detail["c3pdes_error"] = f"{type(exc).__name__}: {exc}"[:160]
+    try:
+        pdes_envelope_coverage(detail)
+    except Exception as exc:
+        detail["c3_pdes_envelope"] = f"{type(exc).__name__}: {exc}"[:160]
+    try:
+        config4_pdes(detail)
+    except Exception as exc:
+        detail["c4_pdes_error"] = f"{type(exc).__name__}: {exc}"[:160]
 
     # Configs 4 and 5 (BASELINE configs[3..4]).
     try:

@@ -1178,9 +1178,14 @@ struct EventQueue {
 // per-node state to the sequential engine (tests/test_fastengine.py).
 // ---------------------------------------------------------------------------
 
+struct AckShard;  // per-partition ack-ledger overlay (defined with AckLedger)
+
 struct Partition {
     i32 id = 0;
     EventQueue q;
+    // Ledger-on runs: provisional ack-wave registrations made this window
+    // (folded into the global ledger in replay order at the barrier).
+    std::unique_ptr<AckShard> shard;
     i64 prov_counter = 0;  // provisional birth ranks (monotone, never reset)
     i64 window_start = 0;  // sim-time start of the current window
     i64 prov_base = 0;     // prov_counter at window start (resolve-map base)
@@ -1208,11 +1213,25 @@ struct Partition {
     };
     vector<Flip> flips;
 
+    // Queue purges caused by an Initialize processed this window
+    // (remove_events_for drops in-flight messages to the booting node).
+    // The partition-local removal handles this partition's queue; the
+    // barrier uses these markers to drop same-window cross-partition
+    // sends to the node whose birth precedes the Initialize globally.
+    struct Purge {
+        u32 at;   // plog index of the Initialize event
+        i32 node;
+    };
+    vector<Purge> purges;
+
     // Window stats, folded into the engine at each barrier.
     i64 steps = 0;
     i64 committed_ops = 0;
     u64 crypto_ns = 0;
     u64 work_cycles = 0;
+    // Per-node work attribution for traffic-aware repartitioning (indexed
+    // by node id; folded into Engine::node_load at each barrier).
+    vector<u64> node_cycles;
     // Partition-local hash memos (content-keyed; results content-equal
     // across partitions, so locality only costs duplicate hashing).
     std::unordered_map<string, i32> host_memo;
@@ -1229,9 +1248,13 @@ struct PdesResult {
     bool timed_out = false;
     i64 windows = 0;
     u64 barrier_cycles = 0;
+    u64 barrier_ns = 0;  // steady-clock barrier time (pdes_barrier_seconds)
     u64 sum_part_cycles = 0;
     u64 max_part_cycles = 0;
     i64 tail_steps = 0;
+    i64 repartitions = 0;  // traffic-aware rebalances taken at barriers
+    i64 lookahead = 0;     // conservative window width W (sim units)
+    bool ledger_on = false;  // ack ledger was live (sharded) during the run
 };
 
 struct Quorums {
@@ -1384,6 +1407,17 @@ struct Mask {
     }
     void set(i64 i) { w[(size_t)(i >> 6)] |= 1ull << (i & 63); }
     void clearbit(i64 i) { w[(size_t)(i >> 6)] &= ~(1ull << (i & 63)); }
+    // Per-bit atomic variants for masks shared across PDES partition
+    // threads (each node only ever flips its own bit, but bits of the
+    // same word belong to different threads).
+    void set_atomic(i64 i) {
+        __atomic_fetch_or(&w[(size_t)(i >> 6)], 1ull << (i & 63),
+                          __ATOMIC_RELAXED);
+    }
+    void clear_atomic(i64 i) {
+        __atomic_fetch_and(&w[(size_t)(i >> 6)], ~(1ull << (i & 63)),
+                           __ATOMIC_RELAXED);
+    }
     i64 count() const {
         return __builtin_popcountll(w[0]) + __builtin_popcountll(w[1]) +
                __builtin_popcountll(w[2]) + __builtin_popcountll(w[3]);
@@ -2239,7 +2273,14 @@ struct AckLedger {
     u32 wave_base = 0;
     std::map<i64, CanonClient> clients;
 
-    CanonClient &client(i64 id) { return clients[id]; }
+    // find-first: under PDES every client is pre-registered at setup, so
+    // the concurrent-window path is a pure lookup (operator[]'s insert
+    // machinery would be a structural race across partition threads).
+    CanonClient &client(i64 id) {
+        auto it = clients.find(id);
+        if (it != clients.end()) return it->second;
+        return clients[id];
+    }
 
     const WaveReg &wave(i64 wave_id) const {
         return waves[(size_t)((u32)wave_id - wave_base)];
@@ -2349,6 +2390,168 @@ struct AckLedger {
         }
         m->wave_id = (i64)reg.pos;
         waves.push_back(std::move(reg));
+    }
+};
+
+// ---------------------------------------------------------------------------
+// PDES ack-ledger sharding.  Under PDES the global registration order of a
+// window's broadcasts is only known at the barrier, so each partition
+// registers its own sends into a private overlay with PROVISIONAL wave
+// positions (high bit set — never `< version`, so LedView::consumed treats
+// them as own-early membership checks).  Only the SENDER consumes a
+// provisional wave (the self-send short-circuit, same step): with the
+// ledger live the window width is min over ALL directed links, so every
+// arrival of a window-sent wave lands in a later window — after the
+// barrier has folded the shard into the global ledger in exact replay
+// order and remapped the sender's early-consumed position to the final
+// one.  The overlay therefore only has to compose with the sender's own
+// consumed set; other partitions' same-window registrations are invisible
+// by construction, exactly as they are unconsumed in the sequential run.
+// ---------------------------------------------------------------------------
+
+constexpr u32 LED_PROV_BIT = 0x80000000u;
+
+struct ShardDig {
+    i32 dig;
+    Mask mask;                           // this window's new bits only
+    vector<std::pair<u32, u8>> add_log;  // provisional positions
+};
+
+struct ShardRec {
+    Mask non_null;                       // this window's new non-null bits
+    vector<std::pair<u32, u8>> nn_log;
+    vector<ShardDig> digs;
+
+    ShardDig *find(i32 dig) {
+        for (auto &d : digs)
+            if (d.dig == dig) return &d;
+        return nullptr;
+    }
+    const ShardDig *find(i32 dig) const {
+        for (const auto &d : digs)
+            if (d.dig == dig) return &d;
+        return nullptr;
+    }
+};
+
+struct AckShard {
+    AckLedger *global = nullptr;
+    std::map<std::pair<i64, i64>, ShardRec> recs;  // (client, req_no)
+    struct ShardWave {
+        WaveReg reg;   // reg.pos is provisional (LED_PROV_BIT | index)
+        u32 plog_at;   // partition plog index of the sending step
+        i32 src;       // sender node id (fold re-registers + remaps)
+    };
+    deque<ShardWave> waves;  // deque: reg references stay stable
+    size_t foldi = 0;        // barrier fold cursor
+
+    ShardRec *rec(i64 client, i64 req_no) {
+        auto it = recs.find({client, req_no});
+        return it == recs.end() ? nullptr : &it->second;
+    }
+    const ShardRec *rec(i64 client, i64 req_no) const {
+        auto it = recs.find({client, req_no});
+        return it == recs.end() ? nullptr : &it->second;
+    }
+
+    void clear() {
+        recs.clear();
+        waves.clear();
+        foldi = 0;
+    }
+
+    // Mirror of AckLedger::register_msg against the COMPOSED state
+    // (frozen global ledger + this partition's overlay).  kind is exact
+    // (it depends only on the source's own bits, which live globally or
+    // in this overlay); post/candidate are best-effort and unused — the
+    // sender's own-path consumption recounts from the composed add logs,
+    // and arrivals only ever consume the fold-time global registration.
+    void register_msg_lite(const MsgP &m, i32 source, u32 plog_at) {
+        if (m->wave_id >= 0) return;
+        ShardWave sw;
+        sw.plog_at = plog_at;
+        sw.src = source;
+        WaveReg &reg = sw.reg;
+        reg.msg = m;
+        reg.pos = LED_PROV_BIT | (u32)waves.size();
+        reg.min_any = INT64_MAX;
+        reg.max_any = INT64_MIN;
+        const vector<AckS> &acks = m->acks;
+        size_t i = 0;
+        while (i < acks.size()) {
+            i64 client_id = acks[i].client;
+            auto cit = global->clients.find(client_id);
+            if (cit == global->clients.end())
+                throw EngineError("pdes ledger: client not pre-registered");
+            CanonClient &cc = cit->second;
+            WaveSeg seg;
+            seg.client = client_id;
+            seg.canon = &cc;
+            seg.src = (u8)source;
+            seg.ack_start = (u32)i;
+            seg.min_reqno = acks[i].reqno;
+            seg.max_reqno = acks[i].reqno;
+            while (i < acks.size() && acks[i].client == client_id) {
+                const AckS &a = acks[i];
+                if (a.reqno < seg.min_reqno) seg.min_reqno = a.reqno;
+                if (a.reqno > seg.max_reqno) seg.max_reqno = a.reqno;
+                CanonRec *RG = cc.rec(a.reqno);  // read-only (frozen)
+                ShardRec &S = recs[{client_id, a.reqno}];
+                WaveTouch t;
+                t.req_no = a.reqno;
+                t.dig = a.dig;
+                t.post = 0;
+                t.candidate = false;
+                bool nn_src = (RG && RG->non_null.test(source)) ||
+                              S.non_null.test(source);
+                CanonDig *DG = RG ? RG->find(a.dig) : nullptr;
+                ShardDig *DS = S.find(a.dig);
+                bool have_bit = (DG && DG->mask.test(source)) ||
+                                (DS && DS->mask.test(source));
+                if (a.dig != 0 && nn_src) {
+                    if (!have_bit) {
+                        if (!DG && !DS) S.digs.push_back(ShardDig{a.dig});
+                        t.kind = 2;  // REJECT
+                    } else {
+                        t.kind = 1;  // DUP
+                        t.post = (u32)((DG ? DG->mask.count() : 0) +
+                                       (DS ? DS->mask.count() : 0));
+                        t.candidate = global->is_candidate_count((i64)t.post);
+                    }
+                } else {
+                    if (a.dig != 0 && !nn_src) {
+                        S.non_null.set(source);
+                        S.nn_log.emplace_back(reg.pos, (u8)source);
+                    }
+                    if (have_bit) {
+                        t.kind = 1;  // DUP (null revote or same-digest)
+                    } else {
+                        if (!DS) {
+                            S.digs.push_back(ShardDig{a.dig});
+                            DS = &S.digs.back();
+                        }
+                        DS->mask.set(source);
+                        DS->add_log.emplace_back(reg.pos, (u8)source);
+                        t.kind = 0;  // NEW
+                    }
+                    t.post = (u32)((DG ? DG->mask.count() : 0) +
+                                   (DS ? DS->mask.count() : 0));
+                    t.candidate = global->is_candidate_count((i64)t.post);
+                }
+                if (t.candidate)
+                    seg.candidates.push_back((u32)seg.touches.size());
+                seg.touches.push_back(t);
+                i++;
+            }
+            seg.ack_end = (u32)i;
+            if (seg.min_reqno < reg.min_any) reg.min_any = seg.min_reqno;
+            if (seg.max_reqno > reg.max_any) reg.max_any = seg.max_reqno;
+            if (!seg.candidates.empty())
+                reg.candidate_segs.push_back((u32)reg.segs.size());
+            reg.segs.push_back(std::move(seg));
+        }
+        m->wave_id = (i64)reg.pos;
+        waves.push_back(std::move(sw));
     }
 };
 
@@ -2543,8 +2746,15 @@ struct ClientD {
     const LedView *led_view = nullptr;
     i64 *led_diverged_total = nullptr;
     i64 *led_classic_count = nullptr;
+    // PDES: the owning partition's ledger overlay (slot on the
+    // Disseminator, re-pointed every step; null outside PDES windows).
+    AckShard *const *led_shard_slot = nullptr;
     bool led_classic = false;
     i64 led_diverged = 0;
+
+    const AckShard *led_shard() const {
+        return led_shard_slot ? *led_shard_slot : nullptr;
+    }
 
     // Quorum bookkeeping used during a changed-config rebuild
     // (disseminator.py:234-246 _apply_request_ack).
@@ -2729,18 +2939,42 @@ struct ClientD {
         CanonClient &cc = ctx->ack_ledger->client(client_state.id);
         CanonRec &R = cc.rec_or_create(crn.req_no);
         if (R.diverged.test(my_config.id)) return;
+        const AckShard *sh = led_shard();
+        const ShardRec *S = sh ? sh->rec(client_state.id, crn.req_no) : nullptr;
         Mask nn;
         for (const auto &pr : R.nn_log)
             if (led_view->consumed(pr.first)) nn.set(pr.second);
+        if (S)
+            for (const auto &pr : S->nn_log)
+                if (led_view->consumed(pr.first)) nn.set(pr.second);
         crn.non_null_voters = nn;
         for (const auto &D : R.digs) {
             CRP cr = crn.client_req(AckS{crn.client_id, crn.req_no, D.dig});
             Mask m;
             for (const auto &pr : D.add_log)
                 if (led_view->consumed(pr.first)) m.set(pr.second);
+            if (S)
+                if (const ShardDig *DS = S->find(D.dig))
+                    for (const auto &pr : DS->add_log)
+                        if (led_view->consumed(pr.first)) m.set(pr.second);
             cr->agreements = m;
         }
-        R.diverged.set(my_config.id);
+        if (S)
+            for (const auto &DS : S->digs) {
+                // Digests first seen this window (canonically AFTER every
+                // frozen global dig, so appending preserves sight order).
+                bool in_global = false;
+                for (const auto &D : R.digs)
+                    if (D.dig == DS.dig) in_global = true;
+                if (in_global) continue;
+                CRP cr =
+                    crn.client_req(AckS{crn.client_id, crn.req_no, DS.dig});
+                Mask m;
+                for (const auto &pr : DS.add_log)
+                    if (led_view->consumed(pr.first)) m.set(pr.second);
+                cr->agreements = m;
+            }
+        R.diverged.set_atomic(my_config.id);
         led_diverged += 1;
         if (led_diverged_total) *led_diverged_total += 1;
     }
@@ -2750,7 +2984,7 @@ struct ClientD {
         CanonClient &cc = ctx->ack_ledger->client(client_state.id);
         CanonRec *R = cc.rec(req_no);
         if (R && R->diverged.test(my_config.id)) {
-            R->diverged.clearbit(my_config.id);
+            R->diverged.clear_atomic(my_config.id);
             led_diverged -= 1;
             if (led_diverged_total) *led_diverged_total -= 1;
         }
@@ -2765,25 +2999,46 @@ struct ClientD {
     void led_try_realign() {
         if (!led_enabled() || led_diverged == 0) return;
         CanonClient &cc = ctx->ack_ledger->client(client_state.id);
+        const AckShard *sh = led_shard();
         for (const auto &crnp : win) {
             ClientReqNoD &crn = *crnp;
             CanonRec *R = cc.rec(crn.req_no);
             if (!R || !R->diverged.test(my_config.id)) continue;
+            const ShardRec *S =
+                sh ? sh->rec(client_state.id, crn.req_no) : nullptr;
             Mask nn;
             for (const auto &pr : R->nn_log)
                 if (led_view->consumed(pr.first)) nn.set(pr.second);
+            if (S)
+                for (const auto &pr : S->nn_log)
+                    if (led_view->consumed(pr.first)) nn.set(pr.second);
             if (crn.non_null_voters != nn) continue;
             bool equal = true;
             for (const auto &D : R->digs) {
                 Mask m;
                 for (const auto &pr : D.add_log)
                     if (led_view->consumed(pr.first)) m.set(pr.second);
+                if (S)
+                    if (const ShardDig *DS = S->find(D.dig))
+                        for (const auto &pr : DS->add_log)
+                            if (led_view->consumed(pr.first))
+                                m.set(pr.second);
                 CRP *cr = crn.requests.get(D.dig);
                 Mask actual = cr ? (*cr)->agreements : Mask();
                 if (actual != m) { equal = false; break; }
             }
+            if (equal && S)
+                for (const auto &DS : S->digs) {
+                    if (R->find(DS.dig)) continue;
+                    Mask m;
+                    for (const auto &pr : DS.add_log)
+                        if (led_view->consumed(pr.first)) m.set(pr.second);
+                    CRP *cr = crn.requests.get(DS.dig);
+                    Mask actual = cr ? (*cr)->agreements : Mask();
+                    if (actual != m) { equal = false; break; }
+                }
             if (!equal) continue;
-            R->diverged.clearbit(my_config.id);
+            R->diverged.clear_atomic(my_config.id);
             led_diverged -= 1;
             if (led_diverged_total) *led_diverged_total -= 1;
             if (led_diverged == 0) break;
@@ -2876,6 +3131,18 @@ struct ClientD {
                 if (cons) cnt += 1;
             }
         }
+        // PDES: this window's own bits (including the one this touch
+        // applies, at its provisional position) live only in the overlay.
+        if (const AckShard *sh = led_shard())
+            if (const ShardRec *S = sh->rec(client_state.id, t.req_no))
+                if (const ShardDig *DS = S->find(t.dig))
+                    for (const auto &pr : DS->add_log) {
+                        bool cons = led_view->consumed(pr.first);
+                        if (!cons && pr.first == wave_pos &&
+                            pr.second == (u8)my_config.id)
+                            cons = true;
+                        if (cons) cnt += 1;
+                    }
         i64 c_r = cnt;
         if (c_r < weak_quorum) return;
         bool newly = c_r == weak_quorum;
@@ -2940,7 +3207,7 @@ struct ClientD {
                 buffer_store(seg.ack_start + k);
                 CanonRec &R = cc.rec_or_create(t.req_no);
                 if (!R.diverged.test(my_config.id)) {
-                    R.diverged.set(my_config.id);
+                    R.diverged.set_atomic(my_config.id);
                     led_diverged += 1;
                     if (led_diverged_total) *led_diverged_total += 1;
                 }
@@ -3177,6 +3444,9 @@ struct Disseminator {
     // Ack-ledger receiver state: the global stream cursor plus the
     // aggregates that gate the wave-level fast path.
     LedView led_view;
+    // PDES: the owning partition's ledger overlay, re-pointed by the
+    // engine every step (null in sequential runs and barrier tails).
+    AckShard *led_shard = nullptr;
     i64 led_diverged_total = 0;
     i64 led_classic_count = 0;
     i64 led_max_lw = 0;          // max client low watermark (PAST gate)
@@ -3233,6 +3503,7 @@ struct Disseminator {
                 c->my_config = my_config;
                 c->client_tracker = client_tracker;
                 c->led_view = &led_view;
+                c->led_shard_slot = &led_shard;
                 c->led_diverged_total = &led_diverged_total;
                 c->led_classic_count = &led_classic_count;
             }
@@ -3314,7 +3585,23 @@ struct Disseminator {
             // precomputed quorum-crossing candidates.  See AckLedger.
             u64 t0 = __rdtsc();
             Actions actions;
-            const WaveReg &reg = ctx->ack_ledger->wave(msg->wave_id);
+            const WaveReg *regp;
+            if ((u64)msg->wave_id & (u64)LED_PROV_BIT) {
+                // PDES provisional wave: only the sender's self-send
+                // short-circuit may consume it (arrivals land post-fold
+                // with the final id — the window is narrower than every
+                // link by construction).
+                if (source != my_config.id || !led_shard)
+                    throw EngineError(
+                        "pdes ledger: provisional wave outside sender");
+                regp = &led_shard
+                            ->waves[(size_t)((u32)msg->wave_id &
+                                             ~LED_PROV_BIT)]
+                            .reg;
+            } else {
+                regp = &ctx->ack_ledger->wave(msg->wave_id);
+            }
+            const WaveReg &reg = *regp;
             const vector<AckS> &acks = reg.msg->acks;
             auto buffer_store = [&](size_t ack_index) {
                 msg_buffers.at(source).store(reg.single(ack_index));
@@ -6532,6 +6819,13 @@ struct RuntimeParms {
     i64 tick_interval = 500, link_latency = 100, wal_latency = 100,
         net_latency = 15, hash_latency = 25, client_latency = 15,
         app_latency = 30, req_store_latency = 150, events_latency = 10;
+    // Per-destination link latency (docs/PERFORMANCE.md §7.1, per-link
+    // lookahead): empty means the scalar link_latency applies to every
+    // destination.  The self entry is ignored (self-sends short-circuit).
+    vector<i64> lat_to;
+    i64 link_lat(i64 dest) const {
+        return lat_to.empty() ? link_latency : lat_to[(size_t)dest];
+    }
 };
 
 struct ClientSpec {
@@ -6568,6 +6862,16 @@ struct Engine {
     vector<std::unique_ptr<Partition>> parts;
     vector<i32> part_of;  // node id -> partition id
     bool pdes_threaded = false;
+    i64 pdes_W = 0;  // conservative window width for the current part_of
+    // Traffic model for rebalancing: per-node EWMA of window work cycles,
+    // plus the raw per-window vectors of the last few windows — the
+    // repartition objective (sum of per-window partition maxima) lives in
+    // the window-to-window burst structure that the EWMA smooths away.
+    vector<double> node_load;
+    std::deque<vector<u64>> node_hist;
+    // Pooled barrier-merge buffers (reused every window).
+    vector<vector<i64>> pdes_fin;
+    vector<size_t> pdes_logi, pdes_flipi, pdes_purgei;
     std::shared_mutex intern_mu;  // installed on ctx.intern when threaded
     std::mutex chain_mu, snap_mu;  // shared chain / snap registry guards
     vector<std::unique_ptr<EngineNode>> nodes;
@@ -6836,14 +7140,33 @@ struct Engine {
             // (PDES runs require the ledger disabled.)
             if (ctx.ack_ledger != nullptr &&
                 (action.targets == ctx.bcast || *action.targets == *ctx.bcast)) {
-                if (m->t == MT::AckBatch || m->t == MT::AckMsg) {
-                    ctx.ack_ledger->register_msg(m, node.id);
-                } else if (m->t == MT::MsgBatch) {
-                    for (const auto &im : m->inner)
-                        if (im->t == MT::AckBatch || im->t == MT::AckMsg)
-                            ctx.ack_ledger->register_msg(im, node.id);
+                if (part) {
+                    // PDES window: provisional registration in this
+                    // partition's shard, tagged with the sending step's
+                    // plog index so the barrier folds it into the global
+                    // ledger at the exact replay position.  Pruning is
+                    // deferred to the (serial) barrier.
+                    if (part->plog.empty())
+                        throw EngineError("pdes ledger: send outside step");
+                    u32 at = (u32)(part->plog.size() - 1);
+                    if (m->t == MT::AckBatch || m->t == MT::AckMsg) {
+                        part->shard->register_msg_lite(m, node.id, at);
+                    } else if (m->t == MT::MsgBatch) {
+                        for (const auto &im : m->inner)
+                            if (im->t == MT::AckBatch || im->t == MT::AckMsg)
+                                part->shard->register_msg_lite(im, node.id,
+                                                               at);
+                    }
+                } else {
+                    if (m->t == MT::AckBatch || m->t == MT::AckMsg) {
+                        ctx.ack_ledger->register_msg(m, node.id);
+                    } else if (m->t == MT::MsgBatch) {
+                        for (const auto &im : m->inner)
+                            if (im->t == MT::AckBatch || im->t == MT::AckMsg)
+                                ctx.ack_ledger->register_msg(im, node.id);
+                    }
+                    if (ctx.ack_ledger->waves.size() >= 256) prune_ledger();
                 }
-                if (ctx.ack_ledger->waves.size() >= 256) prune_ledger();
             }
             for (i32 replica : *action.targets) {
                 if (replica == node.id) {
@@ -6856,7 +7179,7 @@ struct Engine {
                     if (drop_mangler && drop_matches(node.id, replica))
                         continue;  // mangled away (DropMessages)
                     SimEv ev;
-                    ev.time = q.fake_time + node.runtime.link_latency;
+                    ev.time = q.fake_time + node.runtime.link_lat(replica);
                     ev.kind = SK::MsgReceived;
                     ev.target = replica;
                     ev.src = node.id;
@@ -7028,7 +7351,21 @@ struct Engine {
             bool *need_device);
     PdesResult run_pdes(i64 partitions, bool threaded, i64 timeout,
                         i64 stop_time, i64 stop_steps);
+    // Envelope probe: empty string = this engine can run under PDES with
+    // the given partition count; otherwise a structured reason of the form
+    // "pdes_envelope[<code>]: <detail>" (the Python layer parses the code
+    // into PdesEnvelopeUnsupported.reason).
+    string pdes_check(i64 partitions) const;
     void pdes_setup(i64 partitions, bool threaded);
+    // Conservative lookahead for a partition assignment: the smallest
+    // latency on any link that can carry a cross-partition message (with
+    // the ledger live, the smallest inter-node latency outright — wave
+    // registration order must fold once per window).
+    i64 pdes_lookahead_for(const vector<i32> &assign) const;
+    // Traffic-aware rebalancing at a barrier (all keys final, outboxes
+    // empty): recompute part_of from the node-load EWMA, migrate queued
+    // events, refresh pdes_W.  Returns true if the assignment changed.
+    bool pdes_repartition(double imbalance);
     void pdes_window(Partition &part, i64 window_start, i64 window_end,
                      i64 step_cap);
     // Barrier replay: finalize birth-key ranks, deliver cross-partition
@@ -7193,10 +7530,19 @@ void Engine::step(Partition *part) {
     }
     EngineNode &node = *nodes[(size_t)event.target];
     const RuntimeParms &parms = node.runtime;
+    // Ledger-on PDES: point the node's overlay slot at its partition's
+    // shard for the duration of this step (null for sequential/tail
+    // steps — led paths then read the global ledger alone).
+    if (node.machine && node.machine->client_hash_disseminator)
+        node.machine->client_hash_disseminator->led_shard =
+            part && part->shard ? part->shard.get() : nullptr;
 
     switch (event.kind) {
         case SK::Initialize: {
             queue.remove_events_for(node.id);
+            if (part)
+                part->purges.push_back(
+                    {(u32)(part->plog.size() - 1), node.id});
             if (event.init) {
                 // Crash-and-restart: reboot under the event's parameters.
                 // The restarted node missed ack-ledger wave prefixes while
@@ -7207,6 +7553,9 @@ void Engine::step(Partition *part) {
                 node.init_parms.led_classic = classic;
             }
             initialize_node(node);
+            if (node.machine && node.machine->client_hash_disseminator)
+                node.machine->client_hash_disseminator->led_shard =
+                    part && part->shard ? part->shard.get() : nullptr;
             {
                 SimEv tick;
                 tick.time = queue.fake_time + parms.tick_interval;
@@ -7354,7 +7703,9 @@ void Engine::step(Partition *part) {
     }
 
     if (part) {
-        part->work_cycles += __rdtsc() - t_start;
+        u64 dt = __rdtsc() - t_start;
+        part->work_cycles += dt;
+        part->node_cycles[(size_t)event.target] += dt;
     } else {
         kind_cycles[(int)event.kind] += __rdtsc() - t_start;
         kind_counts[(int)event.kind] += 1;
@@ -7463,52 +7814,108 @@ i64 Engine::run(i64 max_steps, i64 timeout, bool *done, bool *timed_out,
 //   Node summaries then match the sequential engine bit-for-bit.
 // ---------------------------------------------------------------------------
 
-void Engine::pdes_setup(i64 partitions, bool threaded) {
-    if (!parts.empty()) throw EngineError("pdes already initialized");
+string Engine::pdes_check(i64 partitions) const {
+    // Structured envelope probe (empty = eligible).  Codes are stable API:
+    // the Python layer parses "pdes_envelope[<code>]" into
+    // PdesEnvelopeUnsupported.reason, and bench.py keys c3_pdes_envelope
+    // off them.  The structured DropMessages mangler IS in the envelope:
+    // it applies at the SEND site (process_net_actions), which is
+    // partition-local and deterministic — no RNG, no queue surgery.
+    // Start delays and ignored nodes are in the envelope too: boot-time
+    // queue purges replay exactly (see Partition::Purge), ignore sets are
+    // partition-local, and a late-boot node consumes acks classically
+    // when the ledger is live (led_classic at construction).
+    if (!parts.empty())
+        return "pdes_envelope[state]: pdes already initialized";
     if (steps != 0 || queue.fake_time != 0)
-        throw EngineError("pdes requires a fresh engine");
+        return "pdes_envelope[state]: pdes requires a fresh engine";
     if (queue.mangler)
-        throw EngineError("pdes envelope: no consume-time manglers");
-    // The structured DropMessages mangler IS in the envelope: it applies
-    // at the SEND site (process_net_actions), which is partition-local
-    // and deterministic — no RNG, no queue surgery.
-    if (ctx.ack_ledger != nullptr)
-        throw EngineError(
-            "pdes requires the ack ledger disabled (MIRBFT_FAST_LEDGER=0): "
-            "the ledger is cluster-shared state; the classic per-receiver "
-            "ack path partitions cleanly");
+        return "pdes_envelope[mangler]: no consume-time manglers";
     if (device_hash_mode || streaming_auth_mode)
-        throw EngineError("pdes envelope: no device-paced modes");
+        return "pdes_envelope[device]: no device-paced modes";
     if (!reconfig_points.empty())
-        throw EngineError("pdes envelope: no reconfiguration");
+        return "pdes_envelope[reconfig]: no reconfiguration";
     for (const auto &np : nodes) {
-        if (np->start_delay > 0)
-            throw EngineError("pdes envelope: no start delays");
         if (np->state.fail_transfers > 0)
-            throw EngineError("pdes envelope: no transfer-failure injection");
-        if (np->runtime.link_latency != nodes[0]->runtime.link_latency)
-            throw EngineError("pdes envelope: uniform link latency required");
+            return "pdes_envelope[transfer_fail]: "
+                   "no transfer-failure injection";
         if (np->runtime.link_latency < 1)
-            throw EngineError("pdes: link latency must be positive");
+            return "pdes_envelope[latency]: link latency must be positive";
+        for (size_t d = 0; d < np->runtime.lat_to.size(); d++)
+            if ((i64)d != (i64)np->id && np->runtime.lat_to[d] < 1)
+                return "pdes_envelope[latency]: "
+                       "link latency must be positive";
     }
-    for (const auto &cs : client_specs)
-        if (!cs.ignore_nodes.empty())
-            throw EngineError("pdes envelope: no ignored nodes");
     if (partitions < 1 || partitions > (i64)nodes.size())
-        throw EngineError("pdes: partitions must be in [1, node count]");
+        return "pdes_envelope[partitions]: "
+               "partitions must be in [1, node count]";
+    return "";
+}
+
+i64 Engine::pdes_lookahead_for(const vector<i32> &assign) const {
+    const i64 N = (i64)nodes.size();
+    i64 w = INT64_MAX;
+    for (i64 j = 0; j < N; j++) {
+        const RuntimeParms &rt = nodes[(size_t)j]->runtime;
+        for (i64 k = 0; k < N; k++) {
+            if (j == k) continue;
+            if (ctx.ack_ledger == nullptr &&
+                assign[(size_t)j] == assign[(size_t)k])
+                continue;
+            w = std::min(w, rt.link_lat(k));
+        }
+    }
+    if (w == INT64_MAX) {
+        // Single partition, ledger off: no link constrains the window;
+        // fall back to the smallest inter-node latency so window/barrier
+        // cadence (and stats) stay comparable across partition counts.
+        for (i64 j = 0; j < N; j++)
+            for (i64 k = 0; k < N; k++)
+                if (j != k)
+                    w = std::min(w, nodes[(size_t)j]->runtime.link_lat(k));
+        if (w == INT64_MAX) w = nodes[0]->runtime.link_latency;
+    }
+    return w;
+}
+
+void Engine::pdes_setup(i64 partitions, bool threaded) {
+    string reason = pdes_check(partitions);
+    if (!reason.empty()) throw EngineError(reason);
     pdes_threaded = threaded;
     if (threaded) ctx.intern.mu = &intern_mu;
     i64 N = (i64)nodes.size();
     part_of.assign((size_t)N, 0);
+    node_load.assign((size_t)N, 0.0);
     for (i64 p = 0; p < partitions; p++) {
         auto part = std::make_unique<Partition>();
         part->id = (i32)p;
         part->q.stamp_mode = EventQueue::PDES;
         part->q.prov = &part->prov_counter;
+        part->node_cycles.assign((size_t)N, 0);
+        if (ctx.ack_ledger != nullptr) {
+            part->shard = std::make_unique<AckShard>();
+            part->shard->global = ctx.ack_ledger;
+        }
         parts.push_back(std::move(part));
+    }
+    if (ctx.ack_ledger != nullptr) {
+        // Pre-populate every client and its full reachable record range:
+        // during windows partition threads may only READ the global
+        // ledger's structure (operator[] inserts and rec_or_create
+        // extensions would race); req_no never exceeds a sender's high
+        // watermark <= total + width.
+        for (const auto &ic : ctx.init_clients) {
+            CanonClient &cc = ctx.ack_ledger->client(ic.id);
+            i64 total = 0;
+            const ClientSpec *cs = spec_of(ic.id);
+            if (cs) total = cs->total;
+            cc.rec_or_create(0);
+            cc.rec_or_create(total + 2 * ic.width + 16);
+        }
     }
     for (i64 i = 0; i < N; i++)
         part_of[(size_t)i] = (i32)(i * partitions / N);
+    pdes_W = pdes_lookahead_for(part_of);
     // Distribute genesis events, restamped to birth time -1 (before any
     // in-run birth, so window-0 births cannot collide with their keys).
     for (auto &ev : queue.heap) {
@@ -7519,6 +7926,134 @@ void Engine::pdes_setup(i64 partitions, bool threaded) {
     queue.heap.clear();
     for (auto &pp : parts)
         std::make_heap(pp->q.heap.begin(), pp->q.heap.end(), SimEvCmp());
+}
+
+bool Engine::pdes_repartition(double imbalance) {
+    const size_t P = parts.size();
+    const i64 N = (i64)nodes.size();
+    double total = 0;
+    for (i64 i = 0; i < N; i++) total += node_load[(size_t)i];
+    if (total <= 0) return false;
+    // Weights: the node-load EWMA, floored so currently-idle nodes still
+    // count (they own future traffic once their clients rotate in).
+    vector<double> w((size_t)N);
+    double floor_w = total / (double)(N * 64);
+    for (i64 i = 0; i < N; i++)
+        w[(size_t)i] = std::max(node_load[(size_t)i], floor_w);
+    bool nonuniform = false;
+    for (const auto &np : nodes)
+        if (!np->runtime.lat_to.empty()) nonuniform = true;
+    vector<vector<i32>> cands;
+    {
+        // Contiguous weighted split: preserves index locality (regional
+        // latency matrices are index-contiguous), which is what keeps the
+        // cross-partition lookahead wide on WAN topologies.
+        vector<i32> c((size_t)N, 0);
+        double tw = 0;
+        for (double x : w) tw += x;
+        double per = tw / (double)P;
+        double acc = 0;
+        i32 cur = 0;
+        i64 in_cur = 0;
+        for (i64 i = 0; i < N; i++) {
+            i64 remaining = N - i;
+            if (cur < (i32)P - 1 && in_cur > 0 &&
+                (acc >= per * (double)(cur + 1) ||
+                 remaining == (i64)P - 1 - (i64)cur)) {
+                cur += 1;
+                in_cur = 0;
+            }
+            c[(size_t)i] = cur;
+            in_cur += 1;
+            acc += w[(size_t)i];
+        }
+        cands.push_back(std::move(c));
+    }
+    if (!nonuniform) {
+        // LPT greedy onto the least-loaded partition (uniform latency:
+        // any assignment keeps the same lookahead).
+        vector<i64> order((size_t)N);
+        for (i64 i = 0; i < N; i++) order[(size_t)i] = i;
+        std::sort(order.begin(), order.end(), [&](i64 a, i64 b) {
+            if (w[(size_t)a] != w[(size_t)b])
+                return w[(size_t)a] > w[(size_t)b];
+            return a < b;
+        });
+        vector<i32> c((size_t)N, 0);
+        vector<double> bin(P, 0.0);
+        for (i64 i : order) {
+            size_t best = 0;
+            for (size_t b = 1; b < P; b++)
+                if (bin[b] < bin[best]) best = b;
+            c[(size_t)i] = (i32)best;
+            bin[best] += w[(size_t)i];
+        }
+        cands.push_back(std::move(c));
+        // Round-robin interleave: bucket ownership rotates through
+        // consecutive node ids, so a commit sweep's hot neighbors land in
+        // different partitions — this balances each window's burst, which
+        // total-weight balancing cannot see.
+        vector<i32> rr((size_t)N);
+        for (i64 i = 0; i < N; i++) rr[(size_t)i] = (i32)(i % (i64)P);
+        cands.push_back(std::move(rr));
+    }
+    // Score = the objective itself on recent history: sum over kept
+    // windows of that window's critical path (max partition member-cycle
+    // sum) under the assignment.  The incumbent competes on the same
+    // history, and migration isn't free, so switching needs a real win.
+    auto score = [&](const vector<i32> &asn) {
+        double s = 0;
+        vector<double> bin(P, 0.0);
+        for (const auto &hv : node_hist) {
+            std::fill(bin.begin(), bin.end(), 0.0);
+            for (i64 i = 0; i < N; i++)
+                bin[(size_t)asn[(size_t)i]] += (double)hv[(size_t)i];
+            s += *std::max_element(bin.begin(), bin.end());
+        }
+        return s;
+    };
+    const double cur_score = score(part_of);
+    const vector<i32> *chosen = nullptr;
+    double chosen_score = cur_score;
+    for (const auto &c : cands) {
+        if (c == part_of) continue;
+        // Never trade lookahead for balance unless the imbalance is
+        // severe: a narrower window multiplies barrier count for every
+        // partition.
+        if (pdes_lookahead_for(c) < pdes_W && imbalance <= 2.0) continue;
+        double s = score(c);
+        if (s < chosen_score) {
+            chosen_score = s;
+            chosen = &c;
+        }
+    }
+    if (chosen == nullptr || chosen_score > 0.97 * cur_score) return false;
+    const vector<i32> cand = *chosen;
+    // Migrate queued events.  Safe at a barrier: every pending key is
+    // final, outboxes are empty, plogs are cleared.
+    vector<vector<SimEv>> moved(P);
+    for (size_t p = 0; p < P; p++) {
+        auto &hp = parts[p]->q.heap;
+        size_t keep = 0;
+        for (size_t k = 0; k < hp.size(); k++) {
+            size_t np2 = (size_t)cand[(size_t)hp[k].target];
+            if (np2 == p) {
+                if (keep != k) hp[keep] = std::move(hp[k]);
+                keep += 1;
+            } else {
+                moved[np2].push_back(std::move(hp[k]));
+            }
+        }
+        hp.resize(keep);
+    }
+    for (size_t p = 0; p < P; p++) {
+        auto &hp = parts[p]->q.heap;
+        for (auto &ev : moved[p]) hp.push_back(std::move(ev));
+        std::make_heap(hp.begin(), hp.end(), SimEvCmp());
+    }
+    part_of = cand;
+    pdes_W = pdes_lookahead_for(part_of);
+    return true;
 }
 
 void Engine::pdes_window(Partition &part, i64 window_start, i64 window_end,
@@ -7536,9 +8071,17 @@ void Engine::pdes_window(Partition &part, i64 window_start, i64 window_end,
 
 i64 Engine::pdes_barrier(i64 window_start, i64 *flip_time) {
     const size_t P = parts.size();
-    // prov id -> final rank, per partition (dense, window-scoped).
-    vector<vector<i64>> fin(P);
-    vector<size_t> logi(P, 0), flipi(P, 0);
+    // prov id -> final rank, per partition (dense, window-scoped).  The
+    // buffers are engine members: capacity persists across windows, so
+    // the per-barrier cost is an assign(), not an allocation.
+    auto &fin = pdes_fin;
+    auto &logi = pdes_logi;
+    auto &flipi = pdes_flipi;
+    auto &purgei = pdes_purgei;
+    if (fin.size() < P) fin.resize(P);
+    logi.assign(P, 0);
+    flipi.assign(P, 0);
+    purgei.assign(P, 0);
     for (size_t p = 0; p < P; p++)
         fin[p].assign(
             (size_t)(parts[p]->prov_counter - parts[p]->prov_base), -1);
@@ -7548,27 +8091,81 @@ i64 Engine::pdes_barrier(i64 window_start, i64 *flip_time) {
         if (r < 0) throw EngineError("pdes: unresolved rank in merge");
         return r;
     };
+    // Incremental k-way merge: a binary min-heap of partition heads keyed
+    // (time, bt, resolved rank) replaces the O(P) scan per pop.  A head's
+    // rank is always resolvable when (re)pushed: a window-born event's
+    // birth precedes it in the SAME partition's plog (its parent was
+    // processed there first), so the birth was merged — and ranked —
+    // before the event can become that partition's head.
+    struct Head {
+        i64 time, bt, rk;
+        size_t p;
+    };
+    auto later = [](const Head &a, const Head &b) {
+        if (a.time != b.time) return a.time > b.time;
+        if (a.bt != b.bt) return a.bt > b.bt;
+        return a.rk > b.rk;
+    };
+    vector<Head> heads;
+    heads.reserve(P);
+    for (size_t p = 0; p < P; p++) {
+        if (parts[p]->plog.empty()) continue;
+        const auto &e = parts[p]->plog[0];
+        heads.push_back({e.time, e.bt, resolved(p, e), p});
+    }
+    std::make_heap(heads.begin(), heads.end(), later);
     i64 cur_bt = INT64_MIN, bt_rank = 0, flip_step = -1;
-    while (true) {
-        // Pop the globally-least processed event by (time, bt, rank).
-        size_t best = P;
-        i64 b_time = 0, b_bt = 0, b_rk = 0;
-        for (size_t p = 0; p < P; p++) {
-            if (logi[p] >= parts[p]->plog.size()) continue;
-            const auto &e = parts[p]->plog[logi[p]];
-            i64 rk = resolved(p, e);
-            if (best == P || e.time < b_time ||
-                (e.time == b_time &&
-                 (e.bt < b_bt || (e.bt == b_bt && rk < b_rk)))) {
-                best = p;
-                b_time = e.time;
-                b_bt = e.bt;
-                b_rk = rk;
-            }
-        }
-        if (best == P) break;
+    while (!heads.empty()) {
+        std::pop_heap(heads.begin(), heads.end(), later);
+        const size_t best = heads.back().p;
+        heads.pop_back();
         Partition &pp = *parts[best];
         const auto &e = pp.plog[logi[best]];
+        // Initialize-driven queue purges act first: in the sequential
+        // engine remove_events_for ran before the boot event's own births,
+        // so exactly the already-ranked (= born-earlier) same-window cross
+        // sends to the booting node are dropped.
+        while (purgei[best] < pp.purges.size() &&
+               pp.purges[purgei[best]].at == logi[best]) {
+            const i32 purged = pp.purges[purgei[best]++].node;
+            for (size_t p2 = 0; p2 < P; p2++) {
+                auto &ob = parts[p2]->outbox;
+                const i64 base2 = parts[p2]->prov_base;
+                ob.erase(
+                    std::remove_if(
+                        ob.begin(), ob.end(),
+                        [&](const SimEv &ev) {
+                            return ev.target == purged &&
+                                   fin[p2][(size_t)(ev.ctr - base2)] >= 0;
+                        }),
+                    ob.end());
+            }
+        }
+        // Fold this step's provisional ack waves into the global ledger:
+        // the merged order IS the sequential send order, so re-registering
+        // here reproduces the canonical positions and logs bit-for-bit.
+        // The sender's early-consumed provisional position is remapped to
+        // the final one (then absorbed, matching the sequential cursor);
+        // the shard overlay itself is discarded wholesale below.
+        if (ctx.ack_ledger != nullptr) {
+            AckShard &shard = *pp.shard;
+            while (shard.foldi < shard.waves.size() &&
+                   shard.waves[shard.foldi].plog_at == logi[best]) {
+                AckShard::ShardWave &sw = shard.waves[shard.foldi++];
+                const u32 prov = sw.reg.pos;
+                const MsgP &m = sw.reg.msg;
+                m->wave_id = -1;
+                ctx.ack_ledger->register_msg(m, sw.src);
+                EngineNode &sn = *nodes[(size_t)sw.src];
+                if (sn.machine && sn.machine->client_hash_disseminator) {
+                    LedView &lv =
+                        sn.machine->client_hash_disseminator->led_view;
+                    for (auto &pos : lv.own_early)
+                        if (pos == prov) pos = (u32)m->wave_id;
+                    lv.absorb();
+                }
+            }
+        }
         // Its births get the next ranks of the insertion sequence at this
         // timestamp (the merged order IS the sequential processing order).
         if (e.time != cur_bt) {
@@ -7599,6 +8196,11 @@ i64 Engine::pdes_barrier(i64 window_start, i64 *flip_time) {
             }
         }
         logi[best] += 1;
+        if (logi[best] < pp.plog.size()) {
+            const auto &ne = pp.plog[logi[best]];
+            heads.push_back({ne.time, ne.bt, resolved(best, ne), best});
+            std::push_heap(heads.begin(), heads.end(), later);
+        }
     }
     // Re-stamp window-born events still pending, and the cross sends.
     for (size_t p = 0; p < P; p++) {
@@ -7635,7 +8237,32 @@ i64 Engine::pdes_barrier(i64 window_start, i64 *flip_time) {
         pp.steps = 0;
         pp.plog.clear();
         pp.flips.clear();
+        pp.purges.clear();
+        if (pp.shard) {
+            if (pp.shard->foldi != pp.shard->waves.size())
+                throw EngineError("pdes ledger: unfolded shard waves");
+            pp.shard->clear();
+        }
     }
+    // Deferred ledger pruning (serial here; structural mutation is unsafe
+    // inside windows).
+    if (ctx.ack_ledger != nullptr && ctx.ack_ledger->waves.size() >= 256)
+        prune_ledger();
+    // Fold the per-node work attribution into the traffic EWMA (each node
+    // accrues only in its own partition), and keep the raw window vector:
+    // candidate assignments are scored against the recent burst history.
+    const i64 N = (i64)nodes.size();
+    vector<u64> winv((size_t)N);
+    for (i64 i = 0; i < N; i++) {
+        Partition &pp = *parts[(size_t)part_of[(size_t)i]];
+        u64 c = pp.node_cycles[(size_t)i];
+        pp.node_cycles[(size_t)i] = 0;
+        winv[(size_t)i] = c;
+        node_load[(size_t)i] =
+            0.7 * node_load[(size_t)i] + 0.3 * (double)c;
+    }
+    node_hist.push_back(std::move(winv));
+    if (node_hist.size() > 8) node_hist.pop_front();
     return flip_step;
 }
 
@@ -7643,10 +8270,21 @@ PdesResult Engine::run_pdes(i64 partitions, bool threaded, i64 timeout,
                             i64 stop_time, i64 stop_steps) {
     if (parts.empty()) pdes_setup(partitions, threaded);
     const size_t P = parts.size();
-    const i64 L = nodes[0]->runtime.link_latency;
     const bool exact = stop_steps >= 0;
     const i64 step_cap = timeout + 1000;
     PdesResult res;
+    res.lookahead = pdes_W;
+    res.ledger_on = ctx.ack_ledger != nullptr;
+    // Traffic-aware rebalancing cadence: the first windows are the
+    // profiling prefix (seed assignment is naive-contiguous), then
+    // rebalance on sustained imbalance with a cooldown so the event
+    // migration cost amortizes.
+    // The candidate scorer competes the incumbent on the same history
+    // with hysteresis, so the trigger can run often and cheaply; the
+    // cooldown only bounds migration churn.
+    const i64 profile_windows = 3;
+    const i64 repart_cooldown = 4;
+    i64 last_repart = 0;
 
     // Persistent worker pool (threaded mode): generation-counter barrier.
     std::vector<std::thread> workers;
@@ -7707,7 +8345,7 @@ PdesResult Engine::run_pdes(i64 partitions, bool threaded, i64 timeout,
                     next_t = std::min(next_t, pp->q.heap.front().time);
             if (next_t == INT64_MAX) break;  // queues fully drained
             if (next_t > T) T = next_t;
-            i64 window_end = T + L;
+            i64 window_end = T + pdes_W;
             if (exact && window_end > stop_time) break;  // tail takes over
 
             u64 t0 = __rdtsc();
@@ -7741,8 +8379,13 @@ PdesResult Engine::run_pdes(i64 partitions, bool threaded, i64 timeout,
             res.max_part_cycles += win_max;
 
             i64 ft = -1;
+            auto b0 = std::chrono::steady_clock::now();
             i64 flip = pdes_barrier(T, &ft);
             res.barrier_cycles += __rdtsc() - t1;
+            res.barrier_ns += (u64)std::chrono::duration_cast<
+                                  std::chrono::nanoseconds>(
+                                  std::chrono::steady_clock::now() - b0)
+                                  .count();
             (void)t0;
             res.windows += 1;
             if (flip >= 0 && res.flip_step < 0) {
@@ -7753,6 +8396,23 @@ PdesResult Engine::run_pdes(i64 partitions, bool threaded, i64 timeout,
             if (steps > timeout) {
                 res.timed_out = true;
                 break;
+            }
+            // Rebalance at the barrier: once after the profiling prefix
+            // (seeding from observed per-node work), then only on
+            // sustained imbalance past the cooldown.
+            if (P > 1) {
+                double imb = win_sum > 0
+                                 ? (double)win_max * (double)P /
+                                       (double)win_sum
+                                 : 1.0;
+                bool due = res.windows == profile_windows ||
+                           (res.windows - last_repart >= repart_cooldown &&
+                            imb > 1.05);
+                if (due && pdes_repartition(imb)) {
+                    res.repartitions += 1;
+                    res.lookahead = pdes_W;
+                    last_repart = res.windows;
+                }
             }
             T = window_end;
         }
@@ -7939,6 +8599,20 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
             node->init_parms.suspect_ticks = get_i64(spec.p, 12);
             node->init_parms.new_epoch_timeout_ticks = get_i64(spec.p, 13);
             node->init_parms.buffer_size = get_i64(spec.p, 14);
+            // Optional element 15: per-destination link-latency row (None
+            // or an N-tuple) — see RuntimeParms::lat_to.
+            if (PySequence_Size(spec.p) > 15) {
+                PyRef lat(PySequence_GetItem(spec.p, 15));
+                if (!lat) throw EngineError("bad node spec");
+                if (lat.p != Py_None) {
+                    Py_ssize_t nl = PySequence_Size(lat.p);
+                    if (nl != (Py_ssize_t)n_nodes)
+                        throw EngineError(
+                            "link_latency_to row length must equal node count");
+                    for (Py_ssize_t k = 0; k < nl; k++)
+                        node->runtime.lat_to.push_back(get_i64(lat.p, k));
+                }
+            }
             engine->nodes.push_back(std::move(node));
         }
 
@@ -8081,10 +8755,14 @@ PyObject *engine_new(PyTypeObject *type, PyObject *args, PyObject *) {
             // (jitter/duplicates) and every-receiver-sees-every-wave
             // (drops), so any generic mangler disables the ledger outright.
             bool uniform = !engine->drop_mangler && !engine->queue.mangler;
-            for (const auto &node : engine->nodes)
-                if (node->runtime.link_latency !=
-                    engine->nodes[0]->runtime.link_latency)
-                    uniform = false;
+            i64 base_lat = engine->nodes[0]->runtime.link_latency;
+            for (const auto &node : engine->nodes) {
+                if (node->runtime.link_latency != base_lat) uniform = false;
+                for (size_t d = 0; d < node->runtime.lat_to.size(); d++)
+                    if ((i64)d != (i64)node->id &&
+                        node->runtime.lat_to[d] != base_lat)
+                        uniform = false;
+            }
             const char *env = std::getenv("MIRBFT_FAST_LEDGER");
             bool enabled =
                 uniform && !(env && env[0] == '0') && !(flags & 1);
@@ -8289,6 +8967,62 @@ PyObject *engine_node_summary(PyObject *self, PyObject *args) {
         (Py_ssize_t)node.state.checkpoint_hash.size(), (long long)epoch,
         (long long)node.state.last_seq_no, active.data(),
         (Py_ssize_t)active.size(), committed, lws);
+}
+
+// node_ack_state(i) -> int: FNV-1a fingerprint of the node's per-client
+// ack-dissemination state (watermarks, vote masks, quorum sets, ledger
+// cursor).  Deterministic across runs with identical event streams — the
+// PDES ledger-parity test compares it against the sequential engine's.
+PyObject *engine_node_ack_state(PyObject *self, PyObject *args) {
+    int i;
+    if (!PyArg_ParseTuple(args, "i", &i)) return nullptr;
+    Engine *e = ((PyEngine *)self)->engine;
+    if (i < 0 || (size_t)i >= e->nodes.size()) {
+        PyErr_SetString(PyExc_IndexError, "node index out of range");
+        return nullptr;
+    }
+    EngineNode &node = *e->nodes[(size_t)i];
+    u64 h = 1469598103934665603ull;
+    auto mix = [&h](u64 v) {
+        h ^= v;
+        h *= 1099511628211ull;
+    };
+    if (node.machine && node.machine->client_hash_disseminator &&
+        node.machine->client_hash_disseminator->initialized) {
+        Disseminator &d = *node.machine->client_hash_disseminator;
+        mix((u64)d.led_view.version);
+        vector<u32> early = d.led_view.own_early;
+        std::sort(early.begin(), early.end());
+        for (u32 p : early) mix((u64)p);
+        mix((u64)d.led_diverged_total);
+        mix((u64)d.led_classic_count);
+        for (const auto &pr : d.clients) {
+            const ClientD &c = *pr.second;
+            mix((u64)pr.first);
+            mix((u64)c.client_state.lw);
+            mix((u64)c.high_watermark);
+            mix(c.led_classic ? 1u : 0u);
+            mix((u64)c.led_diverged);
+            for (const auto &crnp : c.win) {
+                const ClientReqNoD &crn = *crnp;
+                mix((u64)crn.req_no);
+                for (int wi = 0; wi < 4; wi++)
+                    mix(crn.non_null_voters.w[wi]);
+                for (i32 dg : crn.self_acked) mix((u64)(u32)dg);
+                for (const auto &rp : crn.requests.items) {
+                    mix((u64)(u32)rp.first);
+                    for (int wi = 0; wi < 4; wi++)
+                        mix(rp.second->agreements.w[wi]);
+                    mix(rp.second->stored ? 1u : 0u);
+                }
+                for (const auto &rp : crn.weak_requests.items)
+                    mix((u64)(u32)rp.first);
+                for (const auto &rp : crn.strong_requests.items)
+                    mix((u64)(u32)rp.first);
+            }
+        }
+    }
+    return PyLong_FromUnsignedLongLong((unsigned long long)h);
 }
 
 // set_fail_transfers(node, count): the node's next `count` state-transfer
@@ -8542,20 +9276,43 @@ PyObject *engine_run_pdes(PyObject *self, PyObject *args) {
         return nullptr;
     }
     return Py_BuildValue(
-        "{s:L,s:L,s:L,s:L,s:i,s:i,s:L,s:K,s:K,s:K,s:L}", "steps",
-        (long long)r.steps, "fake_time", (long long)r.fake_time, "flip_step",
-        (long long)r.flip_step, "flip_time", (long long)r.flip_time, "done",
-        r.done ? 1 : 0, "timed_out", r.timed_out ? 1 : 0, "windows",
-        (long long)r.windows, "barrier_cycles",
-        (unsigned long long)r.barrier_cycles, "sum_part_cycles",
+        "{s:L,s:L,s:L,s:L,s:i,s:i,s:L,s:K,s:K,s:K,s:K,s:L,s:L,s:L,s:i}",
+        "steps", (long long)r.steps, "fake_time", (long long)r.fake_time,
+        "flip_step", (long long)r.flip_step, "flip_time",
+        (long long)r.flip_time, "done", r.done ? 1 : 0, "timed_out",
+        r.timed_out ? 1 : 0, "windows", (long long)r.windows,
+        "barrier_cycles", (unsigned long long)r.barrier_cycles,
+        "barrier_ns", (unsigned long long)r.barrier_ns, "sum_part_cycles",
         (unsigned long long)r.sum_part_cycles, "max_part_cycles",
         (unsigned long long)r.max_part_cycles, "tail_steps",
-        (long long)r.tail_steps);
+        (long long)r.tail_steps, "repartitions", (long long)r.repartitions,
+        "lookahead", (long long)r.lookahead, "ledger_on",
+        r.ledger_on ? 1 : 0);
+}
+
+// pdes_check(partitions) -> None (eligible) or the structured
+// "pdes_envelope[<code>]: <detail>" reason string.  Probe only: no state
+// is touched, so bench.py can classify configs without running them.
+PyObject *engine_pdes_check(PyObject *self, PyObject *args) {
+    long long partitions;
+    if (!PyArg_ParseTuple(args, "L", &partitions)) return nullptr;
+    Engine *e = ((PyEngine *)self)->engine;
+    string reason;
+    try {
+        reason = e->pdes_check(partitions);
+    } catch (const std::exception &ex) {
+        PyErr_SetString(PyExc_RuntimeError, ex.what());
+        return nullptr;
+    }
+    if (reason.empty()) Py_RETURN_NONE;
+    return PyUnicode_FromStringAndSize(reason.data(),
+                                       (Py_ssize_t)reason.size());
 }
 
 PyMethodDef engine_methods[] = {
     {"run", engine_run, METH_VARARGS, nullptr},
     {"run_pdes", engine_run_pdes, METH_VARARGS, nullptr},
+    {"pdes_check", engine_pdes_check, METH_VARARGS, nullptr},
     {"pending_device_work", engine_pending_device_work, METH_NOARGS, nullptr},
     {"supply_digests", engine_supply_digests, METH_VARARGS, nullptr},
     {"supply_verdicts", engine_supply_verdicts, METH_VARARGS, nullptr},
@@ -8563,6 +9320,7 @@ PyMethodDef engine_methods[] = {
     {"stats", engine_stats, METH_NOARGS, nullptr},
     {"drain_state", engine_drain_state, METH_NOARGS, nullptr},
     {"node_summary", engine_node_summary, METH_VARARGS, nullptr},
+    {"node_ack_state", engine_node_ack_state, METH_VARARGS, nullptr},
     {"set_fail_transfers", engine_set_fail_transfers, METH_VARARGS, nullptr},
     {"node_transfers", engine_node_transfers, METH_VARARGS, nullptr},
     {"pop_hash_log", engine_pop_hash_log, METH_NOARGS, nullptr},

@@ -28,6 +28,11 @@ from .manglers import (
     Until,
     matching,
 )
+from .fastengine import (
+    FastEngineUnsupported,
+    FastRecording,
+    PdesEnvelopeUnsupported,
+)
 
 __all__ = [
     "After",
@@ -39,10 +44,13 @@ __all__ = [
     "DivergenceDetector",
     "EventMangling",
     "EventQueue",
+    "FastEngineUnsupported",
+    "FastRecording",
     "For",
     "HealthConfig",
     "HealthMonitor",
     "NodeConfig",
+    "PdesEnvelopeUnsupported",
     "ReconfigPoint",
     "Recorder",
     "Recording",

@@ -35,6 +35,7 @@ Device crypto in fast runs:
 from __future__ import annotations
 
 import hashlib
+import re
 from typing import Dict, List, Optional, Tuple
 
 from .. import _native, metrics, tracing
@@ -44,6 +45,25 @@ from .recorder import Spec, _u64
 class FastEngineUnsupported(RuntimeError):
     """The config (or a mid-run condition) is outside the fast engine's
     envelope; use the Python engine."""
+
+
+class PdesEnvelopeUnsupported(FastEngineUnsupported):
+    """The config is outside the conservative-PDES envelope.
+
+    ``reason`` carries the machine-readable code from the native layer's
+    structured ``pdes_envelope[<code>]: <detail>`` message (codes today:
+    ``state``, ``mangler``, ``device``, ``reconfig``, ``transfer_fail``,
+    ``latency``, ``partitions``); bench.py keys envelope coverage on it
+    instead of matching message prefixes."""
+
+    def __init__(self, message: str, reason: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+# The native layer's structured envelope-rejection shape; everything else
+# raised out of run_pdes is an internal invariant failure and stays loud.
+_PDES_ENVELOPE = re.compile(r"^pdes_envelope\[([a-z_]+)\]")
 
 
 # Message classes -> the native MT enum codes (fastengine.cpp `enum MT`).
@@ -191,17 +211,23 @@ class FastRecording:
 
         ``pdes_partitions`` > 0 selects the conservative-PDES partitioned
         run mode (docs/PERFORMANCE.md §7.1): replicas are partitioned
-        across ``pdes_partitions`` workers synchronized at link-latency
+        across ``pdes_partitions`` workers synchronized at per-link
         lookahead barriers, bit-identical to the sequential engine.
         ``pdes_threaded`` executes partitions on real threads (correctness
         identical; speedup requires cores).  The PDES envelope: the green
         path plus the structured ``DropMessages`` mangler (applied at the
-        partition-local send site — BASELINE config 4's silenced-leader
-        scenario partitions cleanly); no consume-time manglers, no device
-        modes, no reconfiguration, no start delays / ignored nodes,
-        uniform link latency.  The ack ledger is disabled at construction
-        (the classic per-receiver ack path partitions cleanly; the ledger
-        is cluster-shared state)."""
+        partition-local send site), start delays / ignored nodes (late
+        births are purged and re-ranked at the barrier), non-uniform
+        link-latency matrices (each directed partition pair's window comes
+        from its own latency lower bound, so BASELINE config 4's WAN
+        topology partitions with wide inter-region windows), and the ack
+        ledger (sharded per partition with window-boundary reconciliation;
+        the engine's uniformity gate still runs ledger-off under
+        DropMessages or non-uniform latency, exactly as sequentially).
+        Still outside: consume-time manglers, device modes,
+        reconfiguration.  Rejections raise ``PdesEnvelopeUnsupported``
+        with a machine-readable ``reason`` code; ``pdes_check()`` probes
+        eligibility without running."""
         _require(_native.load_fast() is not None, "native engine unavailable")
         _require(1 <= spec.node_count <= 256, ">256 nodes")
         if device_authoritative or streaming_auth:
@@ -314,7 +340,8 @@ class FastRecording:
                  rp.process_app_latency, rp.process_req_store_latency,
                  rp.process_events_latency, ip.batch_size,
                  ip.heartbeat_ticks, ip.suspect_ticks,
-                 ip.new_epoch_timeout_ticks, ip.buffer_size)
+                 ip.new_epoch_timeout_ticks, ip.buffer_size,
+                 tuple(rp.link_latency_to) if rp.link_latency_to else None)
             )
 
         self.pdes_partitions = int(pdes_partitions)
@@ -332,10 +359,6 @@ class FastRecording:
             client_states, client_specs, node_specs, mangler_desc,
             recorder.random_seed, reconfig_desc or None,
         )
-        if self.pdes_partitions:
-            # Trailing flags arg, bit 0: ack ledger off (cluster-shared
-            # state; the classic ack path partitions cleanly).
-            self._ctor_args += (1,)
         self._engine = _native.fast.FastEngine(*self._ctor_args)
         if device_authoritative or streaming_auth:
             self._engine.set_device_modes(
@@ -744,9 +767,9 @@ class FastRecording:
             # invariant failures and the window runaway stay loud.
             if "runaway" in msg:
                 raise TimeoutError(msg) from exc
-            if msg.startswith(("pdes envelope", "pdes requires",
-                               "pdes: partitions")):
-                raise FastEngineUnsupported(msg) from exc
+            envelope = _PDES_ENVELOPE.match(msg)
+            if envelope:
+                raise PdesEnvelopeUnsupported(msg, envelope.group(1)) from exc
             raise
         if res["timed_out"]:
             raise TimeoutError(
@@ -767,8 +790,33 @@ class FastRecording:
             )
             self.pdes_stats = dict(res, tail_steps=res2["tail_steps"])
             self._engine = engine2
+        self._emit_pdes_metrics(self.pdes_stats)
         self._finalize()
         return self.steps
+
+    def _emit_pdes_metrics(self, stats: dict) -> None:
+        """First-class PDES run stats (docs/OBSERVABILITY.md): window and
+        barrier-time counters, plus the last run's partition imbalance
+        (max partition cycles / mean partition cycles; 1.0 = perfectly
+        balanced) as a gauge."""
+        metrics.counter("pdes_windows_total").inc(stats["windows"])
+        metrics.counter("pdes_barrier_seconds").inc(stats["barrier_ns"] / 1e9)
+        if stats["sum_part_cycles"] > 0 and self.pdes_partitions > 0:
+            metrics.gauge("pdes_partition_imbalance").set(
+                stats["max_part_cycles"] * self.pdes_partitions
+                / stats["sum_part_cycles"]
+            )
+
+    def pdes_check(self, partitions: Optional[int] = None) -> Optional[str]:
+        """Probe PDES eligibility without running the engine: ``None`` when
+        this config can run under ``partitions`` workers (default: the
+        constructed partition count, else 2), otherwise the structured
+        ``pdes_envelope[<code>]: <detail>`` reason string.  Probes a
+        throwaway engine so it works before or after a run."""
+        if partitions is None:
+            partitions = self.pdes_partitions or 2
+        probe = _native.fast.FastEngine(*self._ctor_args)
+        return probe.pdes_check(int(partitions))
 
     def drain_clients(self, timeout: int, slice_steps: int = 200_000) -> int:
         """Run until every client's requests commit on every node; returns

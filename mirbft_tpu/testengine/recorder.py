@@ -48,15 +48,28 @@ def _u64(value: int) -> bytes:
 
 
 class SimLink:
-    """Enqueues MsgReceived with link latency (reference recorder.go:39-47)."""
+    """Enqueues MsgReceived with link latency (reference recorder.go:39-47).
 
-    def __init__(self, source: int, event_queue: EventQueue, delay: int):
+    ``delay_to`` (optional, one entry per destination node) overrides the
+    scalar ``delay`` per directed link — the WAN topologies use it for
+    intra-region vs inter-region latency, and the PDES engine derives its
+    per-partition-pair lookahead windows from the same matrix."""
+
+    def __init__(
+        self,
+        source: int,
+        event_queue: EventQueue,
+        delay: int,
+        delay_to: Optional[Tuple[int, ...]] = None,
+    ):
         self.source = source
         self.event_queue = event_queue
         self.delay = delay
+        self.delay_to = delay_to
 
     def send(self, dest: int, msg) -> None:
-        self.event_queue.insert_msg_received(dest, self.source, msg, self.delay)
+        delay = self.delay if self.delay_to is None else self.delay_to[dest]
+        self.event_queue.insert_msg_received(dest, self.source, msg, delay)
 
 
 class SimReqStore:
@@ -255,6 +268,9 @@ class RuntimeParameters:
 
     tick_interval: int = 500
     link_latency: int = 100
+    # Optional per-destination link-latency row (one entry per node,
+    # self-entry ignored); None means the scalar applies to every link.
+    link_latency_to: Optional[Tuple[int, ...]] = None
     process_wal_latency: int = 100
     process_net_latency: int = 15
     process_hash_latency: int = 25
@@ -550,7 +566,8 @@ class Recorder:
             )
             wal = SimWAL(self.network_state, checkpoint_value)
             link = SimLink(
-                i, event_queue, node_config.runtime_parms.link_latency
+                i, event_queue, node_config.runtime_parms.link_latency,
+                node_config.runtime_parms.link_latency_to,
             )
 
             interceptor = None

@@ -74,6 +74,14 @@ REQUIRED_NAMES = (
     "net_faults_injected_total",
     "net_frames_corrupted_total",
     "scenario_verdict",
+    # Conservative-PDES run stats (testengine/fastengine.py
+    # drain_clients_pdes): the window/barrier counters and imbalance gauge
+    # are the partitioned engine's only first-class observability — the
+    # BENCH trajectory's c3pdes*/c4_pdes_* keys derive from the same
+    # native stats, so silently losing these hides scaling regressions.
+    "pdes_windows_total",
+    "pdes_barrier_seconds",
+    "pdes_partition_imbalance",
 )
 
 

@@ -91,6 +91,30 @@ def test_bit_identical_to_python_engine(spec):
     assert state_fast == state_py
 
 
+def test_nonuniform_link_latency_bit_identical():
+    """Per-destination link-latency rows (RuntimeParameters.link_latency_to
+    / SimLink.delay_to) must mean the same thing in both engines: the
+    native per-link schedule twins the Python one bit-for-bit."""
+
+    def tweak(recorder):
+        n = len(recorder.node_configs)
+        for i, nc in enumerate(recorder.node_configs):
+            nc.runtime_parms.link_latency_to = tuple(
+                100 if (i < n // 2) == (d < n // 2) else 700
+                for d in range(n)
+            )
+
+    spec = Spec(
+        node_count=4, client_count=2, reqs_per_client=10, batch_size=2,
+        tweak_recorder=tweak,
+    )
+    steps_py, time_py, state_py = _python_run(spec)
+    steps_fast, time_fast, state_fast = _fast_run(spec)
+    assert steps_fast == steps_py
+    assert time_fast == time_py
+    assert state_fast == state_py
+
+
 def test_epoch_change_bit_identical():
     """Forced epoch change inside the envelope: node 0 (an epoch-0 leader)
     starts late enough that the others suspect it and rotate epochs, but

@@ -21,6 +21,7 @@ from mirbft_tpu.testengine import Spec
 from mirbft_tpu.testengine.fastengine import (
     FastEngineUnsupported,
     FastRecording,
+    PdesEnvelopeUnsupported,
 )
 
 pytestmark = pytest.mark.skipif(
@@ -131,6 +132,8 @@ def test_pdes_measurement_mode_reports_exact_drain_point():
 
 
 def test_pdes_envelope_rejections():
+    """Out-of-envelope configs raise the structured exception with a
+    machine-readable reason code (no message-prefix matching)."""
     from mirbft_tpu.testengine import For, matching
 
     spec = Spec(
@@ -139,24 +142,174 @@ def test_pdes_envelope_rejections():
             r, "mangler", For(matching.msgs()).drop()
         ),
     )
-    with pytest.raises((FastEngineUnsupported, RuntimeError)):
+    with pytest.raises(PdesEnvelopeUnsupported) as exc_info:
         FastRecording(spec, pdes_partitions=2).drain_clients(10_000_000)
+    assert exc_info.value.reason == "mangler"
+    # The probe agrees with the run-time rejection, code and all.
+    probe = FastRecording(spec).pdes_check(2)
+    assert probe is not None and probe.startswith("pdes_envelope[mangler]")
 
-    spec = Spec(
-        node_count=4, client_count=1, reqs_per_client=1,
-        tweak_recorder=lambda r: setattr(
-            r.node_configs[2], "start_delay", 5000
-        ),
-    )
-    with pytest.raises((FastEngineUnsupported, RuntimeError)):
-        FastRecording(spec, pdes_partitions=2).drain_clients(10_000_000)
-
+    # Device modes reject at construction (Python-side envelope).
     with pytest.raises(FastEngineUnsupported):
         FastRecording(
             Spec(node_count=4, client_count=1, reqs_per_client=1),
             device=True,
             pdes_partitions=2,
         )
+
+
+def test_pdes_start_delay_bit_identical():
+    """Start delays are INSIDE the envelope now (the barrier purges and
+    re-ranks the late node's births): a late-started replica that must
+    state-transfer stays bit-identical under partitioning."""
+    spec = Spec(
+        node_count=4, client_count=2, reqs_per_client=20, batch_size=2,
+        tweak_recorder=lambda r: setattr(
+            r.node_configs[2], "start_delay", 5000
+        ),
+    )
+    steps, fake_time, state = _run_seq(spec)
+    for partitions, threaded in [(2, False), (4, True)]:
+        pdes = FastRecording(
+            spec, pdes_partitions=partitions, pdes_threaded=threaded
+        )
+        assert pdes.drain_clients(timeout=100_000_000) == steps
+        assert pdes.stats()[1] == fake_time
+        assert _state(pdes) == state
+
+
+def _two_region_tweak(recorder, intra=100, inter=1000):
+    """Split the cluster into two latency regions: the per-directed-link
+    lookahead must give region-aligned partition pairs the narrow intra
+    window and cross-region pairs the wide one."""
+    n = len(recorder.node_configs)
+    half = n // 2
+    for i, nc in enumerate(recorder.node_configs):
+        nc.runtime_parms.link_latency_to = tuple(
+            intra if (i < half) == (d < half) else inter for d in range(n)
+        )
+
+
+def test_pdes_nonuniform_latency_bit_identical():
+    """Non-uniform link-latency matrices are inside the envelope: windows
+    come from per-partition-pair latency lower bounds, and the schedule
+    stays bit-identical for every partition count, serial and threaded."""
+    spec = Spec(
+        node_count=8, client_count=4, reqs_per_client=20, batch_size=4,
+        tweak_recorder=_two_region_tweak,
+    )
+    steps, fake_time, state = _run_seq(spec)
+    for partitions, threaded in [(2, False), (4, False), (2, True)]:
+        pdes = FastRecording(
+            spec, pdes_partitions=partitions, pdes_threaded=threaded
+        )
+        assert pdes.drain_clients(timeout=100_000_000) == steps
+        assert pdes.stats()[1] == fake_time
+        assert _state(pdes) == state
+
+
+def test_pdes_nonuniform_latency_widens_window():
+    """With partitions aligned to the two regions, the effective lookahead
+    is the minimum CROSS-partition latency — the wide inter-region bound,
+    not the narrow intra-region one a uniform-minimum window would use."""
+    spec = Spec(
+        node_count=8, client_count=2, reqs_per_client=10, batch_size=2,
+        tweak_recorder=_two_region_tweak,
+    )
+    pdes = FastRecording(spec, pdes_partitions=2)
+    pdes.drain_clients(timeout=100_000_000)
+    assert pdes.pdes_stats["lookahead"] >= 100
+
+
+def test_pdes_ack_ledger_on_parity():
+    """The sharded ack ledger runs ON under PDES (the run reports it) and
+    the per-client ack state — watermarks, voter masks, stored digests,
+    weak/strong sets — matches the sequential ledger run bit-for-bit."""
+    spec = Spec(
+        node_count=16, client_count=16, reqs_per_client=10, batch_size=100,
+        signed_requests=True,
+    )
+    seq = FastRecording(spec)
+    seq.drain_clients(timeout=100_000_000)
+    seq_ack = [seq._engine.node_ack_state(i) for i in range(spec.node_count)]
+    for partitions, threaded in [(2, False), (4, False), (8, True)]:
+        pdes = FastRecording(
+            spec, pdes_partitions=partitions, pdes_threaded=threaded
+        )
+        pdes.drain_clients(timeout=100_000_000)
+        assert pdes.pdes_stats["ledger_on"] == 1
+        assert [
+            pdes._engine.node_ack_state(i) for i in range(spec.node_count)
+        ] == seq_ack
+
+
+def test_pdes_drop_at_window_edge():
+    """DropMessages + two-region latency: sends from the silenced node are
+    suppressed at the partition-local send site while surviving traffic
+    straddles the narrow intra-region lookahead barriers (the 100-unit
+    window forces many barriers; epoch-change traffic crosses them)."""
+    from mirbft_tpu.testengine.manglers import DropMessages
+
+    def tweak(recorder):
+        _two_region_tweak(recorder)
+        recorder.mangler = DropMessages(from_nodes=(0,))
+
+    spec = Spec(
+        node_count=8, client_count=2, reqs_per_client=6, batch_size=2,
+        tweak_recorder=tweak,
+    )
+    steps, fake_time, state = _run_seq(spec, timeout=30_000_000)
+    for partitions, threaded in [(2, False), (4, True)]:
+        pdes = FastRecording(
+            spec, pdes_partitions=partitions, pdes_threaded=threaded
+        )
+        assert pdes.drain_clients(timeout=30_000_000) == steps
+        assert pdes.stats()[1] == fake_time
+        assert _state(pdes) == state
+
+
+def _c4_wan_spec():
+    """BASELINE config 4's topology shape (128 nodes, WAN latency, silenced
+    leader), device modes off — the PDES eligibility guard's subject."""
+    from mirbft_tpu.testengine.manglers import DropMessages
+
+    def tweak(recorder):
+        for nc in recorder.node_configs:
+            nc.runtime_parms.link_latency = 1000
+        recorder.mangler = DropMessages(from_nodes=(0,))
+
+    return Spec(
+        node_count=128, client_count=8, reqs_per_client=5, batch_size=20,
+        tweak_recorder=tweak,
+    )
+
+
+def test_pdes_config4_is_eligible():
+    """Tier-1 envelope-regression guard: BASELINE config 4's spec must be
+    PDES-eligible (bench.py's c4_pdes_* rows depend on it)."""
+    rec = FastRecording(_c4_wan_spec())
+    assert rec.pdes_check(4) is None
+
+
+@pytest.mark.slow
+def test_pdes_threaded_determinism_stress():
+    """Same seed, ten threaded runs: identical step counts, fake-time,
+    node state, and ack-ledger fingerprints every time (the barrier replay
+    makes the global order independent of thread scheduling)."""
+    spec = Spec(node_count=64, client_count=64, reqs_per_client=5,
+                batch_size=100)
+    reference = None
+    for _ in range(10):
+        pdes = FastRecording(spec, pdes_partitions=8, pdes_threaded=True)
+        steps = pdes.drain_clients(timeout=100_000_000)
+        ack = [
+            pdes._engine.node_ack_state(i) for i in range(spec.node_count)
+        ]
+        snapshot = (steps, pdes.stats()[1], _state(pdes), ack)
+        if reference is None:
+            reference = snapshot
+        else:
+            assert snapshot == reference
 
 
 def test_pdes_drop_messages_silenced_leader():
