@@ -12,13 +12,24 @@ they are implemented natively.  Rules of engagement:
 * Built with a direct ``g++`` invocation (no setuptools machinery, no
   pybind11 — neither is guaranteed in the image); the .so is cached next to
   the source and rebuilt when the source is newer.
+
+Sanitizer lane: with ``MIRBFT_TPU_SANITIZE=address[,undefined]`` set, both
+extensions build with the requested ``-fsanitize=`` instrumentation into
+``_native/sanitized/`` and load from there, so the whole native plane —
+including the PDES differential tests — runs against instrumented code.
+The hosting python is not ASan-built, so the caller must put the sanitizer
+runtime first in the process (``LD_PRELOAD``); ``sanitizer_preload()``
+below names the library, and ``tools/build_native.py --sanitize=...``
+prints a ready-to-paste invocation (docs/STATIC_ANALYSIS.md).
 """
 
 from __future__ import annotations
 
 import os
 import subprocess
+import sys
 import sysconfig
+from typing import Optional, Sequence, Tuple
 
 available = False
 core = None
@@ -26,22 +37,74 @@ fast_available = False
 fast = None
 
 _HERE = os.path.dirname(__file__)
+_SAN_DIR = os.path.join(_HERE, "sanitized")
 _SRC = os.path.join(_HERE, "ackplane.cpp")
 _SO = os.path.join(_HERE, "_core.so")
 _FAST_SRC = os.path.join(_HERE, "fastengine.cpp")
 _FAST_SO = os.path.join(_HERE, "_fast.so")
 
+SANITIZERS = ("address", "undefined")
 
-def _build(src: str, so: str) -> bool:
+
+def sanitizers_from_env() -> Tuple[str, ...]:
+    """The ``MIRBFT_TPU_SANITIZE`` selection, validated."""
+    raw = os.environ.get("MIRBFT_TPU_SANITIZE", "")
+    selected = tuple(s.strip() for s in raw.split(",") if s.strip())
+    unknown = set(selected) - set(SANITIZERS)
+    if unknown:
+        raise ValueError(
+            f"MIRBFT_TPU_SANITIZE names unknown sanitizers {sorted(unknown)}; "
+            f"supported: {', '.join(SANITIZERS)}"
+        )
+    return selected
+
+
+def _flags(sanitizers: Sequence[str] = ()) -> list:
+    flags = ["-std=c++17", "-shared", "-fPIC", "-pthread"]
+    if sanitizers:
+        # -O1 keeps stack traces honest; frame pointers make them cheap.
+        flags += [
+            "-O1",
+            "-g",
+            "-fno-omit-frame-pointer",
+            f"-fsanitize={','.join(sanitizers)}",
+        ]
+    else:
+        flags.append("-O2")
+    return flags
+
+
+def sanitizer_preload(sanitizers: Sequence[str]) -> Optional[str]:
+    """The runtime library a non-instrumented python must LD_PRELOAD to
+    host an instrumented extension (ASan insists on being loaded first;
+    libubsan rides along as an ordinary dependency of the .so)."""
+    if "address" not in sanitizers:
+        return None
+    try:
+        out = subprocess.run(
+            ["g++", "-print-file-name=libasan.so"],
+            check=True,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        ).stdout.strip()
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return None
+    return out if os.path.isabs(out) else None
+
+
+def sanitized_so_path(so: str) -> str:
+    return os.path.join(_SAN_DIR, os.path.basename(so))
+
+
+def _build(src: str, so: str, sanitizers: Sequence[str] = ()) -> bool:
     include = sysconfig.get_paths()["include"]
+    os.makedirs(os.path.dirname(so), exist_ok=True)
     tmp = so + ".tmp"
-    cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        "-I", include, src, "-o", tmp,
-    ]
+    cmd = ["g++", *_flags(sanitizers), "-I", include, src, "-o", tmp]
     try:
         subprocess.run(
-            cmd, check=True, capture_output=True, timeout=300
+            cmd, check=True, capture_output=True, timeout=600
         )
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
         return False
@@ -49,7 +112,29 @@ def _build(src: str, so: str) -> bool:
     return True
 
 
-def _load_one(src: str, so: str, modname: str):
+def _import_so(modname: str, so: str):
+    """Import an extension module, from the package directory (normal
+    import) or from an arbitrary path (sanitized artifacts — the PyInit
+    symbol comes from the last dotted component, so the qualified name
+    must keep the ``_core``/``_fast`` tail)."""
+    import importlib
+    import importlib.util
+
+    qualname = f"{__name__}.{modname}"
+    if os.path.dirname(so) == _HERE:
+        return importlib.import_module(qualname)
+    spec = importlib.util.spec_from_file_location(qualname, so)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load {so}")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    sys.modules[qualname] = module
+    return module
+
+
+def _load_one(
+    src: str, so: str, modname: str, sanitizers: Sequence[str] = ()
+):
     """Build (if stale) and import one extension; returns the module or None."""
     try:
         needs_build = (not os.path.exists(so)) or (
@@ -57,27 +142,48 @@ def _load_one(src: str, so: str, modname: str):
         )
     except OSError:
         needs_build = True
-    if needs_build and not _build(src, so):
+    if needs_build and not _build(src, so, sanitizers):
         return None
-    import importlib
-
     try:
-        return importlib.import_module(f"{__name__}.{modname}")
+        return _import_so(modname, so)
     except ImportError:
         # A stale ABI-incompatible artifact: rebuild once.
-        if not _build(src, so):
+        if not _build(src, so, sanitizers):
             return None
         try:
-            return importlib.import_module(f"{__name__}.{modname}")
+            return _import_so(modname, so)
         except ImportError:
             return None
+
+
+def build_sanitized(
+    sanitizers: Sequence[str], force: bool = False
+) -> dict:
+    """Build both extensions with instrumentation into
+    ``_native/sanitized/``; returns {modname: so_path or None}."""
+    out = {}
+    for src, so, modname in (
+        (_SRC, _SO, "_core"),
+        (_FAST_SRC, _FAST_SO, "_fast"),
+    ):
+        target = sanitized_so_path(so)
+        stale = force or (not os.path.exists(target)) or (
+            os.path.getmtime(src) > os.path.getmtime(target)
+        )
+        if stale and not _build(src, target, sanitizers):
+            out[modname] = None
+        else:
+            out[modname] = target
+    return out
 
 
 def _load() -> None:
     global available, core
     if os.environ.get("MIRBFT_TPU_NATIVE", "1") == "0":
         return
-    core = _load_one(_SRC, _SO, "_core")
+    sanitizers = sanitizers_from_env()
+    so = sanitized_so_path(_SO) if sanitizers else _SO
+    core = _load_one(_SRC, so, "_core", sanitizers)
     available = core is not None
 
 
@@ -95,7 +201,9 @@ def load_fast():
     _fast_attempted = True
     if os.environ.get("MIRBFT_TPU_NATIVE", "1") == "0":
         return None
-    fast = _load_one(_FAST_SRC, _FAST_SO, "_fast")
+    sanitizers = sanitizers_from_env()
+    so = sanitized_so_path(_FAST_SO) if sanitizers else _FAST_SO
+    fast = _load_one(_FAST_SRC, so, "_fast", sanitizers)
     fast_available = fast is not None
     return fast
 

@@ -180,6 +180,10 @@ class HealthMonitor:
         # window is (self._stall_since, None, low) until recovery.
         self.stall_windows: List[Tuple[float, Optional[float], int]] = []
         self.observations = 0
+        # Monitor state is written by the node's processing loop and read
+        # by snapshot(); the mutating entry points each take the lock,
+        # while plain-counter reads tolerate staleness by design.
+        # mirlint: allow(lock-map)
         self._lock = threading.Lock()
 
         # Commit progress, fed from the event stream (``ActionCommit`` in

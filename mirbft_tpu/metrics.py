@@ -141,6 +141,10 @@ class Registry:
     design of mainstream client libraries)."""
 
     def __init__(self):
+        # Creation-only lock; reads are deliberately lock-free (class
+        # docstring: CPython attribute increments are atomic enough for
+        # monitoring data).
+        # mirlint: allow(lock-map)
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}

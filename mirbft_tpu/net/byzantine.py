@@ -44,6 +44,15 @@ from ..testengine.manglers import EventMangling, mangler_from_spec
 from ..testengine.queue import SimEvent
 from .faults import DelayScheduler
 
+# Shared-state declaration for mirlint's lock-discipline pass: apply()
+# runs on every sender thread, and both the mangler latch state and the
+# RNG stream mutate on match, so they stay under the WireMangler lock
+# (docs/STATIC_ANALYSIS.md).
+MIRLINT_SHARED_STATE = {
+    "WireMangler._manglers": "_lock",
+    "WireMangler._rng": "_lock",
+}
+
 # Client ids this high can never exist in a standard network state; an ack
 # claiming one is protocol-invalid at every honest replica.
 _EQUIVOCATION_CLIENT_BASE = 1 << 20

@@ -37,6 +37,20 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .. import metrics as metrics_mod
 from .framing import FRAME_HEADER_LEN
 
+# Shared-state declaration for mirlint's lock-discipline pass: submit()
+# runs on node worker threads while reconfigure() runs on the control
+# thread, so every attribute below may only be touched under its lock
+# (docs/STATIC_ANALYSIS.md).
+MIRLINT_SHARED_STATE = {
+    "FaultInjector._plan": "_lock",
+    "FaultInjector._held": "_lock",
+    "FaultInjector._rngs": "_lock",
+    "DelayScheduler._heap": "_cond",
+    "DelayScheduler._counter": "_cond",
+    "DelayScheduler._stopped": "_cond",
+    "DelayScheduler._thread": "_cond",
+}
+
 # Injected-fault kinds (the `kind` label of net_faults_injected_total).
 INJECT_KINDS = (
     "drop",
@@ -294,12 +308,16 @@ class FaultInjector:
         ).inc()
 
     def _rng(self, dest: int) -> random.Random:
-        rng = self._rngs.get(dest)
-        if rng is None:
-            rng = self._rngs[dest] = random.Random(
-                (self._plan.seed * 1000003) ^ (self.node_id << 20) ^ dest
-            )
-        return rng
+        # Must lock: concurrent first-sends to distinct dests race the
+        # dict insert, and reconfigure() swaps _plan out from under the
+        # seed read.
+        with self._lock:
+            rng = self._rngs.get(dest)
+            if rng is None:
+                rng = self._rngs[dest] = random.Random(
+                    (self._plan.seed * 1000003) ^ (self.node_id << 20) ^ dest
+                )
+            return rng
 
     def reconfigure(self, plan: FaultPlan) -> None:
         """Swap the schedule mid-run (partition/heal choreography).  Held

@@ -58,6 +58,19 @@ CONNECTING = "connecting"
 UP = "up"
 BACKOFF = "backoff"
 
+# Shared-state declaration for mirlint's lock-discipline pass: the send
+# queue is filled by node worker threads and drained by the per-peer
+# sender thread, so queue state may only be touched under the peer's
+# condition; the accepted-connection list is shared between the acceptor
+# and stop() (docs/STATIC_ANALYSIS.md).  The remaining _Peer fields
+# (state/backoff_s/down_since/fault_recorded) are single-writer sender-
+# thread state and stay out of the map.
+MIRLINT_SHARED_STATE = {
+    "_Peer.frames": "cond",
+    "_Peer.queued_bytes": "cond",
+    "TcpTransport._conns": "_conns_lock",
+}
+
 _HANDSHAKE = struct.Struct(">I")
 
 

@@ -61,6 +61,9 @@ class _WorkErrNotifier:
     """Failure latch shared by the workers (reference mirbft.go:572-624)."""
 
     def __init__(self):
+        # The latch guards a single write-once error slot; every access
+        # is inside this class's two short methods, which take the lock.
+        # mirlint: allow(lock-map)
         self._lock = threading.Lock()
         self._err: Optional[BaseException] = None
         self.exit_event = threading.Event()

@@ -16,6 +16,18 @@ from ..messages import ClientState, RequestAck
 from ..statemachine.actions import Actions, Events
 from .interfaces import Hasher, RequestStore
 
+# Shared-state declaration for mirlint's lock-discipline pass: Propose
+# runs on client threads while state_applied/allocate run on the
+# processor loop, so per-client request state only moves under the
+# client's lock (docs/STATIC_ANALYSIS.md).
+MIRLINT_SHARED_STATE = {
+    "Client.next_req_no": "_lock",
+    "Client.requests": "_lock",
+    "_ClientRequest.local_allocation_digest": "_lock",
+    "_ClientRequest.remote_correct_digests": "_lock",
+    "Clients._clients": "_lock",
+}
+
 
 class ClientNotExistError(KeyError):
     pass

@@ -140,12 +140,15 @@ class RequestAuthenticator:
             for i, (client_id, req_no, envelope) in enumerate(items):
                 if len(self._memo) >= self._MEMO_CAP:
                     self._memo.clear()
+                # mirlint: allow(id-ordering) — identity memo key; hits are
+                # is-checked against the pinned envelope, never ordered.
                 self._memo[(client_id, req_no, id(envelope))] = (
                     envelope, bool(ok[i])
                 )
         return ok
 
     def authenticate(self, client_id: int, req_no: int, envelope: bytes) -> bool:
+        # mirlint: allow(id-ordering) — identity memo lookup (see above).
         key = (client_id, req_no, id(envelope))
         entry = self._memo.get(key)
         if entry is not None and entry[0] is envelope:

@@ -15,6 +15,14 @@ from typing import Optional
 
 from .messages import RequestAck
 
+# Shared-state declaration for mirlint's lock-discipline pass: one
+# sqlite3 connection shared across node worker threads
+# (check_same_thread=False), so every statement runs under the store
+# lock (docs/STATIC_ANALYSIS.md).
+MIRLINT_SHARED_STATE = {
+    "Store._db": "_lock",
+}
+
 
 class Store:
     """File-backed (or in-memory) ``processor.RequestStore``."""

@@ -53,6 +53,8 @@ _PACK_CAP = 8192
 
 
 def _packed_acks(batch) -> bytes:
+    # mirlint: allow(id-ordering) — identity memo key; the cache entry
+    # pins the object and is is-checked before use, never ordered.
     key = id(batch)
     entry = _PACK_CACHE.get(key)
     if entry is not None and entry[0] is batch:

@@ -524,10 +524,13 @@ class EpochTarget:
     def _ec_key(self, msg: EpochChange) -> tuple:
         """Content key for the digest memo.  The identity side-table entry
         stores the msg itself, pinning the id for the table's lifetime."""
+        # mirlint: allow(id-ordering) — identity side-table lookup; the
+        # entry pins msg and is is-checked, never ordered or hashed.
         entry = self._ec_keys.get(id(msg))
         if entry is not None and entry[0] is msg:
             return entry[1]
         key = tuple(epoch_change_hash_data(msg))
+        # mirlint: allow(id-ordering) — same identity side-table insert.
         self._ec_keys[id(msg)] = (msg, key)
         return key
 

@@ -43,6 +43,8 @@ _split_cache: dict = {}  # id(envelope) -> (weakref, (packed, votes, rest))
 
 def split_votes(envelope: MsgBatch) -> Tuple[bytes, list, list]:
     """(packed_votes, vote_msgs, rest) for an envelope, cached per object."""
+    # mirlint: allow(id-ordering) — identity memo key; the cache entry
+    # pins the object and is is-checked before use, never ordered.
     key = id(envelope)
     entry = _split_cache.get(key)
     if entry is not None and entry[0]() is envelope:

@@ -548,6 +548,8 @@ class DeviceAuthPlane:
         pending = self._pending
         added = False
         for rn, envelope in self.chunk_provider(client_id, req_no)[: self.lookahead]:
+            # mirlint: allow(id-ordering) — identity memo key; entries pin
+            # the envelope and are is-checked at fire time, never ordered.
             key = (client_id, rn, id(envelope))
             if key in memo or key in pending or key in self._issued:
                 continue
@@ -667,6 +669,7 @@ class DeviceAuthPlane:
     # -- fire-time ----------------------------------------------------------
 
     def authenticate(self, client_id: int, req_no: int, envelope: bytes) -> bool:
+        # mirlint: allow(id-ordering) — identity memo lookup (see above).
         key = (client_id, req_no, id(envelope))
         entry = self._memo.get(key)
         if entry is not None and entry[0] is envelope:

@@ -51,19 +51,41 @@ class PdesEnvelopeUnsupported(FastEngineUnsupported):
     """The config is outside the conservative-PDES envelope.
 
     ``reason`` carries the machine-readable code from the native layer's
-    structured ``pdes_envelope[<code>]: <detail>`` message (codes today:
-    ``state``, ``mangler``, ``device``, ``reconfig``, ``transfer_fail``,
-    ``latency``, ``partitions``); bench.py keys envelope coverage on it
-    instead of matching message prefixes."""
+    structured ``pdes_envelope[<code>]: <detail>`` message (the full set
+    is ``PDES_ENVELOPE_REASONS`` below, parity-checked against the C++
+    literals by mirlint); bench.py keys envelope coverage on it instead
+    of matching message prefixes."""
 
     def __init__(self, message: str, reason: str):
         super().__init__(message)
         self.reason = reason
 
 
+# Python source of truth for the native layer's pdes_envelope[<code>]
+# reason codes.  mirlint's parity-envelope-reasons rule diffs this tuple
+# against the string literals in _native/fastengine.cpp in both
+# directions, so adding a rejection on either side without the other
+# fails lint instead of silently miscategorizing bench coverage.
+PDES_ENVELOPE_REASONS = (
+    "state",
+    "mangler",
+    "device",
+    "reconfig",
+    "transfer_fail",
+    "latency",
+    "partitions",
+)
+
+
 # The native layer's structured envelope-rejection shape; everything else
 # raised out of run_pdes is an internal invariant failure and stays loud.
 _PDES_ENVELOPE = re.compile(r"^pdes_envelope\[([a-z_]+)\]")
+
+# Lock-discipline declaration (mirlint locks pass): the conservative-PDES
+# worker threads live entirely on the native side of run_pdes; this
+# wrapper is single-threaded per engine instance, so there is no
+# Python-visible shared state to guard.
+MIRLINT_SHARED_STATE: dict = {}
 
 
 # Message classes -> the native MT enum codes (fastengine.cpp `enum MT`).

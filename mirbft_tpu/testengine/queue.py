@@ -89,6 +89,8 @@ class EventQueue:
                     "pending events)"
                 )
             _, _, event = heapq.heappop(self._heap)
+            # mirlint: allow(id-ordering) — already-mangled marker keyed by
+            # object identity; membership only, never ordered.
             eid = id(event)
             if eid in self._mangled or self.mangler is None:
                 self._mangled.pop(eid, None)
@@ -97,6 +99,7 @@ class EventQueue:
             results = self.mangler.mangle(self.rand.getrandbits(62), event)
             for result in results:
                 if not result.remangle:
+                    # mirlint: allow(id-ordering) — same identity marker.
                     self._mangled[id(result.event)] = result.event
                 self.insert(result.event)
 

@@ -1,162 +1,35 @@
-"""Metric/span name lint: every instrument or span name used in the tree
-must be snake_case and documented in docs/OBSERVABILITY.md.  The health
-plane's anomaly and fault kinds (the ``kind`` label values of
-``anomalies_total`` / ``peer_faults_total``) are held to the same rule —
-dashboards select on them exactly like on metric names.
+"""Metric/span name lint — thin shim over mirlint's ``metric-names`` rule.
 
-Names drift silently otherwise: a renamed counter keeps compiling, the old
-dashboards/readers just read zero.  The tier-1 suite runs ``check()``
-(tests/test_tracing.py), so a new name without a docs entry fails CI.
-
-Usage: ``python -m mirbft_tpu.tools.check_metric_names`` (exit 1 on
-violations).
+The implementation moved into ``mirbft_tpu.tools.mirlint`` (parity pass),
+which also checks determinism, cross-engine constant parity, lock
+discipline and wire-schema drift; run ``python -m mirbft_tpu.tools.mirlint``
+for the full plane.  This module keeps the historical entry points
+(``check()``, ``REQUIRED_NAMES``, ``python -m
+mirbft_tpu.tools.check_metric_names``) so existing tier-1 tests and docs
+references keep working.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
-from typing import Dict, List
+from typing import List, Optional
 
-# Instrument creation through the registry helpers (module-level or any
-# registry/Registry object) with a literal name.
-_METRIC_CALL = re.compile(
-    r"\.(?:counter|gauge|histogram|timer)\(\s*\"([^\"]+)\"", re.MULTILINE
-)
-# Span/trace-event emission with a literal name.
-_SPAN_CALL = re.compile(
-    r"\.(?:span|complete|instant|counter_event)\(\s*\n?\s*\"([^\"]+)\"",
-    re.MULTILINE,
-)
-_SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
-# The two kind tuples in health.py, parsed textually (keeping this lint
-# import-free so it runs before the tree does).
-_KIND_TUPLE = re.compile(
-    r"^(ANOMALY_KINDS|FAULT_KINDS)\s*=\s*\(([^)]*)\)", re.MULTILINE
-)
-_KIND_ITEM = re.compile(r"\"([^\"]+)\"")
-
-# Dispatch-path phase instruments that MUST exist somewhere in the tree:
-# the pack/dispatch split is load-bearing for perf triage (docs/PERFORMANCE.md
-# "Dispatch-path anatomy"), so losing one of these in a refactor should fail
-# the lint even though the name regexes above only validate names that are
-# still present.
-REQUIRED_NAMES = (
-    "hash_pack_seconds",
-    "hash_device_dispatch_seconds",
-    "verify_pack_seconds",
-    "verify_device_dispatch_seconds",
-    "mesh_hash_dispatches",
-    "mesh_hashed_messages",
-    # Socket transport plane (net/tcp.py): the reconnect counter is how
-    # deployments observe outages (docs/TRANSPORT.md), and the byte
-    # counters are the only wire-level throughput signal — losing any of
-    # these in a refactor must fail the lint.
-    "net_tx_bytes_total",
-    "net_rx_bytes_total",
-    "net_tx_dropped_total",
-    "net_reconnects_total",
-    "net_peer_queue_depth",
-    "net_peer_up",
-    # Fused device pipeline (ops/fused.py) and adaptive wave sizing
-    # (testengine/crypto.py WaveController): the dispatch counters prove
-    # fused waves actually run, the gauge is the controller's only
-    # externally visible state.
-    "fused_wave_dispatches",
-    "fused_wave_messages",
-    "hash_wave_autotune_size",
-    # Fault-injection plane (net/faults.py, net/byzantine.py,
-    # tools/mirnet.py scenarios): the injected-fault ledger is one half of
-    # the doctor-judgment contract (docs/FAULTS.md), the verdict gauge is
-    # how soak results surface — a refactor dropping either breaks the
-    # machine-checkable injected-vs-attributed accounting.
-    "net_faults_injected_total",
-    "net_frames_corrupted_total",
-    "scenario_verdict",
-    # Conservative-PDES run stats (testengine/fastengine.py
-    # drain_clients_pdes): the window/barrier counters and imbalance gauge
-    # are the partitioned engine's only first-class observability — the
-    # BENCH trajectory's c3pdes*/c4_pdes_* keys derive from the same
-    # native stats, so silently losing these hides scaling regressions.
-    "pdes_windows_total",
-    "pdes_barrier_seconds",
-    "pdes_partition_imbalance",
+from .mirlint import (
+    REQUIRED_METRIC_NAMES as REQUIRED_NAMES,
+    check_metric_names,
+    repo_root,
 )
 
-
-def repo_root() -> Path:
-    return Path(__file__).resolve().parents[2]
+__all__ = ["REQUIRED_NAMES", "check", "main", "repo_root"]
 
 
-def collect_names(root: Path) -> Dict[str, List[str]]:
-    """{name: [file:line, ...]} for every literal metric/span name used
-    under mirbft_tpu/ and in bench.py (tests and this lint excluded)."""
-    sources = [p for p in (root / "mirbft_tpu").rglob("*.py")]
-    bench = root / "bench.py"
-    if bench.exists():
-        sources.append(bench)
-    out: Dict[str, List[str]] = {}
-    for path in sources:
-        if path.name == "check_metric_names.py":
-            continue
-        text = path.read_text()
-        for pattern in (_METRIC_CALL, _SPAN_CALL):
-            for match in pattern.finditer(text):
-                line = text.count("\n", 0, match.start()) + 1
-                out.setdefault(match.group(1), []).append(
-                    f"{path.relative_to(root)}:{line}"
-                )
-    return out
-
-
-def collect_kinds(root: Path) -> Dict[str, List[str]]:
-    """{kind: [source]} for every anomaly/fault kind declared in
-    mirbft_tpu/health.py (empty if the tuples go missing — which is itself
-    reported by ``check``)."""
-    text = (root / "mirbft_tpu" / "health.py").read_text()
-    out: Dict[str, List[str]] = {}
-    for match in _KIND_TUPLE.finditer(text):
-        tuple_name, body = match.groups()
-        for item in _KIND_ITEM.finditer(body):
-            out.setdefault(item.group(1), []).append(
-                f"mirbft_tpu/health.py:{tuple_name}"
-            )
-    return out
-
-
-def check(root: Path = None) -> List[str]:
+def check(root: Optional[Path] = None) -> List[str]:
     """Return violation messages (empty list = clean)."""
-    root = root or repo_root()
-    docs = (root / "docs" / "OBSERVABILITY.md").read_text()
-    violations: List[str] = []
-    kinds = collect_kinds(root)
-    if not kinds:
-        violations.append(
-            "no anomaly/fault kinds found in mirbft_tpu/health.py "
-            "(ANOMALY_KINDS/FAULT_KINDS tuples moved or renamed?)"
-        )
-    named = dict(collect_names(root))
-    for kind, sites in kinds.items():
-        named.setdefault(kind, []).extend(sites)
-    for required in REQUIRED_NAMES:
-        if required not in named:
-            violations.append(
-                f"required dispatch-path instrument {required!r} is no "
-                "longer emitted anywhere under mirbft_tpu/ or bench.py"
-            )
-    for name, sites in sorted(named.items()):
-        where = ", ".join(sites[:3])
-        if not _SNAKE_CASE.match(name):
-            violations.append(
-                f"metric/span/kind name {name!r} is not snake_case ({where})"
-            )
-        if f"`{name}`" not in docs:
-            violations.append(
-                f"metric/span/kind name {name!r} is not documented in "
-                f"docs/OBSERVABILITY.md ({where})"
-            )
-    return violations
+    return [
+        f"{finding.path}:{finding.line}: {finding.message}"
+        for finding in check_metric_names(root or repo_root())
+    ]
 
 
 def main() -> int:

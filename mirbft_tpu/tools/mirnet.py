@@ -184,6 +184,10 @@ class _CommitLogApp:
 
     def __init__(self, log_path: Path):
         self._file = open(log_path, "a", buffering=1)
+        # Harness-side observation ledger; the append/record methods all
+        # take the lock, and the summary readers run after the child
+        # processes have exited.
+        # mirlint: allow(lock-map)
         self._lock = threading.Lock()
         self.last_checkpoint = (0, b"")
         self.state_transfers: List[int] = []

@@ -1,0 +1,536 @@
+"""mirlint: the static-analysis plane (mirbft_tpu/tools/mirlint.py).
+
+Two layers:
+
+* a fixture corpus of known-bad snippets — one per rule, including
+  pragma-allowlisted variants and a synthetic C++/Python drift pair — each
+  asserting the pass fires at exactly the expected file:line;
+* tier-1 zero-findings gates running every pass over the real tree, so any
+  future nondeterminism source, cross-engine constant drift, unlocked
+  shared-state access, or unserializable message field fails CI here.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from mirbft_tpu.tools import mirlint
+
+REPO = mirlint.repo_root()
+
+
+def _write(tmp_path: Path, name: str, body: str) -> Path:
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(body))
+    return path
+
+
+def _rules(findings):
+    return [(f.line, f.rule) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: determinism fixtures
+
+
+def _determinism(tmp_path, body):
+    path = _write(tmp_path, "bad.py", body)
+    return mirlint.determinism_pass(tmp_path, files=[path])
+
+
+def test_wall_clock_fires_and_perf_counter_is_exempt(tmp_path):
+    findings = _determinism(
+        tmp_path,
+        """\
+        import time
+
+        def stamp():
+            ok = time.perf_counter()
+            return time.time()
+        """,
+    )
+    assert _rules(findings) == [(5, "wall-clock")]
+
+
+def test_wall_clock_sees_through_import_alias(tmp_path):
+    findings = _determinism(
+        tmp_path,
+        """\
+        import time as _time
+
+        def stamp():
+            return _time.monotonic()
+        """,
+    )
+    assert _rules(findings) == [(4, "wall-clock")]
+
+
+def test_unseeded_random_rules(tmp_path):
+    findings = _determinism(
+        tmp_path,
+        """\
+        import os
+        import random
+        import uuid
+
+        def draw(seed):
+            good = random.Random(seed).random()
+            a = random.random()
+            b = random.Random()
+            c = os.urandom(8)
+            d = uuid.uuid4()
+            return (good, a, b, c, d)
+        """,
+    )
+    assert _rules(findings) == [
+        (7, "unseeded-random"),
+        (8, "unseeded-random"),
+        (9, "unseeded-random"),
+        (10, "unseeded-random"),
+    ]
+
+
+def test_id_ordering_fires_and_pragma_silences(tmp_path):
+    findings = _determinism(
+        tmp_path,
+        """\
+        def keys(batch, other):
+            allowed = id(other)  # mirlint: allow(id-ordering) — identity cache
+            return (id(batch), allowed)
+        """,
+    )
+    assert _rules(findings) == [(3, "id-ordering")]
+
+
+def test_pragma_comment_block_above_statement(tmp_path):
+    findings = _determinism(
+        tmp_path,
+        """\
+        def key(batch):
+            # mirlint: allow(id-ordering) — identity memo, is-checked on
+            # every hit, never ordered (two-line rationale comment).
+            return id(batch)
+        """,
+    )
+    assert findings == []
+
+
+def test_set_iteration_rules(tmp_path):
+    findings = _determinism(
+        tmp_path,
+        """\
+        def order(ids):
+            out = []
+            for x in {1, 2, 3}:
+                out.append(x)
+            flat = list(set(ids))
+            text = ",".join({"a", "b"})
+            comp = [x for x in set(ids) | {0}]
+            ok = sorted(set(ids))
+            return out, flat, text, comp, ok
+        """,
+    )
+    assert _rules(findings) == [
+        (3, "set-iteration"),
+        (5, "set-iteration"),
+        (6, "set-iteration"),
+        (7, "set-iteration"),
+    ]
+
+
+def test_dict_serialization_rule(tmp_path):
+    findings = _determinism(
+        tmp_path,
+        """\
+        import json
+
+        def dump(d):
+            canonical = json.dumps(d, sort_keys=True)
+            return json.dumps(d), canonical
+        """,
+    )
+    assert _rules(findings) == [(5, "dict-serialization")]
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: parity fixtures (synthetic C++/Python drift pairs)
+
+
+_MINI_CPP = """\
+// mini engine for drift tests
+enum class MT : u8 { Alpha, Beta };
+static const char *r1 = "pdes_envelope[state]: fresh engines only";
+static const char *r2 = "pdes_envelope[mangler]: no consume-time manglers";
+"""
+
+_MINI_ENGINE = """\
+PDES_ENVELOPE_REASONS = ("state", "mangler")
+
+
+def _mt_codes():
+    from .. import messages as m
+
+    return {m.Alpha: 0, m.Beta: 1}
+"""
+
+_MINI_MESSAGES = """\
+Msg = Union[Alpha, Beta]
+"""
+
+
+def test_envelope_parity_clean(tmp_path):
+    cpp = _write(tmp_path, "fastengine.cpp", _MINI_CPP)
+    py = _write(tmp_path, "fastengine.py", _MINI_ENGINE)
+    assert mirlint.check_envelope_parity(cpp, py) == []
+
+
+def test_envelope_parity_is_bidirectional(tmp_path):
+    # Drop a reason code from the C++ side: the Python tuple now lists a
+    # code the native engine never emits.
+    cpp = _write(
+        tmp_path,
+        "a/fastengine.cpp",
+        _MINI_CPP.replace('"pdes_envelope[mangler]: no consume-time manglers"', '""'),
+    )
+    py = _write(tmp_path, "a/fastengine.py", _MINI_ENGINE)
+    findings = mirlint.check_envelope_parity(cpp, py)
+    assert [f.rule for f in findings] == ["parity-envelope-reasons"]
+    assert "mangler" in findings[0].message
+    assert findings[0].path == str(py)
+
+    # Drop it from the Python side instead: the C++ literal is now
+    # unaccounted for — same rule, opposite direction.
+    cpp = _write(tmp_path, "b/fastengine.cpp", _MINI_CPP)
+    py = _write(
+        tmp_path, "b/fastengine.py", _MINI_ENGINE.replace('"mangler"', "")
+    )
+    findings = mirlint.check_envelope_parity(cpp, py)
+    assert [f.rule for f in findings] == ["parity-envelope-reasons"]
+    assert "mangler" in findings[0].message
+    assert findings[0].path == str(cpp)
+
+
+def test_envelope_parity_on_real_tree_scratch_copy(tmp_path):
+    """The acceptance-criterion drill on real sources: deleting one reason
+    code from a scratch copy of either engine fails the pass."""
+    real_cpp = (REPO / "mirbft_tpu/_native/fastengine.cpp").read_text()
+    real_py = (REPO / "mirbft_tpu/testengine/fastengine.py").read_text()
+
+    cpp = _write(tmp_path, "a/fastengine.cpp", real_cpp)
+    py = _write(
+        tmp_path, "a/fastengine.py", real_py.replace('    "partitions",\n', "")
+    )
+    findings = mirlint.check_envelope_parity(cpp, py)
+    assert any("partitions" in f.message for f in findings)
+
+    cpp = _write(
+        tmp_path,
+        "b/fastengine.cpp",
+        real_cpp.replace("pdes_envelope[partitions]", "pdes_envelope[latency]"),
+    )
+    py = _write(tmp_path, "b/fastengine.py", real_py)
+    findings = mirlint.check_envelope_parity(cpp, py)
+    assert any(
+        "partitions" in f.message and f.rule == "parity-envelope-reasons"
+        for f in findings
+    )
+
+
+def test_msg_kind_parity_drift(tmp_path):
+    cpp = _write(tmp_path, "fastengine.cpp", _MINI_CPP)
+    eng = _write(tmp_path, "fastengine.py", _MINI_ENGINE)
+    msgs = _write(tmp_path, "messages.py", _MINI_MESSAGES)
+    assert mirlint.check_msg_kind_parity(cpp, eng, msgs) == []
+
+    # Reorder the C++ enum: the positional codes no longer agree.
+    cpp2 = _write(
+        tmp_path,
+        "drift/fastengine.cpp",
+        _MINI_CPP.replace("{ Alpha, Beta }", "{ Beta, Alpha }"),
+    )
+    findings = mirlint.check_msg_kind_parity(cpp2, eng, msgs)
+    assert findings and all(f.rule == "parity-msg-kinds" for f in findings)
+
+    # Grow the Msg union without teaching _mt_codes about the member.
+    msgs2 = _write(
+        tmp_path,
+        "drift/messages.py",
+        "Msg = Union[Alpha, Beta, Gamma]\n",
+    )
+    findings = mirlint.check_msg_kind_parity(cpp, eng, msgs2)
+    assert any("Gamma" in f.message for f in findings)
+
+
+def test_wire_tag_parity_drift(tmp_path):
+    cpp = _write(
+        tmp_path,
+        "fastengine.cpp",
+        """\
+        enum WireTag : u32 {
+            TAG_Alpha = 0,
+            TAG_Beta = 1,
+        };
+        """,
+    )
+    wire = _write(
+        tmp_path,
+        "wire.py",
+        """\
+        _REGISTRY_ORDER: List[type] = [
+            m.Alpha,
+            m.Beta,
+        ]
+        """,
+    )
+    assert mirlint.check_wire_tag_parity(cpp, wire) == []
+    wire2 = _write(
+        tmp_path,
+        "drift/wire.py",
+        """\
+        _REGISTRY_ORDER: List[type] = [
+            m.Alpha,
+            m.Inserted,
+            m.Beta,
+        ]
+        """,
+    )
+    findings = mirlint.check_wire_tag_parity(cpp, wire2)
+    assert _rules(findings) == [(3, "parity-wire-tags")]
+    assert "TAG_Beta" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# Pass 3: lock-discipline fixtures
+
+
+def test_lock_discipline_fires_outside_with(tmp_path):
+    path = _write(
+        tmp_path,
+        "mirbft_tpu/threaded.py",
+        """\
+        import threading
+
+        MIRLINT_SHARED_STATE = {"Box._items": "_lock"}
+
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def ok(self):
+                with self._lock:
+                    return len(self._items)
+
+            def bad(self):
+                return self._items.pop()
+        """,
+    )
+    findings = mirlint.locks_pass(tmp_path, files=[path])
+    assert _rules(findings) == [(16, "lock-discipline")]
+    assert "_items" in findings[0].message
+
+
+def test_lock_discipline_pragma(tmp_path):
+    path = _write(
+        tmp_path,
+        "mirbft_tpu/threaded.py",
+        """\
+        import threading
+
+        MIRLINT_SHARED_STATE = {"Box._items": "_lock"}
+
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def racy_len(self):
+                # mirlint: allow(lock-discipline) — stale len is fine here
+                return len(self._items)
+        """,
+    )
+    assert mirlint.locks_pass(tmp_path, files=[path]) == []
+
+
+def test_lock_map_required_for_lock_creation(tmp_path):
+    path = _write(
+        tmp_path,
+        "mirbft_tpu/undeclared.py",
+        """\
+        import threading
+
+
+        class Quiet:
+            def __init__(self):
+                self._lock = threading.Lock()
+        """,
+    )
+    findings = mirlint.locks_pass(tmp_path, files=[path])
+    assert _rules(findings) == [(6, "lock-map")]
+
+    pragmad = _write(
+        tmp_path,
+        "mirbft_tpu/pragmad.py",
+        """\
+        import threading
+
+
+        class Quiet:
+            def __init__(self):
+                # mirlint: allow(lock-map) — creation-only, documented
+                self._lock = threading.Lock()
+        """,
+    )
+    assert mirlint.locks_pass(tmp_path, files=[pragmad]) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 4: wire-schema fixtures
+
+
+_MINI_WIRE = """\
+_REGISTRY_ORDER: List[type] = [
+    m.Registered,
+]
+"""
+
+
+def test_wire_registry_rule(tmp_path):
+    messages = _write(
+        tmp_path,
+        "messages.py",
+        """\
+        from dataclasses import dataclass
+
+
+        @dataclass(frozen=True)
+        class Registered:
+            seq_no: int
+
+
+        @dataclass(frozen=True)
+        class Forgotten:
+            digest: bytes
+        """,
+    )
+    state = _write(tmp_path, "state.py", "")
+    wire = _write(tmp_path, "wire.py", _MINI_WIRE)
+    findings = mirlint.wire_static_pass(messages, state, wire)
+    assert [(f.line, f.rule) for f in findings] == [(10, "wire-registry")]
+    assert "Forgotten" in findings[0].message
+
+
+def test_wire_annotation_rule(tmp_path):
+    messages = _write(
+        tmp_path,
+        "messages.py",
+        """\
+        from dataclasses import dataclass
+        from typing import Dict, Optional, Tuple
+
+
+        @dataclass(frozen=True)
+        class Registered:
+            seq_no: int
+            digests: Tuple[bytes, ...]
+            maybe: Optional[int]
+            table: Dict[str, int]
+        """,
+    )
+    state = _write(tmp_path, "state.py", "")
+    wire = _write(tmp_path, "wire.py", _MINI_WIRE)
+    findings = mirlint.wire_static_pass(messages, state, wire)
+    assert [(f.line, f.rule) for f in findings] == [(10, "wire-annotation")]
+    assert "table" in findings[0].message
+
+
+def test_wire_dynamic_roundtrip_on_real_registry():
+    """Every registered class synthesizes, round-trips the wire codec,
+    and renders every field through the textmarshal path."""
+    assert mirlint.wire_dynamic_pass() == []
+
+
+# ---------------------------------------------------------------------------
+# The real tree is clean + CLI contract
+
+
+@pytest.mark.parametrize("pass_name", mirlint.PASSES)
+def test_real_tree_has_zero_findings(pass_name):
+    findings = mirlint.lint(passes=[pass_name])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exits_zero_and_emits_summary_on_real_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mirbft_tpu.tools.mirlint"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "mirlint_findings_total 0" in proc.stdout
+
+
+def test_cli_json_output():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mirbft_tpu.tools.mirlint", "--json"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["total"] == 0
+    assert payload["findings"] == []
+    assert set(payload["passes"]) == set(mirlint.PASSES)
+    assert "mirlint_findings_total 0" in proc.stderr
+
+
+def test_cli_exit_one_with_precise_location_on_bad_tree(tmp_path):
+    _write(
+        tmp_path,
+        "mirbft_tpu/statemachine/bad.py",
+        """\
+        import time
+
+
+        def stamp():
+            return time.time()
+        """,
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "mirbft_tpu.tools.mirlint",
+            "--root",
+            str(tmp_path),
+            "--passes",
+            "determinism,locks",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "bad.py:5: [wall-clock]" in proc.stdout
+    assert "mirlint_findings_total 1" in proc.stdout
+
+
+def test_check_metric_names_shim_still_works():
+    from mirbft_tpu.tools import check_metric_names
+
+    assert check_metric_names.check() == []
+    assert check_metric_names.REQUIRED_NAMES == mirlint.REQUIRED_METRIC_NAMES

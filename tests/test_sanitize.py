@@ -1,0 +1,130 @@
+"""Sanitizer lane: the native engines under ASan/UBSan.
+
+Rebuilds both extensions with ``-fsanitize=address,undefined`` into
+``_native/sanitized/`` (cached across runs — only a cold tree pays the
+~3 min compile) and re-runs the native-plane smoke tests plus a PDES
+differential against the instrumented .so as subprocesses.  The hosting
+python is not ASan-built, so the children run with the ASan runtime
+LD_PRELOADed and leak detection off (CPython "leaks" interned objects at
+exit by design).
+
+Marked both ``sanitize`` and ``slow``: the tier-1 ``-m "not slow"`` gate
+never pays for the instrumented rebuild.  Run with::
+
+    python -m pytest tests/ -m sanitize -q
+
+or via the printed invocation from
+``python -m mirbft_tpu.tools.build_native --sanitize=address,undefined``.
+See docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from mirbft_tpu import _native
+
+pytestmark = [pytest.mark.sanitize, pytest.mark.slow]
+
+SANITIZERS = ("address", "undefined")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ASAN_BADGES = ("ERROR: AddressSanitizer", "ERROR: LeakSanitizer")
+_UBSAN_BADGE = "runtime error:"
+
+
+@pytest.fixture(scope="module")
+def san_env():
+    """Build the instrumented artifacts and return the child environment."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    preload = _native.sanitizer_preload(SANITIZERS)
+    if preload is None:
+        pytest.skip("libasan runtime not found (g++ -print-file-name)")
+    built = _native.build_sanitized(SANITIZERS)
+    if any(so is None for so in built.values()):
+        pytest.skip(f"sanitized build failed: {built}")
+    env = dict(os.environ)
+    env.update(
+        MIRBFT_TPU_SANITIZE=",".join(SANITIZERS),
+        LD_PRELOAD=preload,
+        ASAN_OPTIONS="detect_leaks=0",
+        JAX_PLATFORMS="cpu",
+    )
+    return env
+
+
+def _run(args, env, timeout):
+    proc = subprocess.run(
+        args,
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    blob = proc.stdout + "\n" + proc.stderr
+    for badge in _ASAN_BADGES + (_UBSAN_BADGE,):
+        assert badge not in blob, blob[-4000:]
+    return proc, blob
+
+
+_DIFFERENTIAL = """\
+from mirbft_tpu import _native
+assert _native.available, "sanitized _core failed to load"
+fast = _native.load_fast()
+assert fast is not None, "sanitized _fast failed to load"
+assert "sanitized" in _native.core.__file__, _native.core.__file__
+assert "sanitized" in fast.__file__, fast.__file__
+
+from mirbft_tpu.testengine import Spec
+from mirbft_tpu.testengine.fastengine import FastRecording
+
+spec = Spec(node_count=4, client_count=4, reqs_per_client=20, batch_size=5)
+
+seq = FastRecording(spec)
+seq.drain_clients(timeout=100_000_000)
+seq_steps, seq_time = seq.stats()[0], seq.stats()[1]
+
+par = FastRecording(spec, pdes_partitions=2)
+par.drain_clients(timeout=100_000_000)
+par_steps, par_time = par.stats()[0], par.stats()[1]
+
+assert seq_steps == par_steps, (seq_steps, par_steps)
+assert seq_time == par_time, (seq_time, par_time)
+print("PDES_DIFFERENTIAL_OK", seq_steps)
+"""
+
+
+def test_pdes_differential_under_sanitizers(san_env):
+    """Sequential vs partitioned PDES stay bit-identical while every
+    native instruction runs instrumented."""
+    proc, blob = _run(
+        [sys.executable, "-c", _DIFFERENTIAL], san_env, timeout=900
+    )
+    assert proc.returncode == 0, blob[-4000:]
+    assert "PDES_DIFFERENTIAL_OK" in proc.stdout, blob[-4000:]
+
+
+def test_native_plane_smoke_under_sanitizers(san_env):
+    """The tier-1 native-plane suite passes against the instrumented .so
+    (the ISSUE 9 acceptance smoke)."""
+    proc, blob = _run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_native_plane.py",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+        ],
+        san_env,
+        timeout=900,
+    )
+    assert proc.returncode == 0, blob[-4000:]
