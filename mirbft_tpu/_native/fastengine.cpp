@@ -5366,8 +5366,15 @@ struct EpochTarget {
                 continue;
             }
             const BatchRec *batch = batch_tracker->get_batch(digest);
-            if (!batch)
+            if (!batch) {
+                if (seq_no <= commit_state->highest_commit)
+                    // Already committed (fetch loop skipped it) and
+                    // possibly checkpoint-truncated from the tracker;
+                    // its QEntry is in the log from the original commit
+                    // (mirrors epoch_target.py fetch_new_epoch_state).
+                    continue;
                 throw EngineError("batch verified above is now missing");
+            }
             auto q = std::make_shared<QEntryS>();
             q->seq = seq_no;
             q->dig = digest;

@@ -4,7 +4,7 @@ A frame is::
 
     magic   2 bytes  b"MB"
     version 1 byte   FRAME_VERSION
-    kind    1 byte   KIND_HANDSHAKE / KIND_MSG / KIND_CLIENT
+    kind    1 byte   KIND_HANDSHAKE / KIND_MSG / KIND_CLIENT / KIND_SNAPSHOT
     length  4 bytes  big-endian payload length
     crc32   4 bytes  big-endian CRC32 of the payload
     payload ``length`` bytes (``wire.encode`` output for KIND_MSG)
@@ -31,10 +31,13 @@ FRAME_HEADER_LEN = 12
 
 # Frame kinds.  KIND_HANDSHAKE must be the first frame on every connection
 # (tcp.py); KIND_MSG carries one wire-encoded protocol message; KIND_CLIENT
-# carries a client-submission envelope (tools/mirnet.py).
+# carries a client-submission envelope (tools/mirnet.py); KIND_SNAPSHOT
+# carries one snapshot state-transfer subframe — request, chunk, or
+# missing (storage/snapshot.py).
 KIND_HANDSHAKE = 0
 KIND_MSG = 1
 KIND_CLIENT = 2
+KIND_SNAPSHOT = 3
 
 # Upper bound on a single payload.  Generous against the largest legitimate
 # protocol message (a MsgBatch of a full iteration's sends), tight against
@@ -99,7 +102,12 @@ class FrameDecoder:
                     raise FrameError(f"bad frame magic {bytes(magic)!r}")
                 if version != FRAME_VERSION:
                     raise FrameError(f"unsupported frame version {version}")
-                if kind not in (KIND_HANDSHAKE, KIND_MSG, KIND_CLIENT):
+                if kind not in (
+                    KIND_HANDSHAKE,
+                    KIND_MSG,
+                    KIND_CLIENT,
+                    KIND_SNAPSHOT,
+                ):
                     raise FrameError(f"unknown frame kind {kind}")
                 if length > self._max_payload:
                     raise FrameError(

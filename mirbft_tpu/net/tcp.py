@@ -50,6 +50,7 @@ from .framing import (
     KIND_CLIENT,
     KIND_HANDSHAKE,
     KIND_MSG,
+    KIND_SNAPSHOT,
     encode_frame,
 )
 
@@ -158,6 +159,7 @@ class TcpTransport:
         }
         self._on_message: Optional[Callable[[int, object], None]] = None
         self._on_client: Optional[Callable[[bytes, Callable], None]] = None
+        self._on_snapshot: Optional[Callable[[bytes], Optional[bytes]]] = None
         self._stop = threading.Event()
         self._threads: list = []
         self._conns: list = []
@@ -184,14 +186,18 @@ class TcpTransport:
         self,
         on_message: Callable[[int, object], None],
         on_client: Optional[Callable[[bytes, Callable], None]] = None,
+        on_snapshot: Optional[Callable[[bytes], Optional[bytes]]] = None,
     ) -> None:
         """Begin accepting and dialing.  ``on_message(source, msg)`` is
         invoked on reader threads for every inbound protocol message (the
         node's thread-safe ``step``); ``on_client(payload, reply)`` for
         KIND_CLIENT frames (``reply(payload)`` answers on the same
-        connection — the mirnet submission path)."""
+        connection — the mirnet submission path); ``on_snapshot(digest)``
+        returns the local snapshot body (or None) for KIND_SNAPSHOT
+        state-transfer requests (storage/snapshot.py)."""
         self._on_message = on_message
         self._on_client = on_client
+        self._on_snapshot = on_snapshot
         accept = threading.Thread(
             target=self._accept_loop,
             name=f"net{self.node_id}-accept",
@@ -449,6 +455,11 @@ class TcpTransport:
                             self._log_drop("unexpected client frame")
                             return
                         self._on_client(payload, reply)
+                    elif kind == KIND_SNAPSHOT:
+                        if self._on_snapshot is None:
+                            self._log_drop("unexpected snapshot frame")
+                            return
+                        self._serve_snapshot(conn, payload)
         except FrameError as exc:
             self._log_drop(f"frame error from peer {source}: {exc}")
         except Exception as exc:  # decode error, stopped node, ...
@@ -458,6 +469,24 @@ class TcpTransport:
                 conn.close()
             except OSError:
                 pass
+
+    def _serve_snapshot(self, conn: socket.socket, payload: bytes) -> None:
+        """Answer one snapshot state-transfer request on the requester's
+        connection.  The chunk stream can be many MiB, so the 0.2 s reader
+        timeout is lifted for the duration of the sendall burst."""
+        # Local import: storage depends on net.framing, so importing at
+        # module level would make the dependency circular.
+        from ..storage import snapshot as snapmod
+
+        replies = snapmod.serve_request(payload, self._on_snapshot)
+        conn.settimeout(None)
+        try:
+            for reply_payload in replies:
+                frame = encode_frame(KIND_SNAPSHOT, reply_payload)
+                conn.sendall(frame)
+                self._tx_bytes.inc(len(frame))
+        finally:
+            conn.settimeout(0.2)
 
     def _log_drop(self, why: str) -> None:
         self.tracer.instant(

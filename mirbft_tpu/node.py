@@ -301,7 +301,9 @@ class Node:
     def _handlers(self) -> Dict[str, Callable]:
         pc = self.processor_config
         return {
-            "wal": lambda actions: proc.process_wal_actions(pc.wal, actions),
+            "wal": lambda actions: proc.process_wal_actions(
+                pc.wal, actions, request_store=pc.request_store
+            ),
             "net": lambda actions: proc.process_net_actions(
                 self.id, pc.link, actions, request_store=pc.request_store
             ),

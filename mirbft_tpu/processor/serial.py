@@ -71,22 +71,46 @@ def recover_wal_for_existing_node(
     return events
 
 
-def process_wal_actions(wal: WAL, actions: Actions) -> Actions:
+def process_wal_actions(
+    wal: WAL, actions: Actions, request_store: Optional[RequestStore] = None
+) -> Actions:
     """Execute Persist/Truncate actions, sync, and pass Sends through —
-    the fsync-before-send barrier (reference serial.go:128-156)."""
+    the fsync-before-send barrier (reference serial.go:128-156).
+
+    When the request store supports checkpoint-keyed GC
+    (``storage.LogStore``), the WAL worker is also where the GC protocol
+    anchors: persisting a checkpoint CEntry *notes* its per-client low
+    watermarks against its WAL index, and a Truncate — emitted only once
+    a checkpoint is stable (statemachine/persisted.py) — releases the GC
+    for the noted watermarks at or below that index.  Both hooks are
+    advisory and degrade to no-ops on stores without them."""
     net_actions = Actions()
+    truncated_at: Optional[int] = None
+    note = getattr(request_store, "note_checkpoint", None)
+    gc = getattr(request_store, "gc", None)
     for action in actions:
         if isinstance(action, st.ActionSend):
             net_actions.push_back(action)
         elif isinstance(action, st.ActionPersist):
             wal.write(action.index, action.entry)
+            if note is not None and isinstance(action.entry, CEntry):
+                note(
+                    action.index,
+                    {
+                        client.id: client.low_watermark
+                        for client in action.entry.network_state.clients
+                    },
+                )
         elif isinstance(action, st.ActionTruncate):
             wal.truncate(action.index)
+            truncated_at = action.index
         else:
             raise AssertionError(
                 f"unexpected WAL action type {type(action).__name__}"
             )
     wal.sync()
+    if gc is not None and truncated_at is not None:
+        gc(truncated_at)
     return net_actions
 
 

@@ -21,6 +21,7 @@ from typing import Callable, List, Optional, Tuple
 
 from . import wire
 from .messages import Persistent
+from .storage.segments import fsync_dir
 
 _LOW_MARK_FILE = "lowmark"
 
@@ -73,6 +74,7 @@ class WAL:
         tmp = self.dir / (_LOW_MARK_FILE + ".tmp")
         tmp.write_text(str(index))
         os.replace(tmp, self.dir / _LOW_MARK_FILE)
+        fsync_dir(self.dir)  # the rename must survive a crash
 
     # --- segments ---
 
@@ -116,6 +118,9 @@ class WAL:
                     os.fsync(fh.fileno())
         self._fh = open(self._active_path, "ab")
         self._active_size = self._active_path.stat().st_size
+        # A crash between creating the segment and syncing the directory
+        # loses the file even though its data was fsynced.
+        fsync_dir(self.dir)
 
     # --- WAL protocol ---
 
@@ -142,12 +147,19 @@ class WAL:
         self._low_index = index
         self._write_low_mark(index)
         segments = self._segments()
+        unlinked = False
         for i, (first, path) in enumerate(segments):
             next_first = (
                 segments[i + 1][0] if i + 1 < len(segments) else None
             )
             if next_first is not None and next_first <= index and path != self._active_path:
                 path.unlink()
+                unlinked = True
+        if unlinked:
+            # A crash before the directory syncs can resurrect an unlinked
+            # segment; harmless for reads (lowmark filters it) but it would
+            # un-reclaim the space truncate just promised to free.
+            fsync_dir(self.dir)
 
     def sync(self) -> None:
         if self._fh is not None:

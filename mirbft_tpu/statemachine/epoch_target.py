@@ -399,6 +399,14 @@ class EpochTarget:
                 continue
             batch = self.batch_tracker.get_batch(digest)
             if batch is None:
+                if seq_no <= self.commit_state.highest_commit:
+                    # Already committed, so the fetch loop above skipped it
+                    # — and a checkpoint reached meanwhile (commits or
+                    # state transfer racing a slow epoch change) may have
+                    # truncated it from the tracker.  Its QEntry is
+                    # already in the log from the original commit; nothing
+                    # to re-persist.
+                    continue
                 raise AssertionError("batch verified above is now missing")
             actions.concat(
                 self.persisted.add_q_entry(

@@ -126,6 +126,13 @@ def _parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         metavar="OUT.json",
         help="with --doctor: also write the full report as JSON",
     )
+    parser.add_argument(
+        "--wal",
+        action="store_true",
+        help="treat LOG as a group-commit WAL directory: dump/verify the "
+        "segments offline (record CRCs, index continuity, torn-tail "
+        "report); exits 1 on problems",
+    )
     return parser.parse_args(argv)
 
 
@@ -326,8 +333,40 @@ def _print_deployment_report(report: dict) -> None:
     )
 
 
+def _print_wal_report(report: dict) -> None:
+    print(f"wal dir: {report['dir']}")
+    print(f"low index: {report['low_index']}")
+    header = f"{'segment':<24} {'records':>8} {'first':>8} {'last':>8} {'bytes':>10} {'valid':>10}  status"
+    print(header)
+    print("-" * len(header))
+    for seg in report["segments"]:
+        first = seg["first_index"] if seg["first_index"] is not None else "-"
+        last = seg["last_index"] if seg["last_index"] is not None else "-"
+        print(
+            f"{seg['name']:<24} {seg['records']:>8} {first:>8} {last:>8} "
+            f"{seg['bytes']:>10} {seg['valid_bytes']:>10}  {seg['status']}"
+        )
+    print(f"live records (>= low index): {report['live_records']}")
+    if report["problems"]:
+        print("problems:")
+        for problem in report["problems"]:
+            print(f"  - {problem}")
+    else:
+        print("no problems found")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _parse_args(argv)
+
+    if args.wal:
+        from ..storage import wal_segment_report
+
+        if not Path(args.log).is_dir():
+            print("mircat: --wal requires a WAL directory", file=sys.stderr)
+            return 2
+        report = wal_segment_report(args.log)
+        _print_wal_report(report)
+        return 0 if report["ok"] else 1
 
     if Path(args.log).is_dir():
         if not (args.doctor or args.doctor_json):

@@ -952,6 +952,12 @@ REQUIRED_METRIC_NAMES = (
     "pdes_windows_total",
     "pdes_barrier_seconds",
     "pdes_partition_imbalance",
+    # Group-commit storage engine (storage/, docs/STORAGE.md).
+    "wal_append_bytes_total",
+    "wal_fsync_seconds",
+    "wal_group_commit_size",
+    "store_gc_reclaimed_bytes_total",
+    "snapshot_transfer_bytes_total",
 )
 
 

@@ -180,9 +180,19 @@ def _reserve_ports(count: int) -> List[int]:
 class _CommitLogApp:
     """App that journals every applied batch to ``commits.log`` — one line
     per QEntry: ``<seq_no> <digest-hex> <client:req,...>``.  The file is
-    the ground truth the parent diffs across nodes."""
+    the ground truth the parent diffs across nodes.
 
-    def __init__(self, log_path: Path):
+    With a ``snapstore``, checkpoint values are **digest-only**: ``snap``
+    persists the snapshot body locally (storage.SnapshotStore) and the
+    32-byte sha256 digest is what circulates in Checkpoint messages.  A
+    ``transfer_to`` that misses locally — a restarted node asked to jump
+    to a checkpoint it never produced — fetches the body from a peer over
+    KIND_SNAPSHOT frames; a failed fetch raises, which the state machine
+    turns into EventStateTransferFailed and a deterministic tick-backoff
+    retry, so transient unavailability costs latency, never liveness.
+    Without a snapstore the legacy inline format (digest ‖ body) is kept."""
+
+    def __init__(self, log_path: Path, snapstore=None, peer_addrs=None):
         self._file = open(log_path, "a", buffering=1)
         # Harness-side observation ledger; the append/record methods all
         # take the lock, and the summary readers run after the child
@@ -191,6 +201,8 @@ class _CommitLogApp:
         self._lock = threading.Lock()
         self.last_checkpoint = (0, b"")
         self.state_transfers: List[int] = []
+        self.snapstore = snapstore
+        self.peer_addrs = list(peer_addrs or [])
 
     def apply(self, entry) -> None:
         reqs = ",".join(f"{r.client_id}:{r.req_no}" for r in entry.requests)
@@ -209,6 +221,8 @@ class _CommitLogApp:
             pending_reconfigurations=(),
         )
         encoded = wire.encode(state)
+        if self.snapstore is not None:
+            return self.snapstore.save(encoded), ()
         return hashlib.sha256(encoded).digest() + encoded, ()
 
     def transfer_to(self, seq_no, snap):
@@ -216,7 +230,20 @@ class _CommitLogApp:
 
         with self._lock:
             self.state_transfers.append(seq_no)
-        return wire.decode(snap[32:])
+        if self.snapstore is None:
+            return wire.decode(snap[32:])
+        blob = self.snapstore.load(snap)
+        if blob is None:
+            from mirbft_tpu.storage import fetch_snapshot_from_peers
+
+            blob = fetch_snapshot_from_peers(self.peer_addrs, snap)
+            if blob is None:
+                raise RuntimeError(
+                    f"snapshot {snap.hex()[:12]} unavailable locally and "
+                    f"from {len(self.peer_addrs)} peers"
+                )
+            self.snapstore.save(blob)  # serve it onward; retries hit disk
+        return wire.decode(blob)
 
     def close(self) -> None:
         with self._lock:
@@ -232,8 +259,7 @@ def run_node(root: Path, node_id: int) -> int:
     from mirbft_tpu.net.tcp import TcpTransport, config_fingerprint
     from mirbft_tpu.node import Node, ProcessorConfig
     from mirbft_tpu.ops import CpuHasher
-    from mirbft_tpu.reqstore import Store
-    from mirbft_tpu.simplewal import WAL
+    from mirbft_tpu.storage import GroupCommitWAL, LogStore, SnapshotStore
 
     cluster = json.loads(_cluster_path(root).read_text())
     node_count = cluster["node_count"]
@@ -294,7 +320,18 @@ def run_node(root: Path, node_id: int) -> int:
 
     cfg = {"id": node_id, "batch_size": 1}
     cfg.update(cluster.get("node_config") or {})
-    app = _CommitLogApp(ndir / "commits.log")
+    snapstore = SnapshotStore(str(ndir / "snaps"))
+    app = _CommitLogApp(
+        ndir / "commits.log",
+        snapstore=snapstore,
+        peer_addrs=[
+            ("127.0.0.1", port)
+            for pid, port in ports.items()
+            if pid != node_id
+        ],
+    )
+    wal = GroupCommitWAL(str(ndir / "wal"))
+    request_store = LogStore(str(ndir / "reqs"))
     node = Node(
         node_id,
         Config(**cfg),
@@ -302,8 +339,8 @@ def run_node(root: Path, node_id: int) -> int:
             link=link,
             hasher=CpuHasher(),
             app=app,
-            wal=WAL(str(ndir / "wal")),
-            request_store=Store(str(ndir / "reqs.db")),
+            wal=wal,
+            request_store=request_store,
             interceptor=recorder,
         ),
     )
@@ -339,7 +376,8 @@ def run_node(root: Path, node_id: int) -> int:
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
 
-    transport.start(on_message, on_client=on_client)
+    transport.start(on_message, on_client=on_client,
+                    on_snapshot=snapstore.load)
     if restarting:
         node.restart_processing(tick_interval=0.02)
     else:
@@ -386,6 +424,11 @@ def run_node(root: Path, node_id: int) -> int:
     except Exception:
         pass
     app.close()
+    try:
+        wal.close()
+        request_store.close()
+    except Exception:
+        pass  # workers already drained; a close race is not a node failure
     return 0
 
 
@@ -1544,6 +1587,131 @@ def _scenario_rolling_kill(root: Path, seed: int) -> dict:
     return _verdict(root, "rolling-kill", res, failures)
 
 
+def _scenario_kill_under_write(root: Path, seed: int) -> dict:
+    """Crash-recovery drill for the storage engine: SIGKILL one node under
+    sustained client write load, have the survivors commit far past what
+    the victim's WAL can replay (multiple checkpoint intervals), restart
+    it, and require it to rejoin **via snapshot state transfer fetched
+    over KIND_SNAPSHOT frames** — proven by a nonzero
+    ``snapshot_transfer_bytes_total`` on the victim — with seq-keyed
+    bit-identical commit logs across all four nodes."""
+    victim = 3
+    survivors = [0, 1, 2]
+    # checkpoint_interval is 5·N = 20 for 4 nodes; pushing the survivors
+    # ≥ 2 intervals past the victim's crash head guarantees its replayed
+    # log ends below the cluster's stable checkpoint, forcing transfer.
+    outrun_seqs = 45
+    with _Cluster(
+        root,
+        seed=seed,
+        node_config=dict(_VIEWCHANGE_CONFIG),
+        unreachable_after_s=0.6,
+        timeout_s=120.0,
+    ) as cluster:
+        cluster.start()
+        # Warm up with the full cluster so the victim dies with real
+        # committed state in its WAL, not a fresh directory.
+        cluster.submit(0, 4)
+        cluster.wait_commits(4, quorum=4)
+
+        # Sustained write load against the survivors only (the victim is
+        # about to die; a connection to it would only buy retry latency).
+        stop_load = threading.Event()
+        progress = {"submitted": 4}
+        load_errors: List[str] = []
+
+        def load() -> None:
+            clients = {
+                i: SocketClient(("127.0.0.1", cluster.ports[i]))
+                for i in survivors
+            }
+            try:
+                req_no = 4
+                while not stop_load.is_set():
+                    data = b"mirnet-%d" % req_no
+                    for client in clients.values():
+                        while not client.submit(req_no, data):
+                            if stop_load.is_set():
+                                return
+                            time.sleep(0.05)
+                    req_no += 1
+                    progress["submitted"] = req_no
+            except (ConnectionError, OSError) as err:
+                load_errors.append(f"load generator died: {err!r}")
+            finally:
+                for client in clients.values():
+                    client.close()
+
+        loader = threading.Thread(target=load, daemon=True)
+        loader.start()
+        try:
+            time.sleep(0.5)  # the SIGKILL lands mid-write, not in a lull
+            head_kill = max(cluster.last_seq(i) for i in survivors)
+            cluster.kill(victim)
+            cluster.wait_fault(survivors, victim, "peer_unreachable",
+                               timeout_s=25.0)
+
+            target = head_kill + outrun_seqs
+            deadline = time.monotonic() + 120.0
+            while min(cluster.last_seq(i) for i in survivors) < target:
+                if load_errors or time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"survivors never outran the victim to seq {target} "
+                        f"(heads: "
+                        f"{[cluster.last_seq(i) for i in survivors]}, "
+                        f"load errors: {load_errors})"
+                    )
+                time.sleep(0.2)
+
+            cluster.restart(victim)
+            rejoin_head = max(cluster.last_seq(i) for i in survivors)
+            # Keep writing while the victim catches up: checkpoint
+            # traffic is what tells it how far behind it is.
+            cluster.wait_rejoin(victim, rejoin_head, timeout_s=60.0)
+        finally:
+            stop_load.set()
+            loader.join(timeout=30)
+
+        submitted = progress["submitted"]
+        cluster.wait_commits(submitted, quorum=3, node_ids=survivors,
+                             timeout_s=120.0)
+        res = cluster.judge()
+        transfer_bytes = _metric_value(
+            cluster.root, victim, "snapshot_transfer_bytes_total"
+        )
+
+    failures: List[str] = list(load_errors)
+    doctor = res["doctor"]
+    if transfer_bytes <= 0:
+        failures.append(
+            "victim rejoined without fetching a snapshot over the socket "
+            "plane (snapshot_transfer_bytes_total == 0)"
+        )
+    if doctor["faults"].get(f"{victim}:peer_unreachable", 0) <= 0:
+        failures.append("victim was never attributed peer_unreachable")
+    if doctor["per_node"][victim]["boots"] < 2:
+        failures.append(
+            f"victim recorded {doctor['per_node'][victim]['boots']} boots, "
+            f"expected >= 2"
+        )
+    fault_kinds = {key.split(":", 1)[1] for key in doctor["faults"]}
+    if fault_kinds - {"peer_unreachable", "suspicion_vote"}:
+        failures.append(
+            f"kill-under-write attributed unexpected kinds: "
+            f"{sorted(fault_kinds)}"
+        )
+    _check_anomalies(
+        failures, doctor, range(4),
+        {"peer_fault", "watermark_stall", "epoch_thrash",
+         "checkpoint_stagnation"},
+    )
+    if res["agreement_problems"]:
+        failures.append("; ".join(res["agreement_problems"]))
+    verdict = _verdict(root, "kill-under-write", res, failures)
+    verdict["snapshot_transfer_bytes"] = transfer_bytes
+    return verdict
+
+
 SCENARIOS = {
     "control": _scenario_control,
     "partition-minority": _scenario_partition_minority,
@@ -1552,6 +1720,7 @@ SCENARIOS = {
     "lossy-wan": _scenario_lossy_wan,
     "byzantine-leader": _scenario_byzantine_leader,
     "rolling-kill": _scenario_rolling_kill,
+    "kill-under-write": _scenario_kill_under_write,
 }
 
 

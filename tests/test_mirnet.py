@@ -96,7 +96,7 @@ def test_mirnet_scenario_partition_heal_smoke(tmp_path):
 @pytest.mark.parametrize(
     "name",
     ["partition-leader", "flap", "lossy-wan", "byzantine-leader",
-     "rolling-kill"],
+     "rolling-kill", "kill-under-write"],
 )
 def test_mirnet_scenario_matrix(tmp_path, name):
     """Full hostile matrix (soaks: each run is seconds-to-minutes of real
