@@ -1307,6 +1307,241 @@ def bench_storage(detail, appenders=16, writes_per_sync=4, rounds=20,
                 t.stop()
 
 
+def _interval_cover(inner, outer):
+    """Seconds of ``inner`` intervals covered by the union of ``outer``
+    intervals (all (start, end) perf_counter pairs)."""
+    merged = []
+    for s, e in sorted(outer):
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    covered = 0.0
+    for s, e in inner:
+        for ms, me in merged:
+            lo, hi = max(s, ms), min(e, me)
+            if lo < hi:
+                covered += hi - lo
+    return covered
+
+
+def bench_pipeline(detail, batch=4096, msg_len=640, waves=8, ready_rows=64,
+                   wal_batches=24, writes_per_batch=256,
+                   admits=2000, window=64, service_s=0.0002):
+    """Admission-to-commit pipeline scheduler (processor/pipeline.py,
+    docs/PERFORMANCE.md §14) and the device-resident chained waves it
+    feeds (ops/fused.py ``chain=`` / ``collect_ready``), on record:
+
+    - ``pipeline_e2e_hashes_per_s``: end-to-end hash rate through CHAINED
+      fused waves — each wave's digest words stay in HBM and gate the
+      next wave's quorum claims in-program; only a commit-ready subset of
+      rows (``collect_ready``) crosses the host boundary per wave, with
+      ONE full trailing collect.
+    - ``pipeline_stage_overlap_pct``: share of WAL-stage write seconds
+      that ran while an earlier batch's fsync was in flight — the async
+      WAL stage edge (``sync_begin`` + strictly-ordered release thread)
+      measured with real fsyncs on this filesystem.  The serial barrier
+      (write, ``sync()``, release) scores 0 by construction; its wall is
+      on record as ``pipeline_wal_serial_s`` vs ``pipeline_wal_piped_s``.
+    - ``pipeline_admission_stall_ms_p99``: p99 of ``AdmissionWindow.admit``
+      wait for a proposer outrunning a fixed-rate completer (the result
+      stage observing commits), i.e. the steady-state backpressure delay
+      ingress sees once the window is full.
+    """
+    import queue
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from mirbft_tpu import messages as m
+    from mirbft_tpu.ops.fused import FusedCryptoPipeline
+    from mirbft_tpu.processor.pipeline import AdmissionWindow
+    from mirbft_tpu.storage import GroupCommitWAL
+
+    # --- chained fused waves: digests device-resident across waves -------
+    rng = np.random.default_rng(1)
+    msg_sets = [
+        [
+            rng.integers(0, 256, size=msg_len, dtype=np.uint8).tobytes()
+            for _ in range(batch)
+        ]
+        for _ in range(2)
+    ]
+    pipe = FusedCryptoPipeline(n_slots=batch, n_digest_slots=4)
+    # Claim rows span the combined space: < chain.rows hits the previous
+    # wave's resident digests, >= chain.rows the current wave's.
+    quorum_first = [(s, [(s % batch, 0, None, None)]) for s in range(8)]
+    quorum_chained = [
+        (s, [((s * 7) % (2 * batch), 0, None, None)]) for s in range(8)
+    ]
+    # Warm both shapes (unchained head wave, chained steady state).
+    w0 = pipe.dispatch_wave(msg_sets[0], quorum=quorum_first)
+    w1 = pipe.dispatch_wave(msg_sets[1], quorum=quorum_chained, chain=w0)
+    pipe.collect_ready(w0, range(ready_rows))
+    pipe.collect(w1)
+
+    start = time.perf_counter()
+    prev = None
+    for i in range(waves):
+        handle = pipe.dispatch_wave(
+            msg_sets[i % 2],
+            quorum=quorum_first if prev is None else quorum_chained,
+            chain=prev,
+        )
+        if prev is not None:
+            # The commit-ready trickle: a subset of the previous wave
+            # crosses to the host; its words stay resident for the chain.
+            pipe.collect_ready(prev, range(ready_rows))
+        prev = handle
+    pipe.collect(prev)
+    wall = time.perf_counter() - start
+    detail["pipeline_e2e_hashes_per_s"] = round(batch * waves / wall, 1)
+
+    # --- async WAL stage edge: writes overlapping fsync ------------------
+    def entry(i):
+        return m.PEntry(seq_no=i, digest=bytes(32))
+
+    with tempfile.TemporaryDirectory(prefix="bench-pipe-wal-") as root:
+        wal = GroupCommitWAL(root + "/serial")
+        start = time.perf_counter()
+        index = 1
+        for _ in range(wal_batches):
+            for _ in range(writes_per_batch):
+                wal.write(index, entry(index))
+                index += 1
+            wal.sync()
+        serial_s = time.perf_counter() - start
+        wal.close()
+
+        wal = GroupCommitWAL(root + "/piped")
+        release_q = queue.Queue()
+        durable_at = {}
+
+        def releaser():
+            # Strictly-ordered release: batch k's sends are eligible only
+            # here, once its fsync ticket resolves (the WAL-before-send
+            # stage edge).
+            while True:
+                item = release_q.get()
+                if item is None:
+                    return
+                k, ticket = item
+                ticket.wait()
+                durable_at[k] = time.perf_counter()
+
+        thread = threading.Thread(target=releaser)
+        thread.start()
+        write_windows = []
+        begun_at = {}
+        start = time.perf_counter()
+        index = 1
+        for k in range(wal_batches):
+            t0 = time.perf_counter()
+            for _ in range(writes_per_batch):
+                wal.write(index, entry(index))
+                index += 1
+            write_windows.append((t0, time.perf_counter()))
+            begun_at[k] = time.perf_counter()
+            release_q.put((k, wal.sync_begin()))
+        release_q.put(None)
+        thread.join()
+        piped_s = time.perf_counter() - start
+        wal.close()
+
+    # A batch's fsync is in flight from sync_begin until its ordered
+    # release; the overlap score is the share of write seconds spent
+    # under some earlier batch's in-flight fsync.
+    fsync_windows = [
+        (begun_at[k], durable_at[k])
+        for k in begun_at
+        if durable_at.get(k, begun_at[k]) > begun_at[k]
+    ]
+    write_s = sum(e - s for s, e in write_windows)
+    overlapped = _interval_cover(write_windows, fsync_windows)
+    detail["pipeline_stage_overlap_pct"] = round(
+        100.0 * overlapped / write_s, 1
+    ) if write_s > 0 else 0.0
+    detail["pipeline_wal_serial_s"] = round(serial_s, 4)
+    detail["pipeline_wal_piped_s"] = round(piped_s, 4)
+
+    # --- admission backpressure p99 --------------------------------------
+    win = AdmissionWindow(window, timeout_s=5.0)
+    service_q = queue.Queue()
+
+    def completer():
+        while True:
+            key = service_q.get()
+            if key is None:
+                return
+            time.sleep(service_s)
+            win.complete([key])
+
+    thread = threading.Thread(target=completer)
+    thread.start()
+    waits = []
+    for key in range(admits):
+        t0 = time.perf_counter()
+        win.admit(key)
+        waits.append(time.perf_counter() - t0)
+        service_q.put(key)
+    service_q.put(None)
+    thread.join()
+    win.close()
+    waits.sort()
+    detail["pipeline_admission_stall_ms_p99"] = round(
+        waits[max(0, int(0.99 * len(waits)) - 1)] * 1e3, 3
+    )
+
+
+def guard_pipeline_planes(detail):
+    """The pipeline must not tax the planes it composes: this run's
+    ``wal_append_mb_s`` and ``fused_wave_4096_ms`` must stay within ±25%
+    (in the direction that hurts) of the most recent recorded bench round
+    carrying the key (``BENCH_r*.json``) — the ``hash_sync_regression``
+    guard pattern.  Keys with no recorded baseline yet are noted, not
+    failed; the verdicts land in ``pipeline_plane_guard``."""
+    import glob
+    import os
+
+    def latest_recorded(key):
+        root = os.path.dirname(os.path.abspath(__file__))
+        for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                           reverse=True):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            value = (doc.get("detail") or {}).get(key)
+            if isinstance(value, (int, float)):
+                return value, os.path.basename(path)
+        return None, None
+
+    verdicts = {}
+    breaches = []
+    # (key, True if larger-is-worse)
+    for key, worse_high in (("wal_append_mb_s", False),
+                            ("fused_wave_4096_ms", True)):
+        current = detail.get(key)
+        ref, source = latest_recorded(key)
+        if not isinstance(current, (int, float)):
+            verdicts[key] = "not measured this run"
+            continue
+        if ref is None:
+            verdicts[key] = "no recorded baseline"
+            continue
+        bad = current > ref * 1.25 if worse_high else current < ref * 0.75
+        verdicts[key] = f"{current} vs {ref} ({source})"
+        if bad:
+            breaches.append(
+                f"{key}={current} regressed >25% vs {ref} ({source})"
+            )
+    detail["pipeline_plane_guard"] = verdicts
+    if breaches:
+        raise RuntimeError("; ".join(breaches))
+
+
 def main():
     detail = {}
 
@@ -1548,6 +1783,19 @@ def main():
         bench_storage(detail)
     except Exception as exc:
         detail["storage_error"] = f"{type(exc).__name__}: {exc}"[:160]
+
+    try:
+        bench_pipeline(detail)
+    except Exception as exc:
+        detail["pipeline_error"] = f"{type(exc).__name__}: {exc}"[:160]
+    try:
+        # Regression guard: the pipeline must not tax the planes it
+        # composes (keys above are already recorded either way).
+        guard_pipeline_planes(detail)
+    except Exception as exc:
+        detail["pipeline_plane_regression_error"] = (
+            f"{type(exc).__name__}: {exc}"[:160]
+        )
 
     try:
         emit_observability_artifacts(detail)

@@ -8,20 +8,30 @@ replicates single-threadedly.  The hash worker is the TPU dispatch path:
 batches leave the coordinator, run on device, and return as events without
 ever blocking the event loop.
 
+The loop itself lives in ``processor/pipeline.py``: the
+``PipelineScheduler`` generalizes the reference coordinator into a staged
+pipeline with bounded per-stage depth.  A ``Node`` built without a
+``pipeline`` config runs the classic schedule (depth 1 everywhere, the
+synchronous WAL barrier, the one-call hash stage — bit-equivalent to the
+reference); passing ``PipelineConfig()`` enables the pipelined mode that
+overlaps WAL fsyncs, in-flight crypto waves and net sends with
+backpressure from the slowest stage back to ``Client.propose`` admission.
+
 Concurrency translation (Go → Python): channels/select become per-worker
 handoff queues plus one coordinator inbox; the ``workErrNotifier`` failure
 latch becomes an event + status snapshot.  Backpressure is preserved: a
-category with a batch in flight accumulates further work in ``WorkItems``
-until its worker returns.
+category with its depth budget in flight accumulates further work in
+``WorkItems`` until a worker returns.  Every hand-off is event-driven
+(blocking gets, sentinel shutdown) — there are no polling timeouts, so an
+idle node wakes in scheduler latency, not a 50 ms floor.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional
 
 from . import health as health_mod
 from . import metrics as metrics_mod
@@ -30,6 +40,7 @@ from . import status as status_mod
 from . import tracing
 from .config import Config
 from .messages import Msg, NetworkState
+from .processor.pipeline import PipelineConfig, PipelineScheduler
 from .statemachine.actions import Actions, Events
 from .statemachine.machine import StateMachine
 
@@ -100,6 +111,7 @@ class Client:
         client_id: int = -1,
         authenticator=None,
         health_monitor=None,
+        admission=None,
     ):
         self._client = client
         self._inbox = inbox
@@ -107,6 +119,7 @@ class Client:
         self._client_id = client_id
         self._authenticator = authenticator
         self._health_monitor = health_monitor
+        self._admission = admission
 
     def next_req_no(self) -> int:
         return self._client.next_req_no_value()
@@ -129,6 +142,10 @@ class Client:
             raise AuthenticationError(
                 f"client {self._client_id} req {req_no}: signature rejected"
             )
+        if self._admission is not None:
+            # End-to-end backpressure: block while the admission window is
+            # full (freed as the result stage observes commits).
+            self._admission.admit((self._client_id, req_no))
         events = self._client.propose(req_no, data)
         if self._notifier.exit_event.is_set():
             raise self._notifier.err() or StoppedError()
@@ -139,18 +156,13 @@ class Client:
 class Node:
     """Reference mirbft.go:75-176."""
 
-    _CATEGORIES: Tuple[Tuple[str, str], ...] = (
-        # (work-items attribute, inbox result tag)
-        ("wal_actions", "wal"),
-        ("net_actions", "net"),
-        ("hash_actions", "hash"),
-        ("client_actions", "client"),
-        ("app_actions", "app"),
-        ("req_store_events", "req_store"),
-        ("result_events", "result"),
-    )
-
-    def __init__(self, node_id: int, config: Config, processor_config: ProcessorConfig):
+    def __init__(
+        self,
+        node_id: int,
+        config: Config,
+        processor_config: ProcessorConfig,
+        pipeline: Optional[PipelineConfig] = None,
+    ):
         self.id = node_id
         self.config = config
         self.processor_config = processor_config
@@ -161,14 +173,6 @@ class Node:
         )
         self.replicas = proc.Replicas(on_forward=self._ingest_forward)
         self.notifier = _WorkErrNotifier()
-        # Coordinator inbox: tagged results/ingress/control messages.
-        self.inbox: "queue.Queue" = queue.Queue()
-        # One handoff slot per category worker.
-        self._work_queues: Dict[str, "queue.Queue"] = {
-            tag: queue.Queue(maxsize=1) for _, tag in self._CATEGORIES
-        }
-        self._pending: Dict[str, bool] = {tag: False for _, tag in self._CATEGORIES}
-        self._threads: List[threading.Thread] = []
         self._tick_thread: Optional[threading.Thread] = None
         self._started = False
         # Wall-clock commit spans: derived from the event/action stream on
@@ -186,6 +190,26 @@ class Node:
         self.health_monitor = health_mod.HealthMonitor(
             node_id, logger=config.logger
         )
+        # The event loop: classic (reference-equivalent) schedule unless a
+        # pipeline config was passed.
+        self.scheduler = PipelineScheduler(
+            node_id,
+            self.work_items,
+            self._handlers(),
+            self.notifier,
+            snapshot_fn=lambda: status_mod.snapshot(self.state_machine),
+            config=pipeline if pipeline is not None else PipelineConfig.classic(),
+            on_snapshot=self.health_monitor.observe_snapshot,
+            wal=processor_config.wal,
+            request_store=processor_config.request_store,
+            hasher=processor_config.hasher,
+        )
+        # Coordinator inbox: tagged results/ingress/control messages.
+        self.inbox = self.scheduler.inbox
+
+    @property
+    def _threads(self) -> List[threading.Thread]:
+        return self.scheduler.threads
 
     # --- boot (reference mirbft.go:436-464) ---
 
@@ -251,6 +275,7 @@ class Node:
             client_id=client_id,
             authenticator=self.processor_config.authenticator,
             health_monitor=self.health_monitor,
+            admission=self.scheduler.admission,
         )
 
     def tick(self) -> None:
@@ -279,24 +304,7 @@ class Node:
                 status_mod.snapshot(self.state_machine)
             )
 
-    # --- workers (reference mirbft.go:231-434) ---
-
-    def _worker(self, tag: str, handler: Callable) -> None:
-        while not self.notifier.exit_event.is_set():
-            try:
-                batch = self._work_queues[tag].get(timeout=0.05)
-            except queue.Empty:
-                continue
-            try:
-                result = handler(batch)
-            except BaseException as e:
-                if tag == "result":
-                    self.notifier.set_exit_status(
-                        status_mod.snapshot(self.state_machine)
-                    )
-                self.notifier.fail(e)
-                return
-            self.inbox.put((f"{tag}_results", result))
+    # --- stage handlers (reference mirbft.go:231-434) ---
 
     def _handlers(self) -> Dict[str, Callable]:
         pc = self.processor_config
@@ -322,6 +330,7 @@ class Node:
         )
         self.span_tracker.observe(events, actions)
         self.health_monitor.observe_events(events, actions)
+        self.scheduler.observe_result_actions(actions)
         return actions
 
     def metrics_text(self, registry=None) -> str:
@@ -339,95 +348,22 @@ class Node:
         own tick, so polling this cannot perturb the detectors."""
         return self.health_monitor.report()
 
-    # --- coordinator (reference mirbft.go:465-565) ---
+    # --- startup ---
 
     def _start(self, tick_interval: Optional[float]) -> None:
         if self._started:
             raise AssertionError("node already started")
         self._started = True
-        handlers = self._handlers()
-        for _, tag in self._CATEGORIES:
-            thread = threading.Thread(
-                target=self._worker,
-                args=(tag, handlers[tag]),
-                name=f"node{self.id}-{tag}",
-                daemon=True,
-            )
-            thread.start()
-            self._threads.append(thread)
-
-        coordinator = threading.Thread(
-            target=self._run_coordinator, name=f"node{self.id}-coord", daemon=True
-        )
-        coordinator.start()
-        self._threads.append(coordinator)
+        self.scheduler.start()
 
         if tick_interval is not None:
             def ticker():
-                while not self.notifier.exit_event.is_set():
-                    time.sleep(tick_interval)
+                # Event-driven: wait() returns True the instant the node
+                # stops — no shutdown polling between ticks.
+                while not self.notifier.exit_event.wait(tick_interval):
                     self.inbox.put(("tick", None))
 
             self._tick_thread = threading.Thread(
                 target=ticker, name=f"node{self.id}-tick", daemon=True
             )
             self._tick_thread.start()
-
-    def _dispatch_ready_work(self) -> None:
-        """Hand any non-empty category with no batch in flight to its worker
-        (the nil-able-channel pattern of the reference select loop)."""
-        work = self.work_items
-        for attr, tag in self._CATEGORIES:
-            batch = getattr(work, attr)
-            if not self._pending[tag] and len(batch) > 0:
-                self._pending[tag] = True
-                setattr(work, attr, type(batch)())
-                self._work_queues[tag].put(batch)
-
-    def _run_coordinator(self) -> None:
-        work = self.work_items
-        add_result = {
-            "wal_results": work.add_wal_results,
-            "net_results": work.add_net_results,
-            "hash_results": work.add_hash_results,
-            "client_results": work.add_client_results,
-            "app_results": work.add_app_results,
-            "req_store_results": work.add_req_store_results,
-            "result_results": work.add_state_machine_results,
-        }
-        waiting_status: List["queue.Queue"] = []
-        health_due = False
-        try:
-            while not self.notifier.exit_event.is_set():
-                # Status may only be taken while no state-machine batch is in
-                # flight: the result worker mutates the machine off-thread.
-                if (waiting_status or health_due) and not self._pending["result"]:
-                    snap = status_mod.snapshot(self.state_machine)
-                    for reply in waiting_status:
-                        reply.put(snap)
-                    waiting_status.clear()
-                    if health_due:
-                        health_due = False
-                        self.health_monitor.observe_snapshot(snap)
-                self._dispatch_ready_work()
-                try:
-                    tag, payload = self.inbox.get(timeout=0.05)
-                except queue.Empty:
-                    continue
-                if tag == "stop":
-                    return
-                if tag == "tick":
-                    work.result_events.tick_elapsed()
-                    health_due = True
-                elif tag == "status":
-                    waiting_status.append(payload)
-                elif tag == "step_events":
-                    work.result_events.concat(payload)
-                elif tag in add_result:
-                    base = tag[: -len("_results")]
-                    add_result[tag](payload)
-                    self._pending[base] = False
-                else:
-                    raise AssertionError(f"unknown inbox tag {tag}")
-        except BaseException as e:
-            self.notifier.fail(e)

@@ -72,6 +72,7 @@ def _hash_stage(blocks, n_blocks, layout: str, interpret: bool):
 
 
 def _fused_body(
+    prev_words,
     blocks,
     n_blocks,
     ax,
@@ -98,10 +99,14 @@ def _fused_body(
     ok = _verify_kernel_body(ax, ay, r_bytes, s_bits, h_bits, mul)
 
     # Digest gate: rows < 0 are ungated; gated rows compare the claimed
-    # digest words against the wave's freshly computed digest.
+    # digest words against the combined [chained previous wave; this wave]
+    # digest table.  The previous wave's words never left HBM — chaining
+    # concatenates device-resident arrays in-program (``prev_words`` is a
+    # one-row dummy on unchained waves; the host pre-offsets the rows).
+    combined = jnp.concatenate([prev_words, digests], axis=0)
     gate = digest_rows >= 0
-    rows = jnp.clip(digest_rows, 0, digests.shape[0] - 1)
-    eq = jnp.all(digests[rows] == claimed, axis=-1)
+    rows = jnp.clip(digest_rows, 0, combined.shape[0] - 1)
+    eq = jnp.all(combined[rows] == claimed, axis=-1)
     gated_valid = valid & (~gate | eq)
     masks, counts, posts, newbits = accumulate_body(
         masks, counts, sources, touches, gated_valid
@@ -118,7 +123,9 @@ def _compiled_fused(layout: str, backend: str, interpret: bool, donate: bool):
         # blocks, n_blocks, masks, counts: the packed slab dies with the
         # dispatch; masks/counts are threaded — the outputs alias the
         # donated inputs, keeping quorum state device-resident across waves.
-        return jax.jit(fn, donate_argnums=(0, 1, 7, 8))
+        # ``prev_words`` (arg 0) is deliberately NOT donated: a chained
+        # handle's digests must stay collectable after gating the next wave.
+        return jax.jit(fn, donate_argnums=(1, 2, 8, 9))
     return jax.jit(fn)
 
 
@@ -130,18 +137,24 @@ class FusedDispatch:
     """One in-flight fused wave.  ``words`` mirrors ``HashDispatch.words``
     (so plane polling code treats either handle identically); ``ok`` /
     ``posts`` / ``newbits`` are the verify and quorum outputs, all still
-    device-resident until ``FusedCryptoPipeline.collect``."""
+    device-resident until ``FusedCryptoPipeline.collect`` (or partially,
+    via ``collect_ready``, which leaves the digest words resident so the
+    handle can keep feeding chained waves)."""
 
     __slots__ = (
-        "words", "count", "layout", "lease",
+        "words", "count", "rows", "layout", "lease",
         "ok", "valid", "verify_count",
         "posts", "newbits", "auth_keys", "auth_items",
+        "chain", "row_map",
     )
 
-    def __init__(self, words, count, layout, lease, ok, valid, verify_count,
-                 posts, newbits):
+    def __init__(self, words, count, rows, layout, lease, ok, valid,
+                 verify_count, posts, newbits, chain=None):
         self.words = words
         self.count = count
+        # Padded device row count — the chained row space the NEXT wave's
+        # quorum gates index this wave's digests through.
+        self.rows = rows
         self.layout = layout
         self.lease = lease
         self.ok = ok
@@ -152,6 +165,11 @@ class FusedDispatch:
         # Auth-plane bookkeeping attached by DeviceHashPlane's fused path.
         self.auth_keys = None
         self.auth_items = None
+        # The chained previous wave (kept alive: its words feed this
+        # program's gate) and the plane's surviving-row bookkeeping after
+        # partial collects.
+        self.chain = chain
+        self.row_map = None
 
 
 class FusedResult:
@@ -182,12 +200,19 @@ class FusedCryptoPipeline:
         n_digest_slots: int = 4,
         kernel: str = "auto",
         touch_k: int = 8,
+        verify_kernel: str = "auto",
     ):
         self.touch_k = touch_k
         self.hasher = TpuHasher(min_device_batch=1, kernel=kernel)
         from .ed25519 import Ed25519BatchVerifier
 
-        self.verifier = Ed25519BatchVerifier(min_device_batch=1)
+        # ``verify_kernel``: the ed25519 field-multiply backend.  "auto"
+        # (the default) resolves through the measured MXU/VPU crossover
+        # probe at dispatch time (ops/crossover.py) — the fused program is
+        # compiled for whichever formulation actually wins on this chip.
+        self.verifier = Ed25519BatchVerifier(
+            min_device_batch=1, kernel=verify_kernel
+        )
         self.masks = jnp.zeros(
             (n_slots, n_digest_slots, MASK_WORDS), dtype=jnp.uint32
         )
@@ -195,11 +220,25 @@ class FusedCryptoPipeline:
         self._interpret = jax.default_backend() != "tpu"
         self._donate = jax.default_backend() == "tpu"
 
+    def resolved_verify_kernel(self) -> str:
+        """The verify backend fused dispatches compile for: explicit
+        settings pass through, "auto" applies the measured crossover."""
+        return self.verifier.resolved_kernel()
+
     # -- host-side packing helpers ------------------------------------------
 
-    def _pack_quorum(self, quorum, batch_rows: int):
+    def _pack_quorum(
+        self, quorum, total_rows: int, row_offset: int = 0
+    ):
         """(sources, touches, valid, digest_rows, claimed) fixed-shape
-        arrays from [(source, [(w, d, digest_row, claimed_digest|None)])]."""
+        arrays from [(source, [(w, d, digest_row, claimed_digest|None)])].
+
+        ``total_rows`` is the caller-visible gated row space; the device
+        program prepends ``prev_words`` before indexing, so unchained
+        waves shift every gated row past the one-row dummy
+        (``row_offset=1``) while chained waves pass rows through
+        (``row_offset=0`` — the combined [chain; current] space IS the
+        device space)."""
         k = self.touch_k
         n = _next_pow2(len(quorum)) if quorum else 1
         sources = np.zeros(n, dtype=np.int32)
@@ -215,11 +254,11 @@ class FusedCryptoPipeline:
                 touches[i, j] = (w, d)
                 valid[i, j] = True
                 if row is not None and row >= 0:
-                    if row >= batch_rows:
+                    if row >= total_rows:
                         raise ValueError(
-                            f"digest row {row} outside wave of {batch_rows}"
+                            f"digest row {row} outside wave of {total_rows}"
                         )
-                    digest_rows[i, j] = row
+                    digest_rows[i, j] = row + row_offset
                     claimed[i, j] = np.frombuffer(
                         claim, dtype=">u4"
                     ).astype(np.uint32)
@@ -240,6 +279,7 @@ class FusedCryptoPipeline:
         block_bucket: Optional[int] = None,
         batch_bucket: Optional[int] = None,
         packed: Optional[PackedWave] = None,
+        chain: Optional[FusedDispatch] = None,
     ) -> FusedDispatch:
         """ONE device dispatch covering all three stages.
 
@@ -247,7 +287,15 @@ class FusedCryptoPipeline:
         ``signed`` is the verify stage's (pubs, msgs, sigs); ``quorum`` is a
         wave stream ``[(source, [(slot, digest_slot, digest_row|None,
         claimed_digest)])]`` whose gated touches compare against this very
-        wave's digests.  Returns without blocking on the device."""
+        wave's digests.  Returns without blocking on the device.
+
+        ``chain`` threads the PREVIOUS wave's device-resident digest words
+        into this program's gate: gated ``digest_row``s then index the
+        combined row space — rows ``[0, chain.rows)`` are the previous
+        wave's digests (still in HBM, never collected), rows from
+        ``chain.rows`` are this wave's.  Consecutive fused waves can gate
+        on each other's content without a host round trip; only
+        commit-ready rows ever cross the boundary (``collect_ready``)."""
         if packed is None:
             packed = self.hasher.pack(messages, block_bucket, batch_bucket)
         if packed.layout == "lanes":
@@ -256,6 +304,16 @@ class FusedCryptoPipeline:
             batch_rows = packed.blocks.shape[0] * TILE
         else:
             batch_rows = packed.blocks.shape[0]
+        if chain is not None:
+            if chain.words is None:
+                raise ValueError("chained handle's digests were released")
+            prev_words = chain.words
+            row_offset = 0
+            total_rows = chain.rows + batch_rows
+        else:
+            prev_words = np.zeros((1, 8), dtype=np.uint32)
+            row_offset = 1
+            total_rows = batch_rows
 
         if signed and len(signed[0]):
             pubs, vmsgs, sigs = signed
@@ -275,7 +333,7 @@ class FusedCryptoPipeline:
             verify_count = 0
 
         sources, touches, tvalid, digest_rows, claimed = self._pack_quorum(
-            quorum or [], batch_rows
+            quorum or [], total_rows, row_offset
         )
 
         backend = self.verifier.resolved_kernel()
@@ -284,6 +342,7 @@ class FusedCryptoPipeline:
         )
         start = time.perf_counter()
         digests, ok, self.masks, self.counts, posts, newbits = fn(
+            prev_words,
             self._stage(packed.blocks),
             self._stage(packed.n_blocks),
             self._stage(ax),
@@ -306,8 +365,8 @@ class FusedCryptoPipeline:
         m.counter("fused_wave_dispatches").inc()
         m.counter("fused_wave_messages").inc(packed.count)
         return FusedDispatch(
-            digests, packed.count, packed.layout, packed.lease,
-            ok, valid, verify_count, posts, newbits,
+            digests, packed.count, batch_rows, packed.layout, packed.lease,
+            ok, valid, verify_count, posts, newbits, chain=chain,
         )
 
     def collect(self, handle: FusedDispatch) -> FusedResult:
@@ -321,10 +380,47 @@ class FusedCryptoPipeline:
         posts = np.asarray(handle.posts)
         newbits = np.asarray(handle.newbits)
         digests = digests_from_words(words[: handle.count])
+        self._release_lease(handle)
+        handle.chain = None  # full collect: stop pinning the chained wave
+        return FusedResult(digests, verdicts, posts, newbits)
+
+    def collect_ready(
+        self, handle: FusedDispatch, rows: Sequence[int]
+    ) -> FusedResult:
+        """Partial collect: materialize ONLY the commit-ready digest rows
+        (current-wave indices, result order follows ``rows``) plus the
+        wave's verdicts and quorum posts.  The digest words stay
+        device-resident — the handle remains valid both for later
+        ``collect_ready``/``collect`` calls and as the ``chain`` input of
+        the next wave, so non-ready digests never cross the host
+        boundary."""
+        idx = np.asarray(list(rows), dtype=np.int32)
+        if idx.size:
+            if idx.min() < 0 or idx.max() >= handle.count:
+                raise ValueError(
+                    f"rows outside the wave's {handle.count} messages"
+                )
+            words = np.asarray(handle.words[idx])
+        else:
+            words = np.zeros((0, 8), dtype=np.uint32)
+        verdicts = (
+            np.asarray(handle.ok)[: handle.verify_count]
+            & handle.valid[: handle.verify_count]
+        )
+        posts = np.asarray(handle.posts)
+        newbits = np.asarray(handle.newbits)
+        digests = digests_from_words(words)
+        # The program has necessarily executed by now (its outputs just
+        # materialized), so the packed slab is consumed and the pooled
+        # lease can be returned even though the words stay resident.
+        self._release_lease(handle)
+        _metrics().counter("fused_partial_collects").inc()
+        return FusedResult(digests, verdicts, posts, newbits)
+
+    def _release_lease(self, handle: FusedDispatch) -> None:
         if handle.lease is not None:
             self.hasher._pool.release(handle.lease)
             handle.lease = None
-        return FusedResult(digests, verdicts, posts, newbits)
 
     def quorum_state(self) -> Tuple[np.ndarray, np.ndarray]:
         """Materialize the device-resident (masks, counts) — a blocking
@@ -339,15 +435,26 @@ def host_fused_reference(
     masks: np.ndarray,
     counts: np.ndarray,
     touch_k: int = 8,
+    prev_digests: Optional[Sequence[bytes]] = None,
+    prev_rows: Optional[int] = None,
 ) -> Tuple[List[bytes], np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Pure-host oracle for the fused wave: hashlib digests, RFC 8032
     verdicts, and numpy quorum accumulation with identical digest gating.
-    Returns (digests, verdicts, masks, counts, posts, newbits)."""
+    Returns (digests, verdicts, masks, counts, posts, newbits).
+
+    ``prev_digests`` models a chained wave: gated rows then index the
+    combined [previous wave; this wave] row space, with the previous wave
+    occupying rows ``[0, prev_rows)`` (``prev_rows`` defaults to
+    ``len(prev_digests)``; pass the chained handle's padded ``rows`` when
+    mirroring device padding).  Rows in the padding gap gate closed, like
+    the device's zero-padded digest rows never matching a real claim."""
     import hashlib
 
     from .ed25519 import verify_one
 
     digests = [hashlib.sha256(m).digest() for m in messages]
+    prev = list(prev_digests or [])
+    offset = len(prev) if prev_rows is None else prev_rows
     if signed and len(signed[0]):
         verdicts = np.array(
             [verify_one(p, m, s) for p, m, s in zip(*signed)], dtype=bool
@@ -367,7 +474,10 @@ def host_fused_reference(
             touches[i, j] = (w, d)
             gate_ok = True
             if row is not None and row >= 0:
-                gate_ok = digests[row] == claim
+                if row < offset:
+                    gate_ok = row < len(prev) and prev[row] == claim
+                else:
+                    gate_ok = digests[row - offset] == claim
             valid[i, j] = gate_ok
     masks, counts, posts, newbits = host_accumulate(
         masks, counts, sources, touches, valid

@@ -12,7 +12,9 @@ determinism is independent of device timing.
 """
 
 from .interfaces import App, EventInterceptor, Hasher, Link, RequestStore, WAL
+from .pipeline import AdmissionWindow, PipelineConfig, PipelineScheduler
 from .serial import (
+    apply_wal_actions,
     initialize_wal_for_new_node,
     process_app_actions,
     process_hash_actions,
@@ -27,16 +29,20 @@ from .clients import Client, Clients
 from .replicas import Replicas, split_forward_requests
 
 __all__ = [
+    "AdmissionWindow",
     "App",
     "Client",
     "Clients",
     "EventInterceptor",
     "Hasher",
     "Link",
+    "PipelineConfig",
+    "PipelineScheduler",
     "RequestStore",
     "Replicas",
     "WAL",
     "WorkItems",
+    "apply_wal_actions",
     "initialize_wal_for_new_node",
     "process_app_actions",
     "process_hash_actions",

@@ -1,0 +1,501 @@
+"""Admission-to-commit pipeline scheduler (L3).
+
+Generalizes the node runtime's category-worker/coordinator loop into a
+staged pipeline with bounded per-stage depth: the seven work categories of
+``node.py`` become pipeline stages, each with its own worker thread and an
+in-flight budget, so while batch k's WAL fsync is on disk, batch k+1's
+crypto wave is on-device and batch k+2's sends are draining into the
+per-peer queues — instead of the strictly sequential one-batch-per-category
+round trip.  At ``depth == 1`` everywhere with the synchronous WAL handler
+and the unsplit hash handler this IS the classic coordinator (the default
+``Node`` mode); ``PipelineConfig()`` enables the pipelined mode.
+
+The two reference ordering barriers survive as **explicit stage edges**,
+not global serialization (serial.py module docstring):
+
+* **WAL-before-send** — WAL batches run their writes on the WAL stage and
+  register an fsync ticket (``GroupCommitWAL.sync_begin``); a dedicated
+  release thread waits tickets strictly in batch order and only then posts
+  the batch's WAL-dependent Sends to the net stage.  No send of batch k
+  can reach the link before batch k's fsync completes, yet batch k+1's
+  writes overlap batch k's fsync.
+* **reqstore-sync-before-ack** — client results still route through the
+  req_store stage, whose handler syncs the request store before its
+  events reach the state machine (unchanged from the serial processor).
+
+**Backpressure** propagates from the slowest stage to admission: a stage
+at full depth accumulates work in ``WorkItems`` (the classic
+one-in-flight-batch rule, widened to N), the state-machine stage stops
+consuming when downstream stages are saturated, and ``Client.propose``
+blocks in the ``AdmissionWindow`` once the configured number of proposals
+is in flight end-to-end.  ``pipeline_depth{stage}`` gauges show per-stage
+occupancy and ``pipeline_stall_seconds{stage}`` counts the time each stage
+spent as the bottleneck (work ready, depth exhausted), so the slowest
+stage is visible at a glance (docs/OBSERVABILITY.md).
+
+All hand-offs are event-driven: blocking ``queue.Queue`` gets woken by a
+sentinel on shutdown — no polling timeouts anywhere, so stage hand-off
+latency is scheduler latency, not a 50 ms floor.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import metrics
+from .. import state as st
+from ..statemachine.actions import Events
+from . import serial
+
+# Stage handed a sentinel (or companion queue handed one) → exit cleanly.
+_SENTINEL = object()
+# Handler return value meaning "a companion thread will post the result".
+_DEFERRED = object()
+
+# (work-items attribute, stage tag) — the seven categories of the
+# reference coordinator, in dispatch-priority order.
+STAGES: Tuple[Tuple[str, str], ...] = (
+    ("wal_actions", "wal"),
+    ("net_actions", "net"),
+    ("hash_actions", "hash"),
+    ("client_actions", "client"),
+    ("app_actions", "app"),
+    ("req_store_events", "req_store"),
+    ("result_events", "result"),
+)
+
+# Pipelined-mode depths: WAL and hash are the stages with real in-flight
+# latency (fsync, device round trip) so they get the deepest windows; the
+# state machine stays serial (depth 1 — ``status()`` correctness and the
+# reference's single-threaded machine both require it).
+_PIPELINED_DEPTH: Dict[str, int] = {
+    "wal": 4,
+    "net": 2,
+    "hash": 4,
+    "client": 1,
+    "app": 2,
+    "req_store": 2,
+    "result": 1,
+}
+
+# Lock discipline (docs/STATIC_ANALYSIS.md): the admission set is touched
+# by proposer threads (admit), the result worker (complete) and the
+# coordinator (close) — always under the window's condition.
+MIRLINT_SHARED_STATE = {
+    "AdmissionWindow._outstanding": "_cond",
+    "AdmissionWindow._closed": "_cond",
+}
+
+
+@dataclass
+class PipelineConfig:
+    """Pipeline scheduler tuning.  The zero-arg constructor is the
+    pipelined mode; ``PipelineConfig.classic()`` reproduces the reference
+    coordinator exactly (depth 1 everywhere, synchronous WAL barrier,
+    unsplit hash stage, unbounded admission)."""
+
+    depth: Dict[str, int] = field(
+        default_factory=lambda: dict(_PIPELINED_DEPTH)
+    )
+    # Max proposals admitted but not yet observed committing; None = off.
+    admission_window: Optional[int] = 1024
+    # Liveness guard: a proposer blocked this long admits anyway (its
+    # request may have been superseded and will never commit locally).
+    admission_timeout_s: float = 5.0
+    # Overlap WAL writes with the previous batch's fsync (requires a WAL
+    # exposing ``sync_begin``; degrades to the blocking barrier otherwise).
+    async_wal: bool = True
+    # Split the hash stage into dispatch + collect threads (requires a
+    # hasher exposing ``dispatch_batches``/``collect_batches``; degrades
+    # to the one-call ``hash_batches`` handler otherwise).
+    split_hash: bool = True
+
+    @classmethod
+    def classic(cls) -> "PipelineConfig":
+        return cls(
+            depth={tag: 1 for _, tag in STAGES},
+            admission_window=None,
+            async_wal=False,
+            split_hash=False,
+        )
+
+    def depth_of(self, tag: str) -> int:
+        if tag == "result":
+            # The deterministic state machine is serial, and status
+            # snapshots require no batch in flight.
+            return 1
+        return max(1, int(self.depth.get(tag, 1)))
+
+
+class AdmissionWindow:
+    """Bounded end-to-end admission: ``Client.propose`` occupies one slot
+    per (client_id, req_no) and the result stage frees slots as their
+    commits are observed, so ingress throttles to the slowest pipeline
+    stage instead of queueing unboundedly ahead of it."""
+
+    def __init__(self, limit: int, timeout_s: float = 5.0):
+        self.limit = max(1, int(limit))
+        self.timeout_s = timeout_s
+        self._cond = threading.Condition()
+        self._outstanding: set = set()
+        self._closed = False
+        metrics.gauge("admission_window_size").set(self.limit)
+        self._occupancy = metrics.gauge("admission_window_outstanding")
+        self._stall = metrics.counter(
+            "pipeline_stall_seconds", labels={"stage": "admission"}
+        )
+        self._stall_hist = metrics.histogram(
+            "pipeline_admission_stall_seconds"
+        )
+        self._overflow = metrics.counter("admission_window_overflow_total")
+
+    def admit(self, key) -> None:
+        """Block while the window is full; returns once ``key`` occupies a
+        slot (or immediately when the window is closed / the wait timed
+        out — admission must never cost liveness)."""
+        start: Optional[float] = None
+        with self._cond:
+            while len(self._outstanding) >= self.limit and not self._closed:
+                now = time.perf_counter()
+                if start is None:
+                    start = now
+                elif now - start >= self.timeout_s:
+                    self._overflow.inc()
+                    break
+                self._cond.wait(self.timeout_s - (now - start))
+            if not self._closed:
+                self._outstanding.add(key)
+                self._occupancy.set(len(self._outstanding))
+        if start is not None:
+            waited = time.perf_counter() - start
+            self._stall.inc(waited)
+            self._stall_hist.observe(waited)
+
+    def complete(self, keys) -> None:
+        with self._cond:
+            before = len(self._outstanding)
+            self._outstanding.difference_update(keys)
+            if len(self._outstanding) != before:
+                self._occupancy.set(len(self._outstanding))
+                self._cond.notify_all()
+
+    def observe_actions(self, actions) -> None:
+        """Free the slots of every request committing in this action
+        batch (called from the result stage, the only thread that sees
+        the action stream)."""
+        keys = [
+            (req.client_id, req.req_no)
+            for action in actions
+            if isinstance(action, st.ActionCommit)
+            for req in action.batch.requests
+        ]
+        if keys:
+            self.complete(keys)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._outstanding.clear()
+            self._occupancy.set(0)
+            self._cond.notify_all()
+
+
+class PipelineScheduler:
+    """The generalized coordinator: owns the stage queues, the per-stage
+    in-flight accounting, and the WAL-release / hash-collect companion
+    threads.  ``Node`` delegates its event loop here."""
+
+    def __init__(
+        self,
+        node_id: int,
+        work_items,
+        handlers: Dict[str, Callable],
+        notifier,
+        snapshot_fn: Callable,
+        config: Optional[PipelineConfig] = None,
+        on_snapshot: Optional[Callable] = None,
+        wal=None,
+        request_store=None,
+        hasher=None,
+    ):
+        self.config = config if config is not None else PipelineConfig.classic()
+        self.work_items = work_items
+        self.notifier = notifier
+        self.snapshot_fn = snapshot_fn
+        self.on_snapshot = on_snapshot
+        self.inbox: "queue.Queue" = queue.Queue()
+        self.threads: List[threading.Thread] = []
+        self._name = f"node{node_id}"
+        self._handlers = dict(handlers)
+        self._depth = {tag: self.config.depth_of(tag) for _, tag in STAGES}
+        self._inflight = {tag: 0 for _, tag in STAGES}
+        self._queues: Dict[str, "queue.Queue"] = {
+            tag: queue.Queue(maxsize=self._depth[tag]) for _, tag in STAGES
+        }
+        self._depth_gauges = {
+            tag: metrics.gauge("pipeline_depth", labels={"stage": tag})
+            for _, tag in STAGES
+        }
+        self._stall_counters = {
+            tag: metrics.counter(
+                "pipeline_stall_seconds", labels={"stage": tag}
+            )
+            for _, tag in STAGES
+        }
+        # tag -> perf_counter() when the stage first had ready work it
+        # could not take (depth exhausted); cleared on dispatch.
+        self._stalled_since: Dict[str, float] = {}
+
+        self.admission: Optional[AdmissionWindow] = None
+        if self.config.admission_window:
+            self.admission = AdmissionWindow(
+                self.config.admission_window,
+                self.config.admission_timeout_s,
+            )
+
+        self._wal = wal
+        self._request_store = request_store
+        self._hasher = hasher
+        self.wal_async = bool(
+            self.config.async_wal
+            and wal is not None
+            and hasattr(wal, "sync_begin")
+        )
+        self._wal_release_q: Optional["queue.Queue"] = None
+        if self.wal_async:
+            self._wal_release_q = queue.Queue(maxsize=self._depth["wal"])
+            self._handlers["wal"] = self._wal_stage
+        self.hash_split = bool(
+            self.config.split_hash
+            and hasher is not None
+            and hasattr(hasher, "dispatch_batches")
+            and hasattr(hasher, "collect_batches")
+        )
+        self._hash_collect_q: Optional["queue.Queue"] = None
+        if self.hash_split:
+            self._hash_collect_q = queue.Queue(maxsize=self._depth["hash"])
+            self._handlers["hash"] = self._hash_stage
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        for _, tag in STAGES:
+            self._spawn(f"{tag}", self._worker, tag, self._handlers[tag])
+        if self._wal_release_q is not None:
+            self._spawn("wal-release", self._wal_releaser)
+        if self._hash_collect_q is not None:
+            self._spawn("hash-collect", self._hash_collector)
+        self._spawn("coord", self.run)
+
+    def _spawn(self, suffix: str, target: Callable, *args) -> None:
+        thread = threading.Thread(
+            target=target,
+            args=args,
+            name=f"{self._name}-{suffix}",
+            daemon=True,
+        )
+        thread.start()
+        self.threads.append(thread)
+
+    def observe_result_actions(self, actions) -> None:
+        """Result-stage hook: free admission slots for observed commits."""
+        if self.admission is not None:
+            self.admission.observe_actions(actions)
+
+    # -- stage workers ------------------------------------------------------
+
+    def _worker(self, tag: str, handler: Callable) -> None:
+        q = self._queues[tag]
+        while True:
+            batch = q.get()
+            if batch is _SENTINEL or self.notifier.exit_event.is_set():
+                return
+            try:
+                result = handler(batch)
+            except BaseException as e:
+                self._stage_failed(tag, e)
+                return
+            if result is not _DEFERRED:
+                self.inbox.put((f"{tag}_results", result))
+
+    def _stage_failed(self, tag: str, err: BaseException) -> None:
+        if tag == "result":
+            self.notifier.set_exit_status(self.snapshot_fn())
+        self.notifier.fail(err)
+        # Wake the coordinator (blocking get) so shutdown propagates.
+        self.inbox.put(("worker_failed", None))
+
+    # Async WAL stage: writes now, fsync ticket waits on the release
+    # thread, so the stage worker is immediately free for the next batch.
+    def _wal_stage(self, actions):
+        net_actions, truncated_at = serial.apply_wal_actions(
+            self._wal, actions, request_store=self._request_store
+        )
+        ticket = self._wal.sync_begin()
+        self._wal_release_q.put((ticket, net_actions, truncated_at))
+        return _DEFERRED
+
+    def _wal_releaser(self) -> None:
+        """Waits fsync tickets strictly in batch order and only then
+        releases each batch's WAL-dependent Sends — the WAL-before-send
+        barrier as a stage edge."""
+        q = self._wal_release_q
+        gc = getattr(self._request_store, "gc", None)
+        while True:
+            item = q.get()
+            if item is _SENTINEL or self.notifier.exit_event.is_set():
+                return
+            ticket, net_actions, truncated_at = item
+            try:
+                ticket.wait()
+                if gc is not None and truncated_at is not None:
+                    gc(truncated_at)
+            except BaseException as e:
+                self._stage_failed("wal", e)
+                return
+            self.inbox.put(("wal_results", net_actions))
+
+    # Split hash stage: the worker only dispatches (async device enqueue);
+    # the collect thread blocks on materialization, so up to ``depth``
+    # crypto waves stay in flight.
+    def _hash_stage(self, actions):
+        hash_actions = []
+        for action in actions:
+            if not isinstance(action, st.ActionHashRequest):
+                raise AssertionError(
+                    f"unexpected Hash action type {type(action).__name__}"
+                )
+            hash_actions.append(action)
+        if not hash_actions:
+            return Events()
+        metrics.histogram("hash_batch_size").observe(len(hash_actions))
+        with metrics.timer("hash_dispatch_seconds"):
+            handle = self._hasher.dispatch_batches(
+                [action.data for action in hash_actions]
+            )
+        self._hash_collect_q.put((handle, hash_actions))
+        return _DEFERRED
+
+    def _hash_collector(self) -> None:
+        q = self._hash_collect_q
+        while True:
+            item = q.get()
+            if item is _SENTINEL or self.notifier.exit_event.is_set():
+                return
+            handle, hash_actions = item
+            try:
+                digests = self._hasher.collect_batches(handle)
+            except BaseException as e:
+                self._stage_failed("hash", e)
+                return
+            if len(digests) != len(hash_actions):
+                self._stage_failed(
+                    "hash",
+                    AssertionError("hasher returned wrong number of digests"),
+                )
+                return
+            events = Events()
+            for action, digest in zip(hash_actions, digests):
+                events.hash_result(digest, action.origin)
+            self.inbox.put(("hash_results", events))
+
+    # -- coordinator --------------------------------------------------------
+
+    def _dispatch_ready(self) -> None:
+        """Hand every non-empty category with spare depth to its stage
+        (the nil-able-channel pattern, widened from one-in-flight to a
+        per-stage budget).  A stage at full depth with ready work is
+        *stalling* — the bottleneck — and its stall time is metered."""
+        work = self.work_items
+        for attr, tag in STAGES:
+            batch = getattr(work, attr)
+            if len(batch) == 0:
+                continue
+            if self._inflight[tag] < self._depth[tag]:
+                self._inflight[tag] += 1
+                self._depth_gauges[tag].set(self._inflight[tag])
+                setattr(work, attr, type(batch)())
+                # Never blocks: queued batches <= in-flight <= depth.
+                self._queues[tag].put(batch)
+                started = self._stalled_since.pop(tag, None)
+                if started is not None:
+                    self._stall_counters[tag].inc(
+                        time.perf_counter() - started
+                    )
+            else:
+                self._stalled_since.setdefault(tag, time.perf_counter())
+
+    def run(self) -> None:
+        work = self.work_items
+        add_result = {
+            "wal_results": work.add_wal_results,
+            "net_results": work.add_net_results,
+            "hash_results": work.add_hash_results,
+            "client_results": work.add_client_results,
+            "app_results": work.add_app_results,
+            "req_store_results": work.add_req_store_results,
+            "result_results": work.add_state_machine_results,
+        }
+        waiting_status: List["queue.Queue"] = []
+        health_due = False
+        try:
+            while True:
+                # Status may only be taken while no state-machine batch is
+                # in flight: the result worker mutates the machine
+                # off-thread.
+                if (
+                    (waiting_status or health_due)
+                    and self._inflight["result"] == 0
+                ):
+                    snap = self.snapshot_fn()
+                    for reply in waiting_status:
+                        reply.put(snap)
+                    waiting_status.clear()
+                    if health_due:
+                        health_due = False
+                        if self.on_snapshot is not None:
+                            self.on_snapshot(snap)
+                self._dispatch_ready()
+                tag, payload = self.inbox.get()
+                if tag == "stop" or self.notifier.exit_event.is_set():
+                    return
+                if tag == "tick":
+                    work.result_events.tick_elapsed()
+                    health_due = True
+                elif tag == "status":
+                    waiting_status.append(payload)
+                elif tag == "step_events":
+                    work.result_events.concat(payload)
+                elif tag in add_result:
+                    base = tag[: -len("_results")]
+                    add_result[tag](payload)
+                    self._inflight[base] -= 1
+                    self._depth_gauges[base].set(self._inflight[base])
+                else:
+                    raise AssertionError(f"unknown inbox tag {tag}")
+        except BaseException as e:
+            self.notifier.fail(e)
+        finally:
+            self._shutdown()
+
+    def _shutdown(self) -> None:
+        """Wake every blocked thread: close the admission window and drop
+        a sentinel in each stage/companion queue.  put_nowait is safe — a
+        full queue means its consumer has work ahead of the sentinel, and
+        exit_event (already set) stops it at the next item."""
+        if self.admission is not None:
+            self.admission.close()
+        sinks = [self._queues[tag] for _, tag in STAGES]
+        sinks.extend(
+            q for q in (self._wal_release_q, self._hash_collect_q)
+            if q is not None
+        )
+        for q in sinks:
+            try:
+                q.put_nowait(_SENTINEL)
+            except queue.Full:
+                pass

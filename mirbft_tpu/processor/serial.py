@@ -11,7 +11,7 @@ Ordering guarantees preserved from the reference:
 from __future__ import annotations
 
 import operator
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from .. import metrics, tracing
 from .. import state as st
@@ -71,23 +71,20 @@ def recover_wal_for_existing_node(
     return events
 
 
-def process_wal_actions(
+def apply_wal_actions(
     wal: WAL, actions: Actions, request_store: Optional[RequestStore] = None
-) -> Actions:
-    """Execute Persist/Truncate actions, sync, and pass Sends through —
-    the fsync-before-send barrier (reference serial.go:128-156).
-
-    When the request store supports checkpoint-keyed GC
-    (``storage.LogStore``), the WAL worker is also where the GC protocol
-    anchors: persisting a checkpoint CEntry *notes* its per-client low
-    watermarks against its WAL index, and a Truncate — emitted only once
-    a checkpoint is stable (statemachine/persisted.py) — releases the GC
-    for the noted watermarks at or below that index.  Both hooks are
-    advisory and degrade to no-ops on stores without them."""
+) -> Tuple[Actions, Optional[int]]:
+    """The write half of a WAL batch: execute Persist/Truncate actions and
+    collect the WAL-dependent Sends, WITHOUT the sync.  Returns
+    ``(net_actions, truncated_at)``; the caller owns the durability
+    barrier — it must sync the WAL before releasing ``net_actions`` to the
+    network, and run request-store GC for ``truncated_at`` only after that
+    sync (the pipeline scheduler overlaps batch k+1's writes with batch
+    k's fsync through this split; ``process_wal_actions`` recombines the
+    two halves for the serial path)."""
     net_actions = Actions()
     truncated_at: Optional[int] = None
     note = getattr(request_store, "note_checkpoint", None)
-    gc = getattr(request_store, "gc", None)
     for action in actions:
         if isinstance(action, st.ActionSend):
             net_actions.push_back(action)
@@ -108,7 +105,27 @@ def process_wal_actions(
             raise AssertionError(
                 f"unexpected WAL action type {type(action).__name__}"
             )
+    return net_actions, truncated_at
+
+
+def process_wal_actions(
+    wal: WAL, actions: Actions, request_store: Optional[RequestStore] = None
+) -> Actions:
+    """Execute Persist/Truncate actions, sync, and pass Sends through —
+    the fsync-before-send barrier (reference serial.go:128-156).
+
+    When the request store supports checkpoint-keyed GC
+    (``storage.LogStore``), the WAL worker is also where the GC protocol
+    anchors: persisting a checkpoint CEntry *notes* its per-client low
+    watermarks against its WAL index, and a Truncate — emitted only once
+    a checkpoint is stable (statemachine/persisted.py) — releases the GC
+    for the noted watermarks at or below that index.  Both hooks are
+    advisory and degrade to no-ops on stores without them."""
+    net_actions, truncated_at = apply_wal_actions(
+        wal, actions, request_store=request_store
+    )
     wal.sync()
+    gc = getattr(request_store, "gc", None)
     if gc is not None and truncated_at is not None:
         gc(truncated_at)
     return net_actions

@@ -150,6 +150,34 @@ class _BatchRelease:
         self.error: Optional[BaseException] = None
 
 
+class SyncTicket:
+    """In-flight durability barrier (``GroupCommitWAL.sync_begin``): the
+    registration half of ``sync()`` without the blocking half, so a caller
+    can overlap further writes with the fsync and ``wait()`` later — the
+    pipeline scheduler's WAL stage runs batch k+1's writes while batch k's
+    fsync is on disk.  ``wait()`` returns once every op buffered before
+    ``sync_begin`` is durable, or raises if the syncer failed."""
+
+    __slots__ = ("_wal", "_ticket", "_first")
+
+    def __init__(self, wal: "GroupCommitWAL", ticket: int, first):
+        self._wal = wal
+        self._ticket = ticket
+        self._first = first
+
+    def done(self) -> bool:
+        """True when the ticket is already durable (never blocks)."""
+        if self._first is None:
+            return True
+        return self._wal._ticket_done(self._ticket)
+
+    def wait(self) -> None:
+        if self._first is None:
+            return
+        self._wal._wait_ticket(self._ticket, self._first)
+        self._first = None
+
+
 class GroupCommitWAL:
     """File-backed ``processor.WAL`` with fsync-batched group commit."""
 
@@ -273,14 +301,32 @@ class GroupCommitWAL:
         """Durability barrier: block until every op buffered before this
         call has been written and fsynced (one group fsync may cover many
         concurrent callers)."""
+        self.sync_begin().wait()
+
+    def sync_begin(self) -> SyncTicket:
+        """Register a durability barrier without blocking: takes a ticket
+        for the ops buffered so far and wakes the syncer, exactly like
+        ``sync()``, but returns a ``SyncTicket`` instead of waiting — the
+        in-flight/complete notification surface the pipeline scheduler
+        overlaps WAL writes with fsyncs through.  ``sync()`` is
+        ``sync_begin().wait()``."""
         with self._cond:
             self._check_open()
             ticket = self._ops
             if self._durable_ops >= ticket:
-                return
+                return SyncTicket(self, ticket, None)
             self._sync_waiting += 1
             release = self._release
             self._work.notify()
+        return SyncTicket(self, ticket, release)
+
+    def _ticket_done(self, ticket: int) -> bool:
+        with self._cond:
+            if self._syncer_error is not None:
+                return True  # wait() will raise; don't report in-flight
+            return self._durable_ops >= ticket
+
+    def _wait_ticket(self, ticket: int, release: _BatchRelease) -> None:
         while True:
             release.event.wait()
             if release.error is not None:

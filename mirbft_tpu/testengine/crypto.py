@@ -366,6 +366,26 @@ class DeviceHashPlane:
 
     # -- fire-time (Hasher protocol) ----------------------------------------
 
+    def dispatch_batches(self, batches: Sequence[Sequence[bytes]]):
+        """The dispatch half of ``hash_batches`` for the pipeline scheduler
+        (processor/pipeline.py): start device work for ``batches`` without
+        blocking and return a handle for ``collect_batches``.  The hash
+        stage's worker calls this and moves on to the next action batch
+        while the device executes; the collector thread pays the blocking
+        sync.  Without a device both halves are host work and the split is
+        free."""
+        batches = list(batches)
+        if self.device:
+            self.enqueue(batches)
+            if self._pending:
+                self._launch_wave()
+        return batches
+
+    def collect_batches(self, handle) -> List[bytes]:
+        """The collect half: blocks until the handle's digests are served
+        (memo hits for the dispatched wave, host fallback for stragglers)."""
+        return self.hash_batches(handle)
+
     def hash_batches(self, batches: Sequence[Sequence[bytes]]) -> List[bytes]:
         out: List[Optional[bytes]] = [None] * len(batches)
         memo = self._memo
@@ -436,19 +456,48 @@ class DeviceHashPlane:
                 self._inflight.append((keys, refs, handle, dispatch_ts))
                 continue
             if self._fused is not None and hasattr(handle, "verify_count"):
+                row_map = handle.row_map
+                if (
+                    needed is not None
+                    and hasattr(self._fused, "collect_ready")
+                ):
+                    want = [i for i, k in enumerate(keys) if k in needed]
+                    if want and len(want) < len(keys):
+                        # Partial collect: only the rows the caller needs
+                        # cross the host boundary; the rest of the wave's
+                        # digest words stay device-resident, and the
+                        # handle (with remapped surviving rows) goes back
+                        # in flight for a later need or chained wave.
+                        rows = [row_map[i] if row_map else i for i in want]
+                        result = self._fused.collect_ready(handle, rows)
+                        self._harvest_auth(handle, result.verdicts)
+                        for j, i in enumerate(want):
+                            self._memo_put(keys[i], refs[i], result.digests[j])
+                            self._issued.pop(keys[i], None)
+                        taken = set(want)
+                        rest = [
+                            i for i in range(len(keys)) if i not in taken
+                        ]
+                        handle.row_map = [
+                            row_map[i] if row_map else i for i in rest
+                        ]
+                        self._inflight.append(
+                            (
+                                [keys[i] for i in rest],
+                                [refs[i] for i in rest],
+                                handle,
+                                dispatch_ts,
+                            )
+                        )
+                        continue
                 # Fused handle: ONE sync yields digests, verdicts and
                 # quorum posts together; verdicts flow straight into the
                 # auth plane's memo — no separate verify collect.
                 result = self._fused.collect(handle)
                 digests = result.digests
-                if handle.auth_keys:
-                    auth = self._fused_auth
-                    for akey, item, verdict in zip(
-                        handle.auth_keys, handle.auth_items, result.verdicts
-                    ):
-                        if item[0] in auth.keys:
-                            auth._memo_put(akey, item[2], bool(verdict))
-                    auth.verified_count += len(handle.auth_keys)
+                if row_map:
+                    digests = [digests[r] for r in row_map]
+                self._harvest_auth(handle, result.verdicts)
             else:
                 digests = self._hasher.collect(handle)
             for key, ref, digest in zip(keys, refs, digests):
@@ -466,6 +515,23 @@ class DeviceHashPlane:
         metrics.histogram("device_wait_seconds").observe(
             time.perf_counter() - start
         )
+
+    def _harvest_auth(self, handle, verdicts) -> None:
+        """Write a fused wave's verify verdicts into the auth plane's memo —
+        exactly once per handle (a partial collect already carries the full
+        verdict set, so later collects of the same handle must not
+        re-harvest)."""
+        if not handle.auth_keys:
+            return
+        auth = self._fused_auth
+        for akey, item, verdict in zip(
+            handle.auth_keys, handle.auth_items, verdicts
+        ):
+            if item[0] in auth.keys:
+                auth._memo_put(akey, item[2], bool(verdict))
+        auth.verified_count += len(handle.auth_keys)
+        handle.auth_keys = None
+        handle.auth_items = None
 
     def _host_hash(self, message: bytes) -> bytes:
         start = time.perf_counter()
@@ -496,6 +562,7 @@ class DeviceAuthPlane:
         device_floor: int = 16,
         lookahead: int = 128,
         mesh_devices: int = 0,
+        verify_kernel: str = "auto",
     ):
         from ..ops.ed25519 import Ed25519BatchVerifier
 
@@ -509,8 +576,11 @@ class DeviceAuthPlane:
             from ..parallel.mesh import make_mesh
 
             mesh = make_mesh(mesh_devices)
+        # ``verify_kernel`` defaults to the measured MXU/VPU crossover
+        # ("auto" resolves through ops/crossover.py at dispatch time);
+        # explicit "mxu"/"vpu" pins the field-multiply backend.
         self.verifier = Ed25519BatchVerifier(
-            min_device_batch=device_floor, mesh=mesh
+            min_device_batch=device_floor, kernel=verify_kernel, mesh=mesh
         )
         self.keys: Dict[int, bytes] = {}
         # (client_id, req_no, id(envelope)) -> (envelope ref, verdict);

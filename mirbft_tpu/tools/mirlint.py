@@ -958,6 +958,10 @@ REQUIRED_METRIC_NAMES = (
     "wal_group_commit_size",
     "store_gc_reclaimed_bytes_total",
     "snapshot_transfer_bytes_total",
+    # Pipeline scheduler (processor/pipeline.py, docs/PERFORMANCE.md §14).
+    "pipeline_depth",
+    "pipeline_stall_seconds",
+    "admission_window_size",
 )
 
 

@@ -120,6 +120,7 @@ def _write_cluster(
     node_config: Optional[dict] = None,
     byzantine: Optional[dict] = None,
     unreachable_after_s: float = 5.0,
+    pipeline: bool = False,
 ) -> None:
     """``cluster.json``: everything a child needs to boot.  The fault
     plane keys are optional — plain deployments (``run_deployment``) leave
@@ -139,6 +140,7 @@ def _write_cluster(
                 str(k): v for k, v in (byzantine or {}).items()
             },
             "unreachable_after_s": unreachable_after_s,
+            "pipeline": pipeline,
         },
     )
 
@@ -332,6 +334,11 @@ def run_node(root: Path, node_id: int) -> int:
     )
     wal = GroupCommitWAL(str(ndir / "wal"))
     request_store = LogStore(str(ndir / "reqs"))
+    pipeline = None
+    if cluster.get("pipeline"):
+        from mirbft_tpu.processor.pipeline import PipelineConfig
+
+        pipeline = PipelineConfig()
     node = Node(
         node_id,
         Config(**cfg),
@@ -343,6 +350,7 @@ def run_node(root: Path, node_id: int) -> int:
             request_store=request_store,
             interceptor=recorder,
         ),
+        pipeline=pipeline,
     )
     thresholds = cluster.get("thresholds")
     node.health_monitor.configure(
@@ -632,6 +640,7 @@ def run_deployment(
     kill_restart: bool = False,
     timeout_s: float = 90.0,
     client_id: int = 0,
+    pipeline: bool = False,
 ) -> dict:
     """Run a real multi-process deployment and return a result summary:
     ``{"commits": {node: n}, "agreement_problems": [...], "reconnects":
@@ -643,7 +652,7 @@ def run_deployment(
     root = Path(root_dir)
     root.mkdir(parents=True, exist_ok=True)
     ports = _reserve_ports(node_count)
-    _write_cluster(root, node_count, ports, [client_id])
+    _write_cluster(root, node_count, ports, [client_id], pipeline=pipeline)
     for i in range(node_count):
         _node_dir(root, i).mkdir(parents=True, exist_ok=True)
 
@@ -828,6 +837,7 @@ class _Cluster:
         thresholds: Optional[dict] = None,
         initial_plans: Optional[dict] = None,
         timeout_s: float = 60.0,
+        pipeline: bool = False,
     ):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
@@ -852,6 +862,7 @@ class _Cluster:
             ),
             byzantine=byzantine,
             unreachable_after_s=unreachable_after_s,
+            pipeline=pipeline,
         )
         self._faults_version = 0
         _write_json_atomic(
@@ -1118,7 +1129,7 @@ def _verdict(root: Path, name: str, data: dict, failures: List[str]) -> dict:
     return doc
 
 
-def _scenario_control(root: Path, seed: int) -> dict:
+def _scenario_control(root: Path, seed: int, *, pipeline: bool = False) -> dict:
     """Zero-rate control: the injector is wired on every link with all
     rates zero — the run must be indistinguishable from no injector at
     all.  Doctor healthy, zero anomalies, zero peer faults, zero injected
@@ -1129,6 +1140,7 @@ def _scenario_control(root: Path, seed: int) -> dict:
         root,
         seed=seed,
         initial_plans={i: FaultPlan(seed=seed) for i in range(4)},
+        pipeline=pipeline,
     ) as cluster:
         cluster.start()
         cluster.submit(0, 6)
@@ -1157,7 +1169,7 @@ def _scenario_control(root: Path, seed: int) -> dict:
     return _verdict(root, "control", res, failures)
 
 
-def _scenario_partition_minority(root: Path, seed: int) -> dict:
+def _scenario_partition_minority(root: Path, seed: int, *, pipeline: bool = False) -> dict:
     """Partition a minority node, wait until every survivor attributes
     ``peer_unreachable`` to it, heal, and require the full cluster (the
     healed node included) to commit fresh traffic.  View changes stay
@@ -1173,6 +1185,7 @@ def _scenario_partition_minority(root: Path, seed: int) -> dict:
         node_config=dict(_VIEWCHANGE_CONFIG),
         unreachable_after_s=0.8,
         timeout_s=45.0,
+        pipeline=pipeline,
     ) as cluster:
         cluster.start()
         cluster.submit(0, 4)
@@ -1236,7 +1249,7 @@ def _scenario_partition_minority(root: Path, seed: int) -> dict:
     return _verdict(root, "partition-minority", res, failures)
 
 
-def _scenario_partition_leader(root: Path, seed: int) -> dict:
+def _scenario_partition_leader(root: Path, seed: int, *, pipeline: bool = False) -> dict:
     """Partition the current primary (the genesis epoch activates as
     epoch 1, so the steady-state primary is node 1): the survivors must
     suspect it — attributing ``suspicion_vote`` to the *correct* node —
@@ -1249,6 +1262,7 @@ def _scenario_partition_leader(root: Path, seed: int) -> dict:
         node_config=dict(_VIEWCHANGE_CONFIG),
         unreachable_after_s=0.8,
         timeout_s=60.0,
+        pipeline=pipeline,
     ) as cluster:
         cluster.start()
         cluster.submit(0, 4)
@@ -1316,7 +1330,7 @@ def _scenario_partition_leader(root: Path, seed: int) -> dict:
     return _verdict(root, "partition-leader", res, failures)
 
 
-def _scenario_flap(root: Path, seed: int) -> dict:
+def _scenario_flap(root: Path, seed: int, *, pipeline: bool = False) -> dict:
     """Link flapping: three short partition/heal pulses against one node,
     each well below the unreachable threshold.  Reconnects happen, and
     dropped in-flight frames may force suspicion-based recovery (the
@@ -1332,6 +1346,7 @@ def _scenario_flap(root: Path, seed: int) -> dict:
         # Whole flap phase < 10s: cumulative outage can never cross it.
         unreachable_after_s=10.0,
         timeout_s=60.0,
+        pipeline=pipeline,
     ) as cluster:
         cluster.start()
         cluster.submit(0, 3)
@@ -1382,7 +1397,7 @@ def _scenario_flap(root: Path, seed: int) -> dict:
     return _verdict(root, "flap", res, failures)
 
 
-def _scenario_lossy_wan(root: Path, seed: int) -> dict:
+def _scenario_lossy_wan(root: Path, seed: int, *, pipeline: bool = False) -> dict:
     """Every link degraded at once — latency, jitter, drops, duplicates,
     reorders, corruption, truncation — netem's lossy-WAN shape.  The
     protocol may ride through view changes (suspicion is legitimate
@@ -1412,6 +1427,7 @@ def _scenario_lossy_wan(root: Path, seed: int) -> dict:
             i: FaultPlan(seed=seed + i, default=wan) for i in range(4)
         },
         timeout_s=90.0,
+        pipeline=pipeline,
     ) as cluster:
         cluster.start()
         cluster.submit(0, 8, timeout_s=90.0)
@@ -1452,7 +1468,7 @@ def _scenario_lossy_wan(root: Path, seed: int) -> dict:
     return _verdict(root, "lossy-wan", res, failures)
 
 
-def _scenario_byzantine_leader(root: Path, seed: int) -> dict:
+def _scenario_byzantine_leader(root: Path, seed: int, *, pipeline: bool = False) -> dict:
     """The current primary actively lies (the genesis epoch activates as
     epoch 1, primary node 1): every epoch-1 Preprepare it sends is
     rewritten with a different protocol-invalid batch per destination
@@ -1476,6 +1492,7 @@ def _scenario_byzantine_leader(root: Path, seed: int) -> dict:
         node_config=dict(_VIEWCHANGE_CONFIG),
         byzantine={byz: behaviors.as_dict()},
         timeout_s=60.0,
+        pipeline=pipeline,
     ) as cluster:
         cluster.start()
         cluster.submit(0, 6, timeout_s=60.0)
@@ -1523,7 +1540,7 @@ def _scenario_byzantine_leader(root: Path, seed: int) -> dict:
     return _verdict(root, "byzantine-leader", res, failures)
 
 
-def _scenario_rolling_kill(root: Path, seed: int) -> dict:
+def _scenario_rolling_kill(root: Path, seed: int, *, pipeline: bool = False) -> dict:
     """Soak: SIGKILL each non-zero node in turn, wait for the survivors to
     attribute the outage, restart it from its durable stores, and keep
     committing.  Every victim must be attributed ``peer_unreachable``;
@@ -1536,6 +1553,7 @@ def _scenario_rolling_kill(root: Path, seed: int) -> dict:
         node_config=dict(_VIEWCHANGE_CONFIG),
         unreachable_after_s=0.6,
         timeout_s=60.0,
+        pipeline=pipeline,
     ) as cluster:
         cluster.start()
         cluster.submit(0, 2)
@@ -1587,7 +1605,7 @@ def _scenario_rolling_kill(root: Path, seed: int) -> dict:
     return _verdict(root, "rolling-kill", res, failures)
 
 
-def _scenario_kill_under_write(root: Path, seed: int) -> dict:
+def _scenario_kill_under_write(root: Path, seed: int, *, pipeline: bool = False) -> dict:
     """Crash-recovery drill for the storage engine: SIGKILL one node under
     sustained client write load, have the survivors commit far past what
     the victim's WAL can replay (multiple checkpoint intervals), restart
@@ -1607,6 +1625,7 @@ def _scenario_kill_under_write(root: Path, seed: int) -> dict:
         node_config=dict(_VIEWCHANGE_CONFIG),
         unreachable_after_s=0.6,
         timeout_s=120.0,
+        pipeline=pipeline,
     ) as cluster:
         cluster.start()
         # Warm up with the full cluster so the victim dies with real
@@ -1725,10 +1744,11 @@ SCENARIOS = {
 
 
 def run_scenario(name: str, root_dir: Optional[str] = None,
-                 seed: int = 7) -> dict:
+                 seed: int = 7, pipeline: bool = False) -> dict:
     """Run one choreographed fault scenario; returns the verdict document
     (also written to ``<dir>/scenario.json``) or raises AssertionError
-    listing every failed check."""
+    listing every failed check.  ``pipeline=True`` runs every node on the
+    staged pipeline scheduler instead of the classic depth-1 schedule."""
     if name not in SCENARIOS:
         raise KeyError(
             f"unknown scenario {name!r} "
@@ -1736,7 +1756,7 @@ def run_scenario(name: str, root_dir: Optional[str] = None,
         )
     if root_dir is None:
         root_dir = tempfile.mkdtemp(prefix=f"mirnet-{name}-")
-    return SCENARIOS[name](Path(root_dir), seed)
+    return SCENARIOS[name](Path(root_dir), seed, pipeline=pipeline)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -1757,6 +1777,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(see --list-scenarios)")
     parser.add_argument("--seed", type=int, default=7,
                         help="fault-injection seed for --scenario")
+    parser.add_argument("--pipeline", action="store_true",
+                        help="run nodes on the staged pipeline scheduler "
+                             "(processor/pipeline.py) instead of the "
+                             "classic depth-1 schedule")
     parser.add_argument("--list-scenarios", action="store_true")
     args = parser.parse_args(argv)
 
@@ -1773,7 +1797,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.scenario is not None:
         try:
             doc = run_scenario(args.scenario, root_dir=args.dir,
-                               seed=args.seed)
+                               seed=args.seed, pipeline=args.pipeline)
         except AssertionError as err:
             print(str(err), file=sys.stderr)
             return 1
@@ -1786,6 +1810,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         reqs=args.reqs,
         kill_restart=args.kill_restart,
         timeout_s=args.timeout,
+        pipeline=args.pipeline,
     )
     print(json.dumps(result, indent=2, sort_keys=True))
     print(

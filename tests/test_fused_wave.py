@@ -132,6 +132,84 @@ def test_fused_lease_discipline_across_pipelined_waves():
     assert len(pool._free[key]) == 4  # reused, not grown
 
 
+def test_fused_chained_wave_gates_on_previous_wave_digests():
+    """Wave 2's quorum gates reference wave 1's digests WITHOUT wave 1
+    ever being collected: the chained handle keeps wave 1's digest words
+    device-resident and the program gates against the combined
+    [chain; current] row space."""
+    pipe = FusedCryptoPipeline(n_slots=8, n_digest_slots=1, kernel="scan")
+    msgs1 = [b"chain-a-%d" % i for i in range(4)]
+    msgs2 = [b"chain-b-%d" % i for i in range(4)]
+    h1 = pipe.dispatch_wave(msgs1)
+    rows1 = h1.rows
+    quorum = [
+        # Gates on WAVE 1's row 2 (still in HBM, never collected).
+        (3, [(0, 0, 2, hashlib.sha256(msgs1[2]).digest())]),
+        # Rejected: wrong claim against wave 1's row 1.
+        (5, [(1, 0, 1, b"\xff" * 32)]),
+        # Gates on THIS wave's row 1 (offset past the chained rows).
+        (6, [(2, 0, rows1 + 1, hashlib.sha256(msgs2[1]).digest())]),
+    ]
+    h2 = pipe.dispatch_wave(msgs2, quorum=quorum, chain=h1)
+    assert h2.chain is h1
+    res2 = pipe.collect(h2)
+    masks0, counts0 = _fresh_states(8, 1)
+    rd, _, rm, rc, rp, rn = host_fused_reference(
+        msgs2, None, quorum, masks0, counts0,
+        prev_digests=[hashlib.sha256(m).digest() for m in msgs1],
+        prev_rows=rows1,
+    )
+    assert res2.digests == rd
+    nq = len(quorum)
+    assert (res2.posts[:nq] == rp[:nq]).all()
+    assert (res2.newbits[:nq] == rn[:nq]).all()
+    dm, dc = pipe.quorum_state()
+    assert (dm == rm).all() and (dc == rc).all()
+    # The chained wave's own digests stayed collectable throughout.
+    res1 = pipe.collect(h1)
+    assert res1.digests == [hashlib.sha256(m).digest() for m in msgs1]
+
+
+def test_fused_chained_wave_rejects_released_handle():
+    pipe = FusedCryptoPipeline(n_slots=4, n_digest_slots=1, kernel="scan")
+    h1 = pipe.dispatch_wave([b"gone"])
+    pipe.collect(h1)
+    h1.words = None
+    with pytest.raises(ValueError, match="released"):
+        pipe.dispatch_wave([b"next"], chain=h1)
+
+
+def test_fused_collect_ready_partial_rows_keep_handle_chainable():
+    """collect_ready materializes only the requested (commit-ready) rows;
+    the handle's digest words stay device-resident, still feed a chained
+    follow-up wave, and a later full collect yields everything."""
+    pipe = FusedCryptoPipeline(n_slots=4, n_digest_slots=1, kernel="scan")
+    msgs = [b"ready-%d" % i for i in range(6)]
+    expect = [hashlib.sha256(m).digest() for m in msgs]
+    h = pipe.dispatch_wave(msgs)
+    part = pipe.collect_ready(h, [4, 1])
+    assert part.digests == [expect[4], expect[1]]  # result follows ``rows``
+    assert h.lease is None  # pooled packing slab returned
+    assert h.words is not None  # the wave's digests never left the device
+    # The partially-collected handle still chains the next wave's gate.
+    quorum = [(2, [(0, 0, 0, expect[0])])]
+    h2 = pipe.dispatch_wave([b"ready-follow"], quorum=quorum, chain=h)
+    res2 = pipe.collect(h2)
+    masks0, counts0 = _fresh_states(4, 1)
+    _, _, _, _, rp, rn = host_fused_reference(
+        [b"ready-follow"], None, quorum, masks0, counts0,
+        prev_digests=expect, prev_rows=h.rows,
+    )
+    assert (res2.posts[:1] == rp[:1]).all()
+    assert (res2.newbits[:1] == rn[:1]).all()
+    assert pipe.collect_ready(h, []).digests == []
+    full = pipe.collect(h)
+    assert full.digests == expect
+    with pytest.raises(ValueError, match="outside"):
+        pipe.collect_ready(h, [len(msgs)])
+    assert metrics.snapshot().get("fused_partial_collects", 0) >= 2
+
+
 def test_wave_controller_grows_on_backlog_and_shrinks_when_idle():
     wc = WaveController(initial=64, floor=16, ceiling=512)
     assert wc.observe(200, 64, 64e-5) == 128  # queue ≥ 2× size: grow

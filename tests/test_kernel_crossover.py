@@ -82,3 +82,55 @@ def test_resolve_verify_backend_from_injected_probe():
 def test_resolve_verify_backend_env_override(monkeypatch):
     monkeypatch.setenv("MIRBFT_TPU_VERIFY_KERNEL", "mxu")
     assert resolve_verify_backend("auto", backend="cpu") == "mxu"
+
+
+def test_fused_pipeline_verify_kernel_defaults_to_crossover():
+    """The fused pipeline's verify stage rides the measured crossover by
+    default: "auto" resolves through resolve_verify_backend, and an
+    explicit kernel passes through untouched."""
+    from mirbft_tpu.ops.fused import FusedCryptoPipeline
+
+    pipe = FusedCryptoPipeline(n_slots=4, n_digest_slots=1)
+    assert pipe.verifier.kernel == "auto"
+    if _jax.default_backend() != "tpu":
+        assert pipe.resolved_verify_kernel() == "vpu"
+    pinned = FusedCryptoPipeline(
+        n_slots=4, n_digest_slots=1, verify_kernel="mxu"
+    )
+    assert pinned.resolved_verify_kernel() == "mxu"
+
+
+def test_fused_dispatch_compiles_resolved_backend(monkeypatch):
+    """A fused dispatch hands the RESOLVED backend to the compile cache —
+    pinned here by env-overriding the crossover and capturing the
+    ``_compiled_fused`` backend argument."""
+    import mirbft_tpu.ops.fused as fused_mod
+
+    monkeypatch.setenv("MIRBFT_TPU_VERIFY_KERNEL", "mxu")
+    pipe = fused_mod.FusedCryptoPipeline(n_slots=4, n_digest_slots=1)
+    assert pipe.resolved_verify_kernel() == "mxu"
+    captured = {}
+    real = fused_mod._compiled_fused
+
+    def spy(layout, backend, interpret, donate):
+        captured["backend"] = backend
+        return real(layout, backend, interpret, donate)
+
+    monkeypatch.setattr(fused_mod, "_compiled_fused", spy)
+    pipe.collect(pipe.dispatch_wave([b"crossover-fused"]))
+    assert captured["backend"] == "mxu"
+
+
+def test_device_auth_plane_verify_kernel_default_and_pin():
+    """DeviceAuthPlane defaults its verifier to the measured crossover and
+    forwards an explicit pin."""
+    from mirbft_tpu.testengine.crypto import DeviceAuthPlane
+
+    plane = DeviceAuthPlane(lambda cid, rn: [], device=False)
+    assert plane.verifier.kernel == "auto"
+    if _jax.default_backend() != "tpu":
+        assert plane.verifier.resolved_kernel() == "vpu"
+    pinned = DeviceAuthPlane(
+        lambda cid, rn: [], device=False, verify_kernel="mxu"
+    )
+    assert pinned.verifier.resolved_kernel() == "mxu"

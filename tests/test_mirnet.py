@@ -51,14 +51,17 @@ def test_mirnet_kill_restart_reconnects_and_commits(tmp_path):
 # --------------------------------------------------------------------------
 
 
-def test_mirnet_scenario_control_zero_rates_clean(tmp_path):
+@pytest.mark.parametrize("pipeline", [False, True], ids=["classic", "pipeline"])
+def test_mirnet_scenario_control_zero_rates_clean(tmp_path, pipeline):
     """Control run: the fault injector is wired on every link with all
     rates zero.  The doctor must exit clean — zero anomalies, zero peer
     faults, zero injected frames — proving the injector itself perturbs
-    nothing (the baseline every hostile scenario is judged against)."""
+    nothing (the baseline every hostile scenario is judged against).
+    Run both schedules: the staged pipeline (processor/pipeline.py) must
+    look identical to the classic depth-1 loop from the wire's view."""
     from mirbft_tpu.tools.mirnet import run_scenario
 
-    doc = run_scenario("control", root_dir=str(tmp_path))
+    doc = run_scenario("control", root_dir=str(tmp_path), pipeline=pipeline)
     assert doc["verdict"] == "pass"
     doctor = doc["data"]["doctor"]
     assert doctor["healthy"]
@@ -67,6 +70,8 @@ def test_mirnet_scenario_control_zero_rates_clean(tmp_path):
     for kinds in doc["data"]["injected"].values():
         assert not any(kinds.values())
     assert (tmp_path / "scenario.json").exists()
+    cluster = json.loads((tmp_path / "cluster.json").read_text())
+    assert cluster["pipeline"] is pipeline
 
 
 def test_mirnet_scenario_partition_heal_smoke(tmp_path):
@@ -107,3 +112,18 @@ def test_mirnet_scenario_matrix(tmp_path, name):
     doc = run_scenario(name, root_dir=str(tmp_path))
     assert doc["verdict"] == "pass"
     assert doc["data"]["agreement_problems"] == []
+
+
+@pytest.mark.slow
+def test_mirnet_kill_under_write_pipelined(tmp_path):
+    """The crash-recovery drill must run unchanged on the pipelined path:
+    SIGKILL under write load, snapshot state transfer on restart, and
+    seq-keyed bit-identical commit logs — the pipeline's WAL/reqstore
+    barriers are doing their job across a real process kill."""
+    from mirbft_tpu.tools.mirnet import run_scenario
+
+    doc = run_scenario("kill-under-write", root_dir=str(tmp_path),
+                       pipeline=True)
+    assert doc["verdict"] == "pass"
+    assert doc["data"]["agreement_problems"] == []
+    assert doc["snapshot_transfer_bytes"] > 0

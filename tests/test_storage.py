@@ -159,6 +159,50 @@ def test_wal_group_commit_concurrent_syncs(tmp_path):
     wal2.close()
 
 
+def test_wal_sync_begin_overlaps_writes_with_inflight_fsync(tmp_path):
+    """sync_begin(): the registration half of sync() — further writes land
+    while the ticket's fsync is in flight, wait() covers exactly the ops
+    buffered before registration, and an already-durable ticket is done
+    immediately."""
+    wal = GroupCommitWAL(str(tmp_path / "wal"))
+    wal.write(1, m.PEntry(seq_no=1, digest=b"a"))
+    ticket = wal.sync_begin()
+    # Overlap: the next batch's writes go in while ticket 1 syncs.
+    wal.write(2, m.PEntry(seq_no=2, digest=b"b"))
+    later = wal.sync_begin()
+    ticket.wait()
+    assert ticket.done()
+    later.wait()
+    assert later.done()
+    # Nothing new buffered: the barrier is already durable, no blocking.
+    settled = wal.sync_begin()
+    assert settled.done()
+    settled.wait()
+    wal.close()
+
+    wal2 = GroupCommitWAL(str(tmp_path / "wal"))
+    assert [i for i, _ in load(wal2)] == [1, 2]
+    wal2.close()
+
+
+def test_wal_sync_begin_many_tickets_resolve_in_any_wait_order(tmp_path):
+    """Tickets may be waited out of registration order (the pipeline's
+    release thread waits them FIFO, but the contract itself is
+    order-free): each wait returns only once ITS ops are durable."""
+    wal = GroupCommitWAL(str(tmp_path / "wal"))
+    tickets = []
+    for index in range(1, 9):
+        wal.write(index, m.PEntry(seq_no=index, digest=b"t"))
+        tickets.append(wal.sync_begin())
+    for ticket in reversed(tickets):
+        ticket.wait()
+        assert ticket.done()
+    wal.close()
+    wal2 = GroupCommitWAL(str(tmp_path / "wal"))
+    assert [i for i, _ in load(wal2)] == list(range(1, 9))
+    wal2.close()
+
+
 def test_wal_segment_report_clean_and_corrupt(tmp_path):
     wal = GroupCommitWAL(str(tmp_path / "wal"), segment_max_bytes=128)
     for index, entry in entries(40):
