@@ -97,6 +97,7 @@ def run_fast_engine(
     device=True,
     device_authoritative=False,
     streaming_auth=False,
+    pipeline=None,
     tweak=None,
     timeout=100_000_000,
 ):
@@ -104,7 +105,10 @@ def run_fast_engine(
     tests/test_fastengine.py).  Device crypto: Ed25519 verdicts come from
     pipelined device waves before the run; wave-eligible hash content is
     mirrored to the device asynchronously during the run and verified at
-    collect.  Returns the same result-dict shape as run_engine."""
+    collect.  ``pipeline=True`` drives the run through the shared staged
+    scheduler (``testengine/sched.py`` FastStageDriver) — host-side only,
+    the simulated schedule stays bit-identical.  Returns the same
+    result-dict shape as run_engine."""
     from mirbft_tpu import metrics
     from mirbft_tpu.testengine import Spec
     from mirbft_tpu.testengine.fastengine import FastRecording
@@ -127,6 +131,7 @@ def run_fast_engine(
         device=device,
         device_authoritative=device_authoritative,
         streaming_auth=streaming_auth,
+        pipeline=pipeline,
     )
     steps = recording.drain_clients(timeout=timeout)
     elapsed = time.perf_counter() - start
@@ -168,10 +173,13 @@ def run_engine(
     signed=False,
     device=True,
     corrupt_clients=(),
+    pipeline=None,
     tweak=None,
     timeout=100_000_000,
 ):
-    """One testengine run; returns a result dict."""
+    """One testengine run; returns a result dict.  ``pipeline=True`` runs
+    the staged host schedule (``testengine/sched.py`` SimStagePipeline);
+    the simulated event schedule stays bit-identical to a serial run."""
     from mirbft_tpu import metrics
     from mirbft_tpu.testengine import Spec
 
@@ -183,6 +191,7 @@ def run_engine(
         batch_size=batch_size,
         signed_requests=signed,
         crypto=_device_crypto() if device else None,
+        pipeline=pipeline,
     )
     recorder = spec.recorder()
     for cid in corrupt_clients:
@@ -229,9 +238,12 @@ def run_engine(
 _HEADLINE_PREFIXES = ("c4_128n_wan_viewchange",)
 _HEADLINE_KEYS = (
     "c1_4n_unique_req_per_s",
+    "c1_serial_4n_unique_req_per_s",
+    "c1_pipeline_over_serial",
     "c2_16n_signed_unique_req_per_s",
     "c2_signed_over_unsigned_slowdown",
     "c3_64n_unique_req_per_s",
+    "c3_serial_64n_unique_req_per_s",
     "c3_64n_commit_ops_per_s",
     "c3_engine_speedup",
     "c4_epoch_changed",
@@ -263,6 +275,24 @@ def headline_last(detail):
         (k, detail[k]) for k in _HEADLINE_KEYS if k in detail
     )
     return ordered
+
+
+def commit_stream(res):
+    """Bit-identity fingerprint of a finished run's commit stream: the
+    step count plus every node's final (checkpoint seq, checkpoint hash).
+    The checkpoint hash covers the committed history up to its seq, so
+    equal fingerprints across a serial and a pipelined run (or across the
+    Python and native engines) mean the schedules committed identically.
+    Must be taken BEFORE ``put`` (which releases the recording)."""
+
+    def ckpt(node):
+        state = node if hasattr(node, "checkpoint_seq_no") else node.state
+        return (state.checkpoint_seq_no, state.checkpoint_hash)
+
+    return (
+        res["steps"],
+        tuple(ckpt(n) for n in res["recording"].nodes),
+    )
 
 
 def put(detail, prefix, res, engaged_keys=True):
@@ -1494,13 +1524,145 @@ def bench_pipeline(detail, batch=4096, msg_len=640, waves=8, ready_rows=64,
     )
 
 
+def bench_commit_latency(detail, reqs=400, window=64):
+    """Commit latency under open-loop load on the REAL threaded runtime
+    (``Node`` running the pipelined scheduler): one node on durable
+    group-commit stores, a proposer thread pushing requests as fast as the
+    admission window admits them.  On record:
+
+    - ``pipeline_load_commit_latency_ms_p50`` / ``_p99``: the commit-span
+      tracer's per-node ``commit_latency_seconds`` histogram (wall-clock
+      span from ingress to the result stage observing the commit).
+    - ``pipeline_load_admission_stall_ms_p99``: p99 of the LIVE
+      ``AdmissionWindow.admit`` wait during the run — the backpressure
+      delay ingress actually saw (the synthetic fixed-rate-completer
+      variant above is ``pipeline_admission_stall_ms_p99``).
+    """
+    import hashlib
+    import queue as queue_mod
+    import tempfile
+    import threading
+
+    from mirbft_tpu import metrics, wire
+    from mirbft_tpu.config import Config, standard_initial_network_state
+    from mirbft_tpu.messages import NetworkState
+    from mirbft_tpu.node import Node, ProcessorConfig
+    from mirbft_tpu.processor.pipeline import PipelineConfig
+    from mirbft_tpu.reqstore import Store
+    from mirbft_tpu.storage import GroupCommitWAL
+    from mirbft_tpu.testengine.crypto import DeviceHashPlane
+
+    class _App:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.committed = set()
+
+        def apply(self, entry):
+            with self.lock:
+                for req in entry.requests:
+                    self.committed.add((req.client_id, req.req_no))
+
+        def snap(self, network_config, client_states):
+            state = NetworkState(
+                config=network_config,
+                clients=tuple(client_states),
+                pending_reconfigurations=(),
+            )
+            encoded = wire.encode(state)
+            return hashlib.sha256(encoded).digest() + encoded, ()
+
+        def transfer_to(self, seq_no, snap):
+            return wire.decode(snap[32:])
+
+    # Loopback delivery on its own thread (a node must never step itself
+    # synchronously from inside a scheduler worker).
+    inbox = queue_mod.Queue()
+
+    class _Link:
+        def send(self, dest, msg):
+            inbox.put(msg)
+
+    metrics.default_registry.reset()
+    app = _App()
+    with tempfile.TemporaryDirectory(prefix="bench-commit-lat-") as root:
+        node = Node(
+            0,
+            Config(id=0, batch_size=1),
+            ProcessorConfig(
+                link=_Link(),
+                hasher=DeviceHashPlane(device=False),
+                app=app,
+                wal=GroupCommitWAL(root + "/wal"),
+                request_store=Store(root + "/reqs.db"),
+            ),
+            pipeline=PipelineConfig(admission_window=window),
+        )
+        stop = threading.Event()
+
+        def deliver():
+            while not stop.is_set():
+                try:
+                    msg = inbox.get(timeout=0.05)
+                except queue_mod.Empty:
+                    continue
+                try:
+                    node.step(0, msg)
+                except Exception:
+                    return
+        thread = threading.Thread(target=deliver, daemon=True)
+        thread.start()
+        try:
+            node.process_as_new_node(
+                standard_initial_network_state(1, 0),
+                b"initial",
+                tick_interval=0.02,
+            )
+            deadline = time.time() + 60
+            for req_no in range(reqs):
+                while time.time() < deadline:
+                    try:
+                        node.client(0).propose(req_no, b"lat-%d" % req_no)
+                        break
+                    except KeyError:
+                        time.sleep(0.01)  # client window not allocated yet
+            while time.time() < deadline:
+                with app.lock:
+                    if len(app.committed) >= reqs:
+                        break
+                if node.notifier.err() is not None:
+                    break
+                time.sleep(0.02)
+            lat = metrics.histogram(
+                "commit_latency_seconds", labels={"node": "0"}
+            )
+            stall = metrics.histogram("pipeline_admission_stall_seconds")
+            detail["pipeline_load_commit_latency_ms_p50"] = round(
+                lat.percentile(50) * 1e3, 3
+            )
+            detail["pipeline_load_commit_latency_ms_p99"] = round(
+                lat.percentile(99) * 1e3, 3
+            )
+            detail["pipeline_load_admission_stall_ms_p99"] = round(
+                stall.percentile(99) * 1e3, 3
+            )
+            detail["pipeline_load_commits"] = len(app.committed)
+        finally:
+            stop.set()
+            thread.join(timeout=2)
+            node.stop()
+            node.processor_config.wal.close()
+            node.processor_config.request_store.close()
+
+
 def guard_pipeline_planes(detail):
-    """The pipeline must not tax the planes it composes: this run's
-    ``wal_append_mb_s`` and ``fused_wave_4096_ms`` must stay within ±25%
-    (in the direction that hurts) of the most recent recorded bench round
-    carrying the key (``BENCH_r*.json``) — the ``hash_sync_regression``
-    guard pattern.  Keys with no recorded baseline yet are noted, not
-    failed; the verdicts land in ``pipeline_plane_guard``."""
+    """The pipeline must not tax the planes it composes, and the pipelined
+    headline must hold what it won: this run's ``wal_append_mb_s``,
+    ``fused_wave_4096_ms``, ``pipeline_e2e_hashes_per_s`` and
+    ``c1_4n_unique_req_per_s`` must stay within ±25% (in the direction
+    that hurts) of the most recent recorded bench round carrying the key
+    (``BENCH_r*.json``) — the ``hash_sync_regression`` guard pattern.
+    Keys with no recorded baseline yet are noted, not failed; the
+    verdicts land in ``pipeline_plane_guard``."""
     import glob
     import os
 
@@ -1513,16 +1675,20 @@ def guard_pipeline_planes(detail):
                     doc = json.load(f)
             except (OSError, ValueError):
                 continue
-            value = (doc.get("detail") or {}).get(key)
-            if isinstance(value, (int, float)):
-                return value, os.path.basename(path)
+            # Archived rounds nest the result under "parsed"; accept both.
+            for container in (doc, doc.get("parsed") or {}):
+                value = (container.get("detail") or {}).get(key)
+                if isinstance(value, (int, float)):
+                    return value, os.path.basename(path)
         return None, None
 
     verdicts = {}
     breaches = []
     # (key, True if larger-is-worse)
     for key, worse_high in (("wal_append_mb_s", False),
-                            ("fused_wave_4096_ms", True)):
+                            ("fused_wave_4096_ms", True),
+                            ("pipeline_e2e_hashes_per_s", False),
+                            ("c1_4n_unique_req_per_s", False)):
         current = detail.get(key)
         ref, source = latest_recorded(key)
         if not isinstance(current, (int, float)):
@@ -1552,22 +1718,37 @@ def main():
 
     # Configs 1-3 run on the NATIVE fast engine (a bit-identical twin of the
     # Python engine — tests/test_fastengine.py pins the full evolution), with
-    # the Python engine's own runs reported alongside as `*py_*` so both
+    # the Python engine's own runs reported alongside (`*_serial_*` for the
+    # c1/c3 schedule-comparison rows, `*py_*` for c2) so both
     # implementations' numbers are on record.  On any FastEngineUnsupported
-    # the Python result doubles as the primary.
+    # a Python run doubles as the primary.
     from mirbft_tpu.testengine.fastengine import FastEngineUnsupported
 
     # Config 1: 4-node green path (host crypto: batches too small to win on
-    # a device; this is the latency-bound smoke config).
-    res_py = run_engine(4, 4, 500, 100, device=False)
-    put(detail, "c1py_4n", res_py, engaged_keys=False)
+    # a device; this is the latency-bound smoke config).  The headline
+    # c1_4n row runs the PIPELINED schedule — the default since the one-
+    # scheduler change — on the native engine; the Python serial run rides
+    # along as the c1_serial_4n comparison row, and the serial and
+    # pipelined commit streams are asserted bit-identical in the same run.
+    res_serial = run_engine(4, 4, 500, 100, device=False)
+    serial_stream = commit_stream(res_serial)
+    put(detail, "c1_serial_4n", res_serial, engaged_keys=False)
     try:
-        res = run_fast_engine(4, 4, 500, 100, device=False)
-        assert res["steps"] == detail["c1py_4n_sim_steps"], "engine divergence"
+        res = run_fast_engine(4, 4, 500, 100, device=False, pipeline=True)
+        assert commit_stream(res) == serial_stream, (
+            "pipelined fast schedule diverged from the serial python run"
+        )
         put(detail, "c1_4n", res, engaged_keys=False)
     except FastEngineUnsupported as exc:
         detail["c1_fast_unsupported"] = str(exc)[:120]
-        put(detail, "c1_4n", res_py, engaged_keys=False)
+        res = run_engine(4, 4, 500, 100, device=False, pipeline=True)
+        assert commit_stream(res) == serial_stream, (
+            "pipelined python schedule diverged from the serial python run"
+        )
+        put(detail, "c1_4n", res, engaged_keys=False)
+    detail["c1_pipeline_over_serial"] = round(
+        res["unique_per_s"] / max(res_serial["unique_per_s"], 1e-9), 2
+    )
 
     # Config 2: 16-node, Ed25519-signed client requests, device crypto —
     # plus the unsigned twin for the signing-cost ratio (always computed
@@ -1608,9 +1789,12 @@ def main():
     # Config 3 (north star): 64-replica stress, device crypto.  The fast
     # run is measured three times and the best run reported (all walls are
     # on record): this rig's shared tunnel/host varies +/-40% run to run,
-    # and the steady-state rate is the quantity of interest.
+    # and the steady-state rate is the quantity of interest.  As with c1,
+    # the headline c3_64n rows run the pipelined schedule and the Python
+    # serial run is kept as the c3_serial_64n comparison row.
     res_py = run_engine(64, 64, 100, 100, device=True)
-    put(detail, "c3py_64n", res_py)
+    serial_stream_c3 = commit_stream(res_py)
+    put(detail, "c3_serial_64n", res_py)
     try:
         from mirbft_tpu import _native
 
@@ -1619,7 +1803,10 @@ def main():
             if _native.load_fast() is not None
             else {}
         )
-        runs = [run_fast_engine(64, 64, 100, 100, device=True) for _ in range(3)]
+        runs = [
+            run_fast_engine(64, 64, 100, 100, device=True, pipeline=True)
+            for _ in range(3)
+        ]
         # Snapshot the global part counters HERE: any engine run between
         # the snapshots (c3dev, PDES rows) pollutes the ack-share delta —
         # round 4's reported ack-share doubling was exactly this artifact
@@ -1630,7 +1817,7 @@ def main():
             else {}
         )
         for r in runs:
-            assert r["steps"] == detail["c3py_64n_sim_steps"], "engine divergence"
+            assert commit_stream(r) == serial_stream_c3, "engine divergence"
         detail["c3_64n_wall_runs_s"] = [round(r["wall_s"], 2) for r in runs]
         engines = [r["recording"]._engine for r in runs]
         res = min(runs, key=lambda r: r["wall_s"])
@@ -1638,7 +1825,10 @@ def main():
         mean_fast_wall = sum(r["wall_s"] for r in runs) / len(runs)
     except FastEngineUnsupported as exc:
         detail["c3_fast_unsupported"] = str(exc)[:120]
-        res = res_py
+        res = run_engine(64, 64, 100, 100, device=True, pipeline=True)
+        assert commit_stream(res) == serial_stream_c3, (
+            "pipelined python schedule diverged from the serial python run"
+        )
         put(detail, "c3_64n", res)
     headline = res["unique_per_s"]
     detail["c3_64n_commit_ops"] = res["commit_ops"]
@@ -1653,14 +1843,14 @@ def main():
         res_dev = run_fast_engine(
             64, 64, 100, 100, device=True, device_authoritative=True
         )
-        assert res_dev["steps"] == detail["c3py_64n_sim_steps"], (
+        assert res_dev["steps"] == detail["c3_serial_64n_sim_steps"], (
             "device-authoritative schedule diverged"
         )
         put(detail, "c3dev_64n", res_dev)
         detail["c3dev_64n_stall_s"] = round(res_dev["device_stall_s"], 2)
     except Exception as exc:
         detail["c3dev_error"] = f"{type(exc).__name__}: {exc}"[:160]
-    if res is not res_py:
+    if "c3_fast_unsupported" not in detail:
         # Mean fast wall vs the single Python run: comparing best-of-N
         # against a single sample would bias the ratio upward.
         detail["c3_engine_speedup"] = round(
@@ -1788,6 +1978,10 @@ def main():
         bench_pipeline(detail)
     except Exception as exc:
         detail["pipeline_error"] = f"{type(exc).__name__}: {exc}"[:160]
+    try:
+        bench_commit_latency(detail)
+    except Exception as exc:
+        detail["commit_latency_error"] = f"{type(exc).__name__}: {exc}"[:160]
     try:
         # Regression guard: the pipeline must not tax the planes it
         # composes (keys above are already recorded either way).

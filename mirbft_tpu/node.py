@@ -150,7 +150,9 @@ class Client:
         if self._notifier.exit_event.is_set():
             raise self._notifier.err() or StoppedError()
         if events:
-            self._inbox.put(("client_results", events))
+            # "client_ingress", not "client_results": this thread never
+            # acquired the client stage, so it must not release it.
+            self._inbox.put(("client_ingress", events))
 
 
 class Node:
@@ -166,6 +168,7 @@ class Node:
         self.id = node_id
         self.config = config
         self.processor_config = processor_config
+        self.pipeline = pipeline
         self.state_machine = StateMachine(config.logger)
         self.work_items = proc.WorkItems()
         self.clients = proc.Clients(
@@ -206,6 +209,14 @@ class Node:
         )
         # Coordinator inbox: tagged results/ingress/control messages.
         self.inbox = self.scheduler.inbox
+
+    @property
+    def schedule(self) -> str:
+        """The active schedule name — what deployment tooling records in
+        ``cluster.json`` and health reports: ``"pipelined"`` when a
+        pipeline config was passed, ``"classic"`` for the reference
+        coordinator."""
+        return "classic" if self.pipeline is None else "pipelined"
 
     @property
     def _threads(self) -> List[threading.Thread]:
@@ -250,7 +261,7 @@ class Node:
     def _ingest_forward(self, source: int, msg) -> None:
         """Inbound ForwardRequest (a peer answering our FetchRequest),
         intercepted at replica ingress.  Verified + stored via the client
-        store; the RequestPersisted events take the client_results inbox
+        store; the RequestPersisted events take the client_ingress inbox
         path so they cross the request-store durability barrier before the
         state machine sees them — the same ordering ``propose`` gets."""
         events = self.clients.ingest_forwarded(msg)
@@ -265,7 +276,7 @@ class Node:
             )
             return
         if events:
-            self.inbox.put(("client_results", events))
+            self.inbox.put(("client_ingress", events))
 
     def client(self, client_id: int) -> Client:
         return Client(

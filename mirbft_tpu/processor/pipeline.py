@@ -7,8 +7,23 @@ in-flight budget, so while batch k's WAL fsync is on disk, batch k+1's
 crypto wave is on-device and batch k+2's sends are draining into the
 per-peer queues — instead of the strictly sequential one-batch-per-category
 round trip.  At ``depth == 1`` everywhere with the synchronous WAL handler
-and the unsplit hash handler this IS the classic coordinator (the default
-``Node`` mode); ``PipelineConfig()`` enables the pipelined mode.
+and the unsplit hash handler this IS the classic coordinator;
+``PipelineConfig()`` (the default ``Node`` mode) enables the pipelined
+mode.
+
+This module is also the **one scheduler contract** shared by all three
+engines: ``StageGraph`` (stages + bounded depths + ``BARRIER_EDGES``) plus
+``DepthAutotuner`` (stall-driven depth control) carry no threads of their
+own, so the same stage model drives three implementations:
+
+* the threaded ``PipelineScheduler`` below (the ``Node`` runtime client);
+* ``testengine/sched.SimStagePipeline`` — the ``EventQueue``/``Recording``
+  driver, which prefetches simulated hash work into device waves under the
+  hash stage's budget without touching the simulated schedule;
+* ``testengine/sched.FastStageDriver`` — the fastengine adapter, which
+  surfaces the native engine's step loop as scheduler stages (the engine
+  slice is the pinned ``result`` stage; host crypto waves ride the hash
+  stage's rolling window).
 
 The two reference ordering barriers survive as **explicit stage edges**,
 not global serialization (serial.py module docstring):
@@ -82,6 +97,21 @@ _PIPELINED_DEPTH: Dict[str, int] = {
     "result": 1,
 }
 
+# The two reference ordering barriers as data — (upstream, downstream)
+# stage pairs whose hand-off must stay strictly batch-ordered regardless
+# of stage depth.  Every scheduler implementation shares this tuple; the
+# autotuner never relaxes a barrier because barriers are ordering
+# constraints enforced by the release paths, not depths.
+BARRIER_EDGES: Tuple[Tuple[str, str], ...] = (
+    ("wal", "net"),  # WAL-before-send (fsync ticket release order)
+    ("req_store", "result"),  # reqstore-sync-before-ack
+)
+
+# Ceiling for autotuned stage depths.  Past ~16 the admission window, not
+# stage depth, is the binding constraint, and unbounded growth would just
+# hide a stage that is genuinely too slow.
+MAX_STAGE_DEPTH = 16
+
 # Lock discipline (docs/STATIC_ANALYSIS.md): the admission set is touched
 # by proposer threads (admit), the result worker (complete) and the
 # coordinator (close) — always under the window's condition.
@@ -113,6 +143,11 @@ class PipelineConfig:
     # hasher exposing ``dispatch_batches``/``collect_batches``; degrades
     # to the one-call ``hash_batches`` handler otherwise).
     split_hash: bool = True
+    # Stall-driven depth autotuning (``DepthAutotuner``): the configured
+    # depths become starting points; the deepest-stalling stage grows and
+    # idle stages shrink, bounded by ``max_depth``.
+    autotune: bool = True
+    max_depth: int = MAX_STAGE_DEPTH
 
     @classmethod
     def classic(cls) -> "PipelineConfig":
@@ -121,6 +156,7 @@ class PipelineConfig:
             admission_window=None,
             async_wal=False,
             split_hash=False,
+            autotune=False,
         )
 
     def depth_of(self, tag: str) -> int:
@@ -129,6 +165,208 @@ class PipelineConfig:
             # snapshots require no batch in flight.
             return 1
         return max(1, int(self.depth.get(tag, 1)))
+
+    def graph_limit(self) -> int:
+        """Depth ceiling for the StageGraph: ``max_depth`` when the
+        autotuner may grow stages, otherwise the configured maximum (so
+        classic mode keeps exact depth-1 queues)."""
+        if self.autotune:
+            return max(1, int(self.max_depth))
+        return max(self.depth_of(tag) for _, tag in STAGES)
+
+
+class StageGraph:
+    """The shared scheduler state: per-stage depth budgets, in-flight
+    occupancy, and stall accounting.  Thread-free and clock-injectable —
+    the threaded ``PipelineScheduler`` and both simulation-engine drivers
+    (``testengine/sched.py``) run the same graph.
+
+    Invariant every client preserves: a stage's in-flight count only moves
+    through ``try_acquire``/``release``, so occupancy never exceeds the
+    current depth and depth never exceeds ``limit``.  Queue capacities are
+    sized at ``limit`` so the autotuner can grow a depth without resizing
+    queues.
+    """
+
+    def __init__(
+        self,
+        depth: Dict[str, int],
+        limit: int = MAX_STAGE_DEPTH,
+        pinned: Tuple[str, ...] = ("result",),
+    ):
+        self.stages: Tuple[str, ...] = tuple(tag for _, tag in STAGES)
+        self.edges = BARRIER_EDGES
+        self.pinned = frozenset(pinned)
+        self.limit = max(1, int(limit))
+        self._depth = {
+            tag: min(max(1, int(depth.get(tag, 1))), self.limit)
+            for tag in self.stages
+        }
+        self._inflight = {tag: 0 for tag in self.stages}
+        self._stall_total = {tag: 0.0 for tag in self.stages}
+        # tag -> perf_counter() when the stage first had ready work it
+        # could not take (depth exhausted); cleared on dispatch.
+        self._stalled_since: Dict[str, float] = {}
+        self._depth_gauges = {
+            tag: metrics.gauge("pipeline_depth", labels={"stage": tag})
+            for tag in self.stages
+        }
+        self._limit_gauges = {
+            tag: metrics.gauge("pipeline_depth_limit", labels={"stage": tag})
+            for tag in self.stages
+        }
+        for tag in self.stages:
+            self._limit_gauges[tag].set(self._depth[tag])
+        self._stall_counters = {
+            tag: metrics.counter(
+                "pipeline_stall_seconds", labels={"stage": tag}
+            )
+            for tag in self.stages
+        }
+
+    def depth_of(self, tag: str) -> int:
+        return self._depth[tag]
+
+    def occupancy(self, tag: str) -> int:
+        return self._inflight[tag]
+
+    def try_acquire(self, tag: str, now: Optional[float] = None) -> bool:
+        """Take one in-flight slot on ``tag``; on refusal the stage is
+        marked stalling (ready work, depth exhausted) until the next
+        successful acquire or explicit ``clear_stall``."""
+        if self._inflight[tag] >= self._depth[tag]:
+            self.note_stalled(tag, now)
+            return False
+        self._inflight[tag] += 1
+        self._depth_gauges[tag].set(self._inflight[tag])
+        self.clear_stall(tag, now)
+        return True
+
+    def release(self, tag: str) -> None:
+        self._inflight[tag] -= 1
+        self._depth_gauges[tag].set(self._inflight[tag])
+
+    def note_stalled(self, tag: str, now: Optional[float] = None) -> None:
+        if tag not in self._stalled_since:
+            self._stalled_since[tag] = (
+                time.perf_counter() if now is None else now
+            )
+
+    def clear_stall(self, tag: str, now: Optional[float] = None) -> None:
+        started = self._stalled_since.pop(tag, None)
+        if started is not None:
+            if now is None:
+                now = time.perf_counter()
+            waited = max(0.0, now - started)
+            self._stall_total[tag] += waited
+            self._stall_counters[tag].inc(waited)
+
+    def stall_seconds(self, tag: str, now: Optional[float] = None) -> float:
+        """Cumulative stall time for ``tag``, including any ongoing stall
+        (the autotuner reads this; an ongoing stall must count or a stage
+        that never un-stalls would never be grown)."""
+        total = self._stall_total[tag]
+        started = self._stalled_since.get(tag)
+        if started is not None:
+            if now is None:
+                now = time.perf_counter()
+            total += max(0.0, now - started)
+        return total
+
+    def set_depth(self, tag: str, value: int) -> int:
+        """Adjust a stage's depth budget, clamped to [1, limit]; pinned
+        stages (the serial state machine) are refused.  Returns the depth
+        actually in effect."""
+        if tag in self.pinned:
+            return self._depth[tag]
+        new = min(max(1, int(value)), self.limit)
+        self._depth[tag] = new
+        self._limit_gauges[tag].set(new)
+        return new
+
+
+class DepthAutotuner:
+    """Stall-driven depth control with WaveController-style hysteresis
+    (testengine/crypto.py): each ``observe`` reads per-stage stall deltas
+    since the previous observation, grows the deepest-stalling stage (×2,
+    up to ``graph.limit``) once its delta crosses ``grow_threshold_s``,
+    shrinks a stage (÷2) only after ``idle_rounds`` consecutive quiet
+    observations, and sleeps ``cooldown_rounds`` after any adjustment so a
+    single burst cannot thrash the depths.  Pinned stages are never
+    touched, and barriers are unaffected by construction: ``set_depth``
+    changes budgets only — the WAL release thread and req_store handler
+    keep their strict orderings at any depth."""
+
+    def __init__(
+        self,
+        graph: StageGraph,
+        grow_threshold_s: float = 0.002,
+        idle_rounds: int = 4,
+        cooldown_rounds: int = 2,
+    ):
+        self.graph = graph
+        self.grow_threshold_s = grow_threshold_s
+        self.idle_rounds = idle_rounds
+        self.cooldown_rounds = cooldown_rounds
+        self._last = {tag: 0.0 for tag in graph.stages}
+        self._idle = {tag: 0 for tag in graph.stages}
+        self._cooldown = 0
+        self._adjust = {
+            (tag, direction): metrics.counter(
+                "pipeline_autotune_adjustments_total",
+                labels={"stage": tag, "direction": direction},
+            )
+            for tag in graph.stages
+            for direction in ("grow", "shrink")
+        }
+
+    def observe(
+        self, now: Optional[float] = None
+    ) -> Optional[Tuple[str, int, int]]:
+        """One control step (call on the tick cadence).  Returns the
+        adjustment made as ``(stage, old_depth, new_depth)``, or None."""
+        graph = self.graph
+        deltas: Dict[str, float] = {}
+        for tag in graph.stages:
+            total = graph.stall_seconds(tag, now)
+            deltas[tag] = total - self._last[tag]
+            self._last[tag] = total
+            # Idle bookkeeping runs every observation, cooldown or not —
+            # hysteresis counts real quiet time, not control-enabled time.
+            if deltas[tag] <= 0.0 and graph.occupancy(tag) == 0:
+                self._idle[tag] += 1
+            else:
+                self._idle[tag] = 0
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        grow = [
+            tag
+            for tag in graph.stages
+            if tag not in graph.pinned
+            and deltas[tag] >= self.grow_threshold_s
+            and graph.depth_of(tag) < graph.limit
+        ]
+        if grow:
+            tag = max(grow, key=lambda t: deltas[t])
+            old = graph.depth_of(tag)
+            new = graph.set_depth(tag, old * 2)
+            if new != old:
+                self._adjust[(tag, "grow")].inc()
+                self._cooldown = self.cooldown_rounds
+                self._idle[tag] = 0
+                return (tag, old, new)
+        for tag in graph.stages:
+            if tag in graph.pinned or graph.depth_of(tag) <= 1:
+                continue
+            if self._idle[tag] >= self.idle_rounds:
+                old = graph.depth_of(tag)
+                new = graph.set_depth(tag, old // 2)
+                self._adjust[(tag, "shrink")].inc()
+                self._cooldown = self.cooldown_rounds
+                self._idle[tag] = 0
+                return (tag, old, new)
+        return None
 
 
 class AdmissionWindow:
@@ -231,24 +469,19 @@ class PipelineScheduler:
         self.threads: List[threading.Thread] = []
         self._name = f"node{node_id}"
         self._handlers = dict(handlers)
-        self._depth = {tag: self.config.depth_of(tag) for _, tag in STAGES}
-        self._inflight = {tag: 0 for _, tag in STAGES}
+        self.graph = StageGraph(
+            depth={tag: self.config.depth_of(tag) for _, tag in STAGES},
+            limit=self.config.graph_limit(),
+        )
+        self.autotuner: Optional[DepthAutotuner] = (
+            DepthAutotuner(self.graph) if self.config.autotune else None
+        )
+        # Queues are sized at the graph limit, not the starting depth, so
+        # the autotuner can widen a stage without resizing; dispatch depth
+        # is governed solely by graph.try_acquire.
         self._queues: Dict[str, "queue.Queue"] = {
-            tag: queue.Queue(maxsize=self._depth[tag]) for _, tag in STAGES
+            tag: queue.Queue(maxsize=self.graph.limit) for _, tag in STAGES
         }
-        self._depth_gauges = {
-            tag: metrics.gauge("pipeline_depth", labels={"stage": tag})
-            for _, tag in STAGES
-        }
-        self._stall_counters = {
-            tag: metrics.counter(
-                "pipeline_stall_seconds", labels={"stage": tag}
-            )
-            for _, tag in STAGES
-        }
-        # tag -> perf_counter() when the stage first had ready work it
-        # could not take (depth exhausted); cleared on dispatch.
-        self._stalled_since: Dict[str, float] = {}
 
         self.admission: Optional[AdmissionWindow] = None
         if self.config.admission_window:
@@ -267,7 +500,7 @@ class PipelineScheduler:
         )
         self._wal_release_q: Optional["queue.Queue"] = None
         if self.wal_async:
-            self._wal_release_q = queue.Queue(maxsize=self._depth["wal"])
+            self._wal_release_q = queue.Queue(maxsize=self.graph.limit)
             self._handlers["wal"] = self._wal_stage
         self.hash_split = bool(
             self.config.split_hash
@@ -277,7 +510,7 @@ class PipelineScheduler:
         )
         self._hash_collect_q: Optional["queue.Queue"] = None
         if self.hash_split:
-            self._hash_collect_q = queue.Queue(maxsize=self._depth["hash"])
+            self._hash_collect_q = queue.Queue(maxsize=self.graph.limit)
             self._handlers["hash"] = self._hash_stage
 
     # -- lifecycle ----------------------------------------------------------
@@ -415,19 +648,11 @@ class PipelineScheduler:
             batch = getattr(work, attr)
             if len(batch) == 0:
                 continue
-            if self._inflight[tag] < self._depth[tag]:
-                self._inflight[tag] += 1
-                self._depth_gauges[tag].set(self._inflight[tag])
+            if self.graph.try_acquire(tag):
                 setattr(work, attr, type(batch)())
-                # Never blocks: queued batches <= in-flight <= depth.
+                # Never blocks: queued batches <= in-flight <= depth <=
+                # graph.limit == queue capacity.
                 self._queues[tag].put(batch)
-                started = self._stalled_since.pop(tag, None)
-                if started is not None:
-                    self._stall_counters[tag].inc(
-                        time.perf_counter() - started
-                    )
-            else:
-                self._stalled_since.setdefault(tag, time.perf_counter())
 
     def run(self) -> None:
         work = self.work_items
@@ -449,7 +674,7 @@ class PipelineScheduler:
                 # off-thread.
                 if (
                     (waiting_status or health_due)
-                    and self._inflight["result"] == 0
+                    and self.graph.occupancy("result") == 0
                 ):
                     snap = self.snapshot_fn()
                     for reply in waiting_status:
@@ -466,15 +691,24 @@ class PipelineScheduler:
                 if tag == "tick":
                     work.result_events.tick_elapsed()
                     health_due = True
+                    if self.autotuner is not None:
+                        self.autotuner.observe()
                 elif tag == "status":
                     waiting_status.append(payload)
                 elif tag == "step_events":
                     work.result_events.concat(payload)
+                elif tag == "client_ingress":
+                    # Client events injected from outside the pipeline
+                    # (propose threads, forwarded-request ingress): same
+                    # durability routing as client stage results, but no
+                    # stage slot was acquired so none is released —
+                    # occupancy would go negative and blind the
+                    # autotuner's idle detection.
+                    work.add_client_results(payload)
                 elif tag in add_result:
                     base = tag[: -len("_results")]
                     add_result[tag](payload)
-                    self._inflight[base] -= 1
-                    self._depth_gauges[base].set(self._inflight[base])
+                    self.graph.release(base)
                 else:
                     raise AssertionError(f"unknown inbox tag {tag}")
         except BaseException as e:

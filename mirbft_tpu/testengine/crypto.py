@@ -253,6 +253,22 @@ class DeviceHashPlane:
         if join_time:
             metrics.counter("host_crypto_seconds").inc(join_time)
 
+    def pending_count(self) -> int:
+        """Scheduled-but-unlaunched batches in the current wave."""
+        return len(self._pending)
+
+    def launch_partial(self) -> bool:
+        """Launch the pending wave even below ``wave_size`` — the scheduler
+        drivers' lull fill (testengine/sched.py): when the event queue
+        shows a strictly-future next event, the coming simulated wait is
+        host time the device can use.  The WaveController observes a
+        partial launch like any other, so habitual lulls shrink the wave
+        size toward what actually launches."""
+        if not self.device or not self._pending:
+            return False
+        self._launch_wave()
+        return True
+
     def _launch_wave(self) -> None:
         """One async kernel dispatch per block-bucket over the pending set.
         Block buckets are quantized (min 4, powers of two) and the batch

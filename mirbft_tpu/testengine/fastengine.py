@@ -222,6 +222,7 @@ class FastRecording:
         streaming_auth: bool = False,
         pdes_partitions: int = 0,
         pdes_threaded: bool = False,
+        pipeline=None,
     ):
         """``device_authoritative``: the TPU is the producer of every
         wave-eligible protocol digest — the engine pauses (wall-clock only;
@@ -249,7 +250,15 @@ class FastRecording:
         Still outside: consume-time manglers, device modes,
         reconfiguration.  Rejections raise ``PdesEnvelopeUnsupported``
         with a machine-readable ``reason`` code; ``pdes_check()`` probes
-        eligibility without running."""
+        eligibility without running.
+
+        ``pipeline``: True (PipelineConfig defaults) or an explicit
+        ``processor.pipeline.PipelineConfig`` attaches a ``FastStageDriver``
+        — the native engine's step loop surfaced as scheduler stages, with
+        the device hash mirror collected through a rolling bounded-depth
+        wave window instead of one trailing collect-all.  Defaults to
+        ``spec.pipeline``.  Schedule-preserving: steps, fake-time and node
+        summaries are bit-identical with or without it."""
         _require(_native.load_fast() is not None, "native engine unavailable")
         _require(1 <= spec.node_count <= 256, ">256 nodes")
         if device_authoritative or streaming_auth:
@@ -306,6 +315,20 @@ class FastRecording:
         # Optional sim-domain tracer (attach_sim_tracer): progress counters
         # stamped with the engine's virtual fake_time, not wall time.
         self.sim_tracer: Optional[tracing.Tracer] = None
+
+        effective_pipeline = (
+            pipeline if pipeline is not None else getattr(spec, "pipeline", None)
+        )
+        self.scheduler = None
+        if effective_pipeline:
+            from ..processor.pipeline import PipelineConfig
+            from .sched import FastStageDriver
+
+            self.scheduler = FastStageDriver(
+                PipelineConfig()
+                if effective_pipeline is True
+                else effective_pipeline
+            )
 
         client_states = [(c.id, c.width) for c in recorder.network_state.clients]
 
@@ -518,6 +541,15 @@ class FastRecording:
             self._pending_digests.append(digest)
         while len(self._pending_msgs) >= self.hash_wave:
             self._launch_waves()
+        if self.scheduler is not None:
+            # Rolling window (FastStageDriver): at most depth_of("hash")
+            # waves stay un-collected — the oldest wave collects (and
+            # digest-verifies) as the window slides, so verification is
+            # incremental and a device running behind shows up as the hash
+            # stage's stall instead of one giant trailing collect.
+            while self.scheduler.hash_window_over(len(self._inflight)):
+                self._collect_oldest_wave()
+                self.scheduler.wave_collected()
         metrics.gauge("hash_wave_queue_depth").set(len(self._pending_msgs))
 
     def _dispatch_hash_chunks(self, by_bucket):
@@ -556,26 +588,35 @@ class FastRecording:
             self._inflight.append((handle, [d for _, d in chunk], dispatch_ts))
         metrics.gauge("hash_waves_in_flight").set(len(self._inflight))
 
+    def _collect_oldest_wave(self) -> None:
+        """Collect (and digest-verify) the oldest in-flight wave — FIFO, so
+        the rolling window and the collect-all drain see identical
+        digest-comparison order."""
+        handle, expected, dispatch_ts = self._inflight.pop(0)
+        digests = self._hasher.collect(handle)
+        for device_digest, engine_digest in zip(digests, expected):
+            if bytes(device_digest) != engine_digest:
+                raise AssertionError(
+                    "device digest diverged from engine digest"
+                )
+        tracer = tracing.default_tracer
+        if tracer.enabled and dispatch_ts:
+            tracer.complete(
+                "hash_wave",
+                dispatch_ts,
+                pid=0,
+                tid=1,
+                args={"messages": len(expected)},
+            )
+        metrics.gauge("hash_waves_in_flight").set(len(self._inflight))
+
     def _collect_inflight(self) -> None:
         if self._pending_msgs:
             self._launch_waves()
-        tracer = tracing.default_tracer
-        for handle, expected, dispatch_ts in self._inflight:
-            digests = self._hasher.collect(handle)
-            for device_digest, engine_digest in zip(digests, expected):
-                if bytes(device_digest) != engine_digest:
-                    raise AssertionError(
-                        "device digest diverged from engine digest"
-                    )
-            if tracer.enabled and dispatch_ts:
-                tracer.complete(
-                    "hash_wave",
-                    dispatch_ts,
-                    pid=0,
-                    tid=1,
-                    args={"messages": len(expected)},
-                )
-        self._inflight = []
+        while self._inflight:
+            self._collect_oldest_wave()
+        if self.scheduler is not None:
+            self.scheduler.hash_window_reset()
         metrics.gauge("hash_waves_in_flight").set(0)
 
     # -- drive -------------------------------------------------------------
@@ -588,6 +629,10 @@ class FastRecording:
         import time as _time
 
         stall_start = _time.perf_counter()
+        if self.scheduler is not None:
+            # An engine pause on device results is the hash stage running
+            # behind — the same grow signal as a blocked mirror collect.
+            self.scheduler.device_stall_begin()
         contents, verdict_needs = self._engine.pending_device_work()
         if contents:
             from .crypto import block_bucket_of
@@ -631,6 +676,8 @@ class FastRecording:
             self._engine.supply_digests(supplied)
         if verdict_needs:
             self._serve_verdict_waves(verdict_needs)
+        if self.scheduler is not None:
+            self.scheduler.device_stall_end()
         self.device_stall_s += _time.perf_counter() - stall_start
 
     _AUTH_LOOKAHEAD = 32
@@ -734,12 +781,17 @@ class FastRecording:
         drain (bench config 5)."""
         executed = 0
         while executed < max_steps:
+            if self.scheduler is not None:
+                self.scheduler.slice_begin()
             try:
                 ran, done, timed_out, need_device = self._engine.run(
                     max_steps - executed, timeout
                 )
             except RuntimeError as exc:
                 raise FastEngineUnsupported(str(exc)) from exc
+            finally:
+                if self.scheduler is not None:
+                    self.scheduler.slice_end()
             executed += ran
             self._drain_hash_log()
             self._trace_slice()
@@ -847,12 +899,19 @@ class FastRecording:
             return self.drain_clients_pdes(timeout)
         done = False
         while not done:
+            if self.scheduler is not None:
+                # The engine slice is the pinned serial "result" stage; a
+                # slice boundary is also the autotune observation point.
+                self.scheduler.slice_begin()
             try:
                 _, done, timed_out, need_device = self._engine.run(
                     slice_steps, timeout
                 )
             except RuntimeError as exc:
                 raise FastEngineUnsupported(str(exc)) from exc
+            finally:
+                if self.scheduler is not None:
+                    self.scheduler.slice_end()
             self._drain_hash_log()
             self._trace_slice()
             if timed_out:

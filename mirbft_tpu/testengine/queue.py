@@ -79,6 +79,16 @@ class EventQueue:
         heapq.heappush(self._heap, (event.time, self._counter, event))
         self._counter += 1
 
+    def peek_time(self) -> Optional[int]:
+        """Fake-time of the earliest pending event, without consuming it.
+        Advisory only (a mangler may replace the head at consumption): the
+        scheduler drivers use the gap to the next event as lull detection —
+        simulated wait the host can spend launching partial device waves
+        (testengine/sched.py)."""
+        if not self._heap:
+            return None
+        return self._heap[0][0]
+
     def consume(self) -> SimEvent:
         """Pop the next event, applying the mangler on first touch
         (reference eventqueue.go:74-99)."""

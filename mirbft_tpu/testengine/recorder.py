@@ -497,6 +497,12 @@ class Recorder:
         # DivergenceDetector fingerprinting checkpoint values each interval
         # (docs/OBSERVABILITY.md "Health plane").
         self.health: Optional[HealthConfig] = None
+        # Optional pipelined host scheduling (set before recording(), same
+        # pattern): a processor.pipeline.PipelineConfig attaches a
+        # SimStagePipeline — bounded stall-metered crypto prefetch with
+        # autotuned depths.  The simulated schedule is bit-identical with
+        # or without it (the driver only touches the hash plane).
+        self.pipeline = None
 
     def recording(self) -> "Recording":
         event_queue = EventQueue(seed=self.random_seed, mangler=self.mangler)
@@ -602,6 +608,12 @@ class Recorder:
         recording = Recording(
             event_queue, nodes, clients, hash_plane=hash_plane, auth_plane=auth_plane
         )
+        if self.pipeline is not None:
+            from .sched import SimStagePipeline
+
+            recording.scheduler = SimStagePipeline(
+                hash_plane, event_queue, config=self.pipeline
+            )
         if self.tracer is not None:
             tracer = self.tracer
             tracer.clock = lambda: float(event_queue.fake_time)
@@ -678,6 +690,10 @@ class Recording:
         self.health_monitors: Dict[int, HealthMonitor] = {}
         self.divergence: Optional[DivergenceDetector] = None
         self._next_divergence_check = 0
+        # Pipelined host scheduling (wired by Recorder.recording() when
+        # Recorder.pipeline is set): the shared stage-graph driver for
+        # crypto prefetch — see testengine/sched.SimStagePipeline.
+        self.scheduler = None
 
     def _schedule_proposal(
         self, node_id: int, client_id: int, req_no: int, data: bytes, delay: int
@@ -705,6 +721,10 @@ class Recording:
         if event.initialize is not None:
             # Restart: clear any outstanding events for this node first.
             queue.remove_events_for(node.id)
+            if self.scheduler is not None:
+                # Dropped events include any scheduled hash batches whose
+                # prefetch slots must be returned.
+                self.scheduler.on_node_reset(node.id)
             node.initialize(event.initialize)
             queue.insert_tick(node.id, parms.tick_interval)
             # Schedule proposals for every configured client, not just those
@@ -823,6 +843,10 @@ class Recording:
         elif event.tick:
             node.work_items.result_events.tick_elapsed()
             queue.insert_tick(node.id, parms.tick_interval)
+            if self.scheduler is not None and event.target == 0:
+                # One autotune observation per tick round (node 0's tick),
+                # matching the Node runtime's tick-driven cadence.
+                self.scheduler.on_tick()
             if self.health_monitors:
                 monitor = self.health_monitors.get(node.id)
                 if monitor is not None and node.state_machine is not None:
@@ -896,6 +920,8 @@ class Recording:
                 # The device dispatch for this batch is still in flight:
                 # model the extra device latency in simulated time instead
                 # of stalling the host loop on a blocking collect.
+                if self.scheduler is not None:
+                    self.scheduler.on_hash_deferred()
                 queue.insert_process(
                     node.id,
                     "process_hash_actions",
@@ -903,9 +929,14 @@ class Recording:
                     parms.process_hash_latency,
                 )
                 return  # pending["hash"] stays set; nothing new to schedule
+            sched = self.scheduler
+            if sched is not None:
+                sched.before_hash_fire(event.process_hash_actions)
             node.work_items.add_hash_results(
                 proc.process_hash_actions(node.hasher, event.process_hash_actions)
             )
+            if sched is not None:
+                sched.after_hash_fire(event.process_hash_actions)
             node.pending["hash"] = False
         elif event.process_client_actions is not None:
             node.work_items.add_client_results(
@@ -941,9 +972,15 @@ class Recording:
                 queue.insert_process(node.id, event_field, batch, latency)
                 setattr(work, attr, empty())
                 if key == "hash" and self.hash_plane is not None:
-                    # Start the device working on this batch (async) while
-                    # the simulated hash latency elapses.
-                    self.hash_plane.enqueue([a.data for a in batch])
+                    if self.scheduler is not None:
+                        # One scheduler: the prefetch rides the shared hash
+                        # stage's depth budget (refusals are stall-metered;
+                        # the simulated schedule is untouched either way).
+                        self.scheduler.on_hash_scheduled(node.id, batch)
+                    else:
+                        # Start the device working on this batch (async)
+                        # while the simulated hash latency elapses.
+                        self.hash_plane.enqueue([a.data for a in batch])
 
     def health_report(self) -> dict:
         """Aggregate health report: per-node monitor reports plus the
@@ -1048,6 +1085,10 @@ class Spec:
     clients_ignore: Tuple[int, ...] = ()
     signed_requests: bool = False
     crypto: Optional[CryptoConfig] = None  # None -> host paths (CryptoConfig())
+    # Pipelined host scheduling: True -> PipelineConfig() defaults, or an
+    # explicit processor.pipeline.PipelineConfig.  Schedule-preserving —
+    # step counts and commit streams are bit-identical either way.
+    pipeline: object = None
     tweak_recorder: Optional[Callable[[Recorder], None]] = None
 
     def recorder(self) -> Recorder:
@@ -1088,6 +1129,13 @@ class Spec:
             client_configs=client_configs,
             crypto=self.crypto,
         )
+        if self.pipeline:
+            if self.pipeline is True:
+                from ..processor.pipeline import PipelineConfig
+
+                recorder.pipeline = PipelineConfig()
+            else:
+                recorder.pipeline = self.pipeline
         if self.tweak_recorder is not None:
             self.tweak_recorder(recorder)
         return recorder

@@ -6,7 +6,7 @@ and this repo maintains *two* engines (the Python testengine and the C++
 ``_native/fastengine.cpp`` twin) that must stay in lockstep.  Nothing about
 either property is enforced by the type system; historically divergences
 were found at runtime by fault choreography.  mirlint enforces the cheap
-four-fifths statically, in four passes:
+four-fifths statically, in five passes:
 
 ``determinism``
     AST lint over ``statemachine/``, ``processor/`` and ``testengine/``
@@ -46,6 +46,15 @@ four-fifths statically, in four passes:
     every registered class must round-trip ``decode(encode(x)) == x`` and
     render every field through ``tools/textmarshal.py``.
 
+``sched``
+    Scheduler-path lint over ``processor/``, ``testengine/`` and
+    ``node.py``: flags fixed-interval ``time.sleep(<constant>)`` calls
+    inside loops (``sleep-poll``).  The one-scheduler contract is
+    event-driven — condition waits, queue gets with timeouts, simulated
+    events — and a constant-interval polling loop reintroduces exactly
+    the latency floor the pipelined schedule removed.  Computed backoffs
+    escape; genuinely-needed fixed sleeps take the pragma.
+
 False positives are silenced with a pragma comment on the flagged line or
 the line above::
 
@@ -67,7 +76,7 @@ import sys
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-PASSES = ("determinism", "parity", "locks", "wire")
+PASSES = ("determinism", "parity", "locks", "wire", "sched")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -958,10 +967,13 @@ REQUIRED_METRIC_NAMES = (
     "wal_group_commit_size",
     "store_gc_reclaimed_bytes_total",
     "snapshot_transfer_bytes_total",
-    # Pipeline scheduler (processor/pipeline.py, docs/PERFORMANCE.md §14).
+    # Pipeline scheduler (processor/pipeline.py, docs/PERFORMANCE.md §14)
+    # and the shared stage graph + depth autotuner (§15).
     "pipeline_depth",
     "pipeline_stall_seconds",
     "admission_window_size",
+    "pipeline_depth_limit",
+    "pipeline_autotune_adjustments_total",
 )
 
 
@@ -1490,6 +1502,76 @@ def wire_pass(root: Path) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# Pass 5: scheduler paths
+
+
+class _SleepPollVisitor(ast.NodeVisitor):
+    """Flags ``time.sleep(<numeric constant>)`` inside a loop body.
+
+    Only constant intervals are flagged: a computed argument (adaptive
+    backoff, a deadline remainder) is already event-shaped.  Condition
+    waits and queue gets with timeouts never match — they wake early on
+    the event, which is the whole point."""
+
+    def __init__(self, path: str, imports: _ImportMap, pragmas: Pragmas):
+        self.path = path
+        self.imports = imports
+        self.pragmas = pragmas
+        self.findings: List[Finding] = []
+        self._loop_depth = 0
+
+    def _flag(self, node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if not self.pragmas.allows(line, rule):
+            self.findings.append(Finding(self.path, line, rule, message))
+
+    def _visit_loop(self, node: ast.AST) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_While = _visit_loop
+    visit_For = _visit_loop
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (
+            self._loop_depth > 0
+            and self.imports.resolve(node.func) == "time.sleep"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, (int, float))
+        ):
+            self._flag(
+                node,
+                "sleep-poll",
+                "fixed-interval time.sleep in a scheduler-path loop polls "
+                "at a latency floor; wait on the event instead (condition "
+                "wait, queue get with timeout, or a scheduled sim event)",
+            )
+        self.generic_visit(node)
+
+
+def sched_pass(
+    root: Path, files: Optional[Sequence[Path]] = None
+) -> List[Finding]:
+    """Rule ids: sleep-poll."""
+    if files is None:
+        files = []
+        for sub in ("processor", "testengine"):
+            files.extend(sorted((root / "mirbft_tpu" / sub).rglob("*.py")))
+        files.append(root / "mirbft_tpu" / "node.py")
+    findings: List[Finding] = []
+    for path in files:
+        text, tree, pragmas = _parse(path)
+        visitor = _SleepPollVisitor(
+            _rel(path, root), _ImportMap(tree), pragmas
+        )
+        visitor.visit(tree)
+        findings.extend(visitor.findings)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Driver
 
 
@@ -1511,6 +1593,8 @@ def lint(
         findings += locks_pass(root)
     if "wire" in selected:
         findings += wire_pass(root)
+    if "sched" in selected:
+        findings += sched_pass(root)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 

@@ -120,11 +120,13 @@ def _write_cluster(
     node_config: Optional[dict] = None,
     byzantine: Optional[dict] = None,
     unreachable_after_s: float = 5.0,
-    pipeline: bool = False,
+    pipeline: bool = True,
 ) -> None:
     """``cluster.json``: everything a child needs to boot.  The fault
     plane keys are optional — plain deployments (``run_deployment``) leave
-    them at their inert defaults."""
+    them at their inert defaults.  The pipelined schedule is the default;
+    ``pipeline=False`` (the ``--classic`` flag) selects the reference
+    coordinator, and the active schedule is recorded under ``schedule``."""
     _write_json_atomic(
         _cluster_path(root),
         {
@@ -141,6 +143,7 @@ def _write_cluster(
             },
             "unreachable_after_s": unreachable_after_s,
             "pipeline": pipeline,
+            "schedule": "pipelined" if pipeline else "classic",
         },
     )
 
@@ -640,7 +643,7 @@ def run_deployment(
     kill_restart: bool = False,
     timeout_s: float = 90.0,
     client_id: int = 0,
-    pipeline: bool = False,
+    pipeline: bool = True,
 ) -> dict:
     """Run a real multi-process deployment and return a result summary:
     ``{"commits": {node: n}, "agreement_problems": [...], "reconnects":
@@ -837,7 +840,7 @@ class _Cluster:
         thresholds: Optional[dict] = None,
         initial_plans: Optional[dict] = None,
         timeout_s: float = 60.0,
-        pipeline: bool = False,
+        pipeline: bool = True,
     ):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
@@ -1129,7 +1132,7 @@ def _verdict(root: Path, name: str, data: dict, failures: List[str]) -> dict:
     return doc
 
 
-def _scenario_control(root: Path, seed: int, *, pipeline: bool = False) -> dict:
+def _scenario_control(root: Path, seed: int, *, pipeline: bool = True) -> dict:
     """Zero-rate control: the injector is wired on every link with all
     rates zero — the run must be indistinguishable from no injector at
     all.  Doctor healthy, zero anomalies, zero peer faults, zero injected
@@ -1169,7 +1172,7 @@ def _scenario_control(root: Path, seed: int, *, pipeline: bool = False) -> dict:
     return _verdict(root, "control", res, failures)
 
 
-def _scenario_partition_minority(root: Path, seed: int, *, pipeline: bool = False) -> dict:
+def _scenario_partition_minority(root: Path, seed: int, *, pipeline: bool = True) -> dict:
     """Partition a minority node, wait until every survivor attributes
     ``peer_unreachable`` to it, heal, and require the full cluster (the
     healed node included) to commit fresh traffic.  View changes stay
@@ -1249,7 +1252,7 @@ def _scenario_partition_minority(root: Path, seed: int, *, pipeline: bool = Fals
     return _verdict(root, "partition-minority", res, failures)
 
 
-def _scenario_partition_leader(root: Path, seed: int, *, pipeline: bool = False) -> dict:
+def _scenario_partition_leader(root: Path, seed: int, *, pipeline: bool = True) -> dict:
     """Partition the current primary (the genesis epoch activates as
     epoch 1, so the steady-state primary is node 1): the survivors must
     suspect it — attributing ``suspicion_vote`` to the *correct* node —
@@ -1330,7 +1333,7 @@ def _scenario_partition_leader(root: Path, seed: int, *, pipeline: bool = False)
     return _verdict(root, "partition-leader", res, failures)
 
 
-def _scenario_flap(root: Path, seed: int, *, pipeline: bool = False) -> dict:
+def _scenario_flap(root: Path, seed: int, *, pipeline: bool = True) -> dict:
     """Link flapping: three short partition/heal pulses against one node,
     each well below the unreachable threshold.  Reconnects happen, and
     dropped in-flight frames may force suspicion-based recovery (the
@@ -1397,7 +1400,7 @@ def _scenario_flap(root: Path, seed: int, *, pipeline: bool = False) -> dict:
     return _verdict(root, "flap", res, failures)
 
 
-def _scenario_lossy_wan(root: Path, seed: int, *, pipeline: bool = False) -> dict:
+def _scenario_lossy_wan(root: Path, seed: int, *, pipeline: bool = True) -> dict:
     """Every link degraded at once — latency, jitter, drops, duplicates,
     reorders, corruption, truncation — netem's lossy-WAN shape.  The
     protocol may ride through view changes (suspicion is legitimate
@@ -1468,7 +1471,7 @@ def _scenario_lossy_wan(root: Path, seed: int, *, pipeline: bool = False) -> dic
     return _verdict(root, "lossy-wan", res, failures)
 
 
-def _scenario_byzantine_leader(root: Path, seed: int, *, pipeline: bool = False) -> dict:
+def _scenario_byzantine_leader(root: Path, seed: int, *, pipeline: bool = True) -> dict:
     """The current primary actively lies (the genesis epoch activates as
     epoch 1, primary node 1): every epoch-1 Preprepare it sends is
     rewritten with a different protocol-invalid batch per destination
@@ -1540,7 +1543,7 @@ def _scenario_byzantine_leader(root: Path, seed: int, *, pipeline: bool = False)
     return _verdict(root, "byzantine-leader", res, failures)
 
 
-def _scenario_rolling_kill(root: Path, seed: int, *, pipeline: bool = False) -> dict:
+def _scenario_rolling_kill(root: Path, seed: int, *, pipeline: bool = True) -> dict:
     """Soak: SIGKILL each non-zero node in turn, wait for the survivors to
     attribute the outage, restart it from its durable stores, and keep
     committing.  Every victim must be attributed ``peer_unreachable``;
@@ -1605,7 +1608,7 @@ def _scenario_rolling_kill(root: Path, seed: int, *, pipeline: bool = False) -> 
     return _verdict(root, "rolling-kill", res, failures)
 
 
-def _scenario_kill_under_write(root: Path, seed: int, *, pipeline: bool = False) -> dict:
+def _scenario_kill_under_write(root: Path, seed: int, *, pipeline: bool = True) -> dict:
     """Crash-recovery drill for the storage engine: SIGKILL one node under
     sustained client write load, have the survivors commit far past what
     the victim's WAL can replay (multiple checkpoint intervals), restart
@@ -1744,7 +1747,7 @@ SCENARIOS = {
 
 
 def run_scenario(name: str, root_dir: Optional[str] = None,
-                 seed: int = 7, pipeline: bool = False) -> dict:
+                 seed: int = 7, pipeline: bool = True) -> dict:
     """Run one choreographed fault scenario; returns the verdict document
     (also written to ``<dir>/scenario.json``) or raises AssertionError
     listing every failed check.  ``pipeline=True`` runs every node on the
@@ -1779,8 +1782,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="fault-injection seed for --scenario")
     parser.add_argument("--pipeline", action="store_true",
                         help="run nodes on the staged pipeline scheduler "
-                             "(processor/pipeline.py) instead of the "
-                             "classic depth-1 schedule")
+                             "(processor/pipeline.py) — the default; kept "
+                             "as an explicit flag for scripts")
+    parser.add_argument("--classic", action="store_true",
+                        help="run nodes on the classic depth-1 reference "
+                             "schedule instead of the pipelined default")
     parser.add_argument("--list-scenarios", action="store_true")
     args = parser.parse_args(argv)
 
@@ -1788,6 +1794,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         for name in sorted(SCENARIOS):
             print(name)
         return 0
+
+    if args.pipeline and args.classic:
+        parser.error("--pipeline and --classic are mutually exclusive")
+    pipeline = not args.classic
 
     if args.node is not None:
         if args.dir is None:
@@ -1797,7 +1807,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.scenario is not None:
         try:
             doc = run_scenario(args.scenario, root_dir=args.dir,
-                               seed=args.seed, pipeline=args.pipeline)
+                               seed=args.seed, pipeline=pipeline)
         except AssertionError as err:
             print(str(err), file=sys.stderr)
             return 1
@@ -1810,7 +1820,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         reqs=args.reqs,
         kill_restart=args.kill_restart,
         timeout_s=args.timeout,
-        pipeline=args.pipeline,
+        pipeline=pipeline,
     )
     print(json.dumps(result, indent=2, sort_keys=True))
     print(

@@ -460,6 +460,71 @@ def test_wire_dynamic_roundtrip_on_real_registry():
 
 
 # ---------------------------------------------------------------------------
+# Pass 5: scheduler-path fixtures
+
+
+def _sched(tmp_path, body):
+    path = _write(tmp_path, "bad.py", body)
+    return mirlint.sched_pass(tmp_path, files=[path])
+
+
+def test_sleep_poll_fires_inside_loops_only(tmp_path):
+    findings = _sched(
+        tmp_path,
+        """\
+        import time
+
+        def boot():
+            time.sleep(0.1)  # one-shot settle, not a poll
+
+        def poll(done):
+            while not done():
+                time.sleep(0.05)
+
+        def scan(items, done):
+            for item in items:
+                time.sleep(1)
+        """,
+    )
+    assert _rules(findings) == [(8, "sleep-poll"), (12, "sleep-poll")]
+
+
+def test_sleep_poll_sees_through_from_import_and_alias(tmp_path):
+    findings = _sched(
+        tmp_path,
+        """\
+        from time import sleep as snooze
+
+        def poll(done):
+            while not done():
+                snooze(0.05)
+        """,
+    )
+    assert _rules(findings) == [(5, "sleep-poll")]
+
+
+def test_sleep_poll_exempts_computed_backoff_and_pragma(tmp_path):
+    findings = _sched(
+        tmp_path,
+        """\
+        import time
+
+        def backoff(done, delay):
+            while not done():
+                time.sleep(delay)
+                delay *= 2
+
+        def settle(done):
+            while not done():
+                # mirlint: allow(sleep-poll) — hardware settle interval,
+                # no event exists to wait on.
+                time.sleep(0.01)
+        """,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # The real tree is clean + CLI contract
 
 

@@ -72,6 +72,21 @@ def test_mirnet_scenario_control_zero_rates_clean(tmp_path, pipeline):
     assert (tmp_path / "scenario.json").exists()
     cluster = json.loads((tmp_path / "cluster.json").read_text())
     assert cluster["pipeline"] is pipeline
+    assert cluster["schedule"] == ("pipelined" if pipeline else "classic")
+
+
+def test_mirnet_scenario_control_default_is_pipelined(tmp_path):
+    """Satellite of the default flip: with no schedule argument at all, a
+    scenario runs pipelined, records it in cluster.json, and the doctor
+    stays clean."""
+    from mirbft_tpu.tools.mirnet import run_scenario
+
+    doc = run_scenario("control", root_dir=str(tmp_path))
+    assert doc["verdict"] == "pass"
+    assert doc["data"]["doctor"]["healthy"]
+    cluster = json.loads((tmp_path / "cluster.json").read_text())
+    assert cluster["pipeline"] is True
+    assert cluster["schedule"] == "pipelined"
 
 
 def test_mirnet_scenario_partition_heal_smoke(tmp_path):

@@ -1,0 +1,114 @@
+"""One-scheduler differential: the pipelined schedule is host-side only.
+
+The shared stage graph (docs/PERFORMANCE.md §15) drives crypto prefetch,
+wave collection and stall metering in BOTH simulation engines, but must
+never touch the simulated schedule: a pipelined run and a serial run of
+the same spec are bit-identical — same step counts, same final fake
+time, same per-node checkpoint/epoch/app-hash/committed-request state.
+Pinned here for the Python testengine (SimStagePipeline) and the native
+fast engine (FastStageDriver), on the c1 shape and a signed c2 shape,
+plus cross-engine agreement of the pipelined runs themselves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from mirbft_tpu import _native
+from mirbft_tpu.processor.pipeline import PipelineConfig
+from mirbft_tpu.testengine import CryptoConfig, Spec
+from mirbft_tpu.testengine.fastengine import FastRecording
+
+SPECS = [
+    Spec(node_count=4, client_count=4, reqs_per_client=20, batch_size=2),
+    Spec(
+        node_count=8,
+        client_count=8,
+        reqs_per_client=10,
+        batch_size=5,
+        signed_requests=True,
+    ),
+    # Host hash plane engaged (device=False keeps it off the accelerator):
+    # the SimStagePipeline prefetch/lull-fill path runs against real waves.
+    Spec(
+        node_count=4,
+        client_count=4,
+        reqs_per_client=30,
+        batch_size=5,
+        crypto=CryptoConfig(device=False, hash_wave=16, hash_floor=4),
+    ),
+]
+
+_IDS = ["c1-small", "c2-signed-small", "c1-hash-plane"]
+
+
+def _python_run(spec, pipeline):
+    rec = dataclasses.replace(spec, pipeline=pipeline).recorder().recording()
+    steps = rec.drain_clients(timeout=10_000_000)
+    state = [
+        (
+            n.state.checkpoint_seq_no,
+            n.state.checkpoint_hash,
+            n.state_machine.epoch_tracker.current_epoch.number,
+            n.state.last_seq_no,
+            n.state.active_hash.digest(),
+            dict(n.state.committed_reqs),
+        )
+        for n in rec.nodes
+    ]
+    return steps, rec.event_queue.fake_time, state
+
+
+def _fast_run(spec, pipeline):
+    fr = FastRecording(spec, pipeline=pipeline)
+    steps = fr.drain_clients(timeout=10_000_000)
+    state = [
+        (
+            n.checkpoint_seq_no,
+            n.checkpoint_hash,
+            n.epoch,
+            n.last_seq_no,
+            n.active_hash_digest,
+            dict(n.committed_reqs),
+        )
+        for n in fr.nodes
+    ]
+    return steps, fr.stats()[1], state
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=_IDS)
+def test_testengine_pipelined_schedule_is_bit_identical(spec):
+    serial = _python_run(spec, pipeline=None)
+    piped = _python_run(spec, pipeline=PipelineConfig())
+    assert piped == serial
+
+
+@pytest.mark.skipif(
+    _native.load_fast() is None, reason="native fast engine unavailable"
+)
+@pytest.mark.parametrize("spec", SPECS[:2], ids=_IDS[:2])
+def test_fastengine_pipelined_schedule_is_bit_identical(spec):
+    serial = _fast_run(spec, pipeline=None)
+    piped = _fast_run(spec, pipeline=PipelineConfig())
+    assert piped == serial
+
+
+@pytest.mark.skipif(
+    _native.load_fast() is None, reason="native fast engine unavailable"
+)
+@pytest.mark.parametrize("spec", SPECS[:2], ids=_IDS[:2])
+def test_pipelined_runs_agree_across_engines(spec):
+    py = _python_run(spec, pipeline=PipelineConfig())
+    fast = _fast_run(spec, pipeline=PipelineConfig())
+    assert fast == py
+
+
+def test_pipeline_true_shorthand_means_default_config():
+    """Spec(pipeline=True) and Spec(pipeline=PipelineConfig()) build the
+    same schedule (the shorthand bench.py and mirnet use)."""
+    spec = SPECS[0]
+    assert _python_run(spec, pipeline=True) == _python_run(
+        spec, pipeline=PipelineConfig()
+    )
