@@ -1654,11 +1654,90 @@ def bench_commit_latency(detail, reqs=400, window=64):
             node.processor_config.request_store.close()
 
 
+def bench_sharded(detail, reqs_per_group=30, nodes_per_group=2,
+                  timeout_s=90.0):
+    """Config 6: multi-group sharded consensus on the REAL socket
+    deployment (``tools/mirnet.py --groups``, docs/SHARDING.md) — one
+    process per (group, node), one routed client multiplexing every
+    group.  On record:
+
+    - ``c6_1g_unique_req_per_s`` / ``c6_2g_unique_req_per_s``: unique
+      committed req/s from first submission to last commit, 1 vs 2
+      groups of ``nodes_per_group`` nodes each (startup excluded — the
+      quantity of interest is the steady-state shard scaling, not
+      process spawn).
+    - ``c6_scaling_ratio``: the 2-group rate over the 1-group rate —
+      the paper's multi-leader scaling claim in shard form.
+    - ``observer_catchup_s``: spawn-to-synced wall time for one late
+      observer per group on the 2-group run; the history predates the
+      feeds' retained backlog, so this path exercises the RESET +
+      KIND_SNAPSHOT bootstrap, not just tailing.
+    """
+    import shutil
+    import tempfile
+
+    from mirbft_tpu.tools import mirnet
+
+    rates = {}
+    for groups in (1, 2):
+        root = tempfile.mkdtemp(prefix=f"bench-shard-{groups}g-")
+        try:
+            with mirnet._ShardedCluster(
+                root,
+                groups=groups,
+                nodes_per_group=nodes_per_group,
+                timeout_s=timeout_s,
+            ) as cluster:
+                cluster.start()
+                client = mirnet._connect_routed(
+                    cluster.map.members(0)[0], timeout_s
+                )
+                t0 = time.monotonic()
+                try:
+                    for g in range(groups):
+                        cluster.submit_group(
+                            g, 0, reqs_per_group, client=client
+                        )
+                    for g in range(groups):
+                        cluster.wait_commits(g, reqs_per_group)
+                finally:
+                    client.close()
+                elapsed = time.monotonic() - t0
+                rates[groups] = groups * reqs_per_group / max(elapsed, 1e-9)
+
+                if groups == 2:
+                    t0 = time.monotonic()
+                    for g in range(groups):
+                        cluster.spawn_observer(g, 0)
+                    for g in range(groups):
+                        mirnet.wait_observer_synced(
+                            cluster.root, g, 0, cluster.head(g),
+                            timeout_s=timeout_s,
+                        )
+                    detail["observer_catchup_s"] = round(
+                        time.monotonic() - t0, 2
+                    )
+                    for g in range(groups):
+                        problems = mirnet.observer_identity_problems(
+                            cluster.root, g, 0
+                        )
+                        if problems:
+                            raise RuntimeError(
+                                f"observer {g}/0 diverged: {problems}"
+                            )
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    detail["c6_1g_unique_req_per_s"] = round(rates[1], 1)
+    detail["c6_2g_unique_req_per_s"] = round(rates[2], 1)
+    detail["c6_scaling_ratio"] = round(rates[2] / max(rates[1], 1e-9), 2)
+
+
 def guard_pipeline_planes(detail):
     """The pipeline must not tax the planes it composes, and the pipelined
     headline must hold what it won: this run's ``wal_append_mb_s``,
-    ``fused_wave_4096_ms``, ``pipeline_e2e_hashes_per_s`` and
-    ``c1_4n_unique_req_per_s`` must stay within ±25% (in the direction
+    ``fused_wave_4096_ms``, ``pipeline_e2e_hashes_per_s``,
+    ``c1_4n_unique_req_per_s``, ``c6_2g_unique_req_per_s`` and
+    ``observer_catchup_s`` must stay within ±25% (in the direction
     that hurts) of the most recent recorded bench round carrying the key
     (``BENCH_r*.json``) — the ``hash_sync_regression`` guard pattern.
     Keys with no recorded baseline yet are noted, not failed; the
@@ -1688,7 +1767,9 @@ def guard_pipeline_planes(detail):
     for key, worse_high in (("wal_append_mb_s", False),
                             ("fused_wave_4096_ms", True),
                             ("pipeline_e2e_hashes_per_s", False),
-                            ("c1_4n_unique_req_per_s", False)):
+                            ("c1_4n_unique_req_per_s", False),
+                            ("c6_2g_unique_req_per_s", False),
+                            ("observer_catchup_s", True)):
         current = detail.get(key)
         ref, source = latest_recorded(key)
         if not isinstance(current, (int, float)):
@@ -1982,6 +2063,11 @@ def main():
         bench_commit_latency(detail)
     except Exception as exc:
         detail["commit_latency_error"] = f"{type(exc).__name__}: {exc}"[:160]
+    try:
+        # Config 6: sharded socket deployment (routing tier + observer).
+        bench_sharded(detail)
+    except Exception as exc:
+        detail["sharded_error"] = f"{type(exc).__name__}: {exc}"[:160]
     try:
         # Regression guard: the pipeline must not tax the planes it
         # composes (keys above are already recorded either way).

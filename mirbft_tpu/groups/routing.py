@@ -1,0 +1,243 @@
+"""Client-routing tier for multi-group sharded consensus.
+
+A sharded deployment runs S independent mirbft groups; each client is
+homed to exactly one group by a stable hash of its client id
+(:func:`group_for_client`).  The routing tier is deliberately thin:
+
+* :class:`GroupMap` — the authoritative ``group -> [(host, port), ...]``
+  table, JSON-serializable so it can ride in MAP_REPLY frames and
+  redirect replies.
+* :class:`RoutedClient` — a route-aware socket client.  One TCP
+  connection per node address multiplexes submissions to every group the
+  node co-hosts (the KIND_CLIENT group envelope, ``net/framing.py``); a
+  submission that lands on a node not hosting the client's group earns a
+  ``CLIENT_REDIRECT`` reply carrying the current group map, which the
+  client installs before retrying — so a stale or empty map self-heals
+  in one round trip.
+
+Rebalancing (moving a client between groups) is an explicit non-goal:
+the hash is static per deployment (docs/SHARDING.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import socket
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..net.framing import (
+    KIND_CLIENT,
+    KIND_GROUP,
+    FrameDecoder,
+    encode_client_envelope,
+    encode_frame,
+)
+
+# Client submission bodies: 8-byte big-endian req_no + opaque request
+# data.  Replies are a 1-byte status, except redirects which append the
+# serialized group map after the status byte.
+CLIENT_REQ = struct.Struct(">Q")
+CLIENT_BUSY = b"\x00"
+CLIENT_OK = b"\x01"
+CLIENT_REDIRECT = b"\x02"
+
+_HASH_INPUT = struct.Struct(">Q")
+
+
+def group_for_client(client_id: int, num_groups: int) -> int:
+    """Stable routing hash: sha256 of the 8-byte big-endian client id,
+    first 8 digest bytes mod the group count.  Deterministic across
+    processes and Python versions (never ``hash()``), uniform enough that
+    client populations spread evenly."""
+    if num_groups < 1:
+        raise ValueError(f"num_groups must be >= 1, got {num_groups}")
+    digest = hashlib.sha256(_HASH_INPUT.pack(client_id)).digest()
+    return int.from_bytes(digest[:8], "big") % num_groups
+
+
+def client_for_group(group_id: int, num_groups: int, start: int = 0) -> int:
+    """Smallest client id >= ``start`` that hashes to ``group_id`` —
+    the deployment harness picks per-group client identities with it."""
+    cid = start
+    while group_for_client(cid, num_groups) != group_id:
+        cid += 1
+        if cid - start > 100_000:
+            raise RuntimeError(
+                f"no client id for group {group_id}/{num_groups} "
+                f"within 100k of {start}"
+            )
+    return cid
+
+
+class GroupMap:
+    """``group -> [(host, port), ...]``: which node addresses serve each
+    group.  The serialized form rides in MAP_REPLY frames and redirect
+    replies, so it is plain JSON, not the wire codec."""
+
+    def __init__(self, addrs: Dict[int, List[Tuple[str, int]]]):
+        if not addrs:
+            raise ValueError("GroupMap needs at least one group")
+        self.addrs = {
+            int(g): [(str(h), int(p)) for h, p in members]
+            for g, members in addrs.items()
+        }
+        self.num_groups = len(self.addrs)
+        if sorted(self.addrs) != list(range(self.num_groups)):
+            raise ValueError(
+                f"group ids must be dense 0..S-1, got {sorted(self.addrs)}"
+            )
+
+    def members(self, group_id: int) -> List[Tuple[str, int]]:
+        return list(self.addrs[group_id])
+
+    def to_json_bytes(self) -> bytes:
+        return json.dumps(
+            {str(g): [[h, p] for h, p in m] for g, m in self.addrs.items()},
+            sort_keys=True,
+        ).encode()
+
+    @classmethod
+    def from_json_bytes(cls, data: bytes) -> "GroupMap":
+        doc = json.loads(data.decode())
+        return cls(
+            {
+                int(g): [(h, int(p)) for h, p in members]
+                for g, members in doc.items()
+            }
+        )
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, GroupMap) and self.addrs == other.addrs
+
+    def __repr__(self) -> str:
+        return f"GroupMap({self.addrs!r})"
+
+
+class RoutedClient:
+    """Route-aware submission handle over the KIND_CLIENT plane.
+
+    ``submit(client_id, req_no, data)`` hashes the client to its home
+    group, sends a group-enveloped frame to a member of that group, and
+    interprets the three reply statuses: OK (committed to the protocol),
+    BUSY (client window full — caller retries), REDIRECT (the node does
+    not host that group — install the attached map and retry another
+    member).  Connections are cached per address and reused across
+    groups, so a node co-hosting several groups sees one multiplexed
+    connection, not one per group.
+    """
+
+    def __init__(
+        self,
+        group_map: Optional[GroupMap] = None,
+        bootstrap: Optional[Tuple[str, int]] = None,
+        timeout_s: float = 15.0,
+        attempts: int = 6,
+    ):
+        if group_map is None and bootstrap is None:
+            raise ValueError("RoutedClient needs a group map or a bootstrap addr")
+        self.map = group_map
+        self.timeout_s = timeout_s
+        self.attempts = attempts
+        self.redirects_followed = 0
+        self._conns: Dict[Tuple[str, int], socket.socket] = {}
+        self._decoders: Dict[Tuple[str, int], FrameDecoder] = {}
+        if self.map is None:
+            self.map = self.fetch_map(bootstrap)
+
+    # -- connection cache --------------------------------------------------
+
+    def _conn(self, addr: Tuple[str, int]) -> socket.socket:
+        sock = self._conns.get(addr)
+        if sock is None:
+            sock = socket.create_connection(addr, timeout=self.timeout_s)
+            self._conns[addr] = sock
+            self._decoders[addr] = FrameDecoder()
+        return sock
+
+    def _drop(self, addr: Tuple[str, int]) -> None:
+        sock = self._conns.pop(addr, None)
+        self._decoders.pop(addr, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _roundtrip(self, addr: Tuple[str, int], frame: bytes, kind: int) -> bytes:
+        sock = self._conn(addr)
+        decoder = self._decoders[addr]
+        sock.sendall(frame)
+        while True:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError(f"{addr} closed the connection")
+            for got_kind, payload in decoder.feed(chunk):
+                if got_kind == kind:
+                    return payload
+
+    # -- map discovery -----------------------------------------------------
+
+    def fetch_map(self, addr: Tuple[str, int]) -> GroupMap:
+        """MAP_REQUEST/MAP_REPLY round trip against any sharded node."""
+        from . import ship
+
+        payload = self._roundtrip(
+            addr, encode_frame(KIND_GROUP, ship.encode_map_request()), KIND_GROUP
+        )
+        subtype, _group, _seq, body = ship.decode(payload)
+        if subtype != ship.MAP_REPLY:
+            raise ConnectionError(
+                f"{addr} answered MAP_REQUEST with subtype {subtype}"
+            )
+        return GroupMap.from_json_bytes(body)
+
+    # -- submission --------------------------------------------------------
+
+    def submit(
+        self,
+        client_id: int,
+        req_no: int,
+        data: bytes,
+        member: Optional[int] = None,
+    ) -> bool:
+        """Submit one request; True iff accepted (OK), False on BUSY.
+        ``member`` pins the submission to one group member index (the
+        harness submits to every member — the reference stress shape);
+        default rotates by attempt.  Redirect replies update the map and
+        retry; connection errors rotate to the next member."""
+        body = CLIENT_REQ.pack(req_no) + data
+        last_err: Optional[Exception] = None
+        group_id = 0
+        for attempt in range(self.attempts):
+            # Recomputed each attempt: a redirect may have replaced the
+            # map (and with it the group count and membership).
+            group_id = group_for_client(client_id, self.map.num_groups)
+            frame = encode_frame(
+                KIND_CLIENT, encode_client_envelope(group_id, body)
+            )
+            members = self.map.members(group_id)
+            idx = member if member is not None else attempt
+            addr = members[idx % len(members)]
+            if attempt:
+                time.sleep(min(1.0, 0.05 * (2 ** (attempt - 1))))
+            try:
+                status = self._roundtrip(addr, frame, KIND_CLIENT)
+            except (OSError, ConnectionError) as err:
+                last_err = err
+                self._drop(addr)
+                continue
+            if status[:1] == CLIENT_REDIRECT:
+                self.map = GroupMap.from_json_bytes(status[1:])
+                self.redirects_followed += 1
+                continue
+            return status[:1] == CLIENT_OK
+        raise ConnectionError(
+            f"group {group_id} unreachable after {self.attempts} attempts"
+        ) from last_err
+
+    def close(self) -> None:
+        for addr in list(self._conns):
+            self._drop(addr)

@@ -5,6 +5,7 @@ A frame is::
     magic   2 bytes  b"MB"
     version 1 byte   FRAME_VERSION
     kind    1 byte   KIND_HANDSHAKE / KIND_MSG / KIND_CLIENT / KIND_SNAPSHOT
+                     / KIND_GROUP
     length  4 bytes  big-endian payload length
     crc32   4 bytes  big-endian CRC32 of the payload
     payload ``length`` bytes (``wire.encode`` output for KIND_MSG)
@@ -33,11 +34,14 @@ FRAME_HEADER_LEN = 12
 # (tcp.py); KIND_MSG carries one wire-encoded protocol message; KIND_CLIENT
 # carries a client-submission envelope (tools/mirnet.py); KIND_SNAPSHOT
 # carries one snapshot state-transfer subframe — request, chunk, or
-# missing (storage/snapshot.py).
+# missing (storage/snapshot.py); KIND_GROUP carries one sharding-plane
+# subframe — group-map discovery or committed-batch log shipping
+# (groups/ship.py, docs/SHARDING.md).
 KIND_HANDSHAKE = 0
 KIND_MSG = 1
 KIND_CLIENT = 2
 KIND_SNAPSHOT = 3
+KIND_GROUP = 4
 
 # Upper bound on a single payload.  Generous against the largest legitimate
 # protocol message (a MsgBatch of a full iteration's sends), tight against
@@ -107,6 +111,7 @@ class FrameDecoder:
                     KIND_MSG,
                     KIND_CLIENT,
                     KIND_SNAPSHOT,
+                    KIND_GROUP,
                 ):
                     raise FrameError(f"unknown frame kind {kind}")
                 if length > self._max_payload:
@@ -132,3 +137,39 @@ class FrameDecoder:
     def pending_bytes(self) -> int:
         """Bytes buffered awaiting a complete frame (diagnostics only)."""
         return len(self._buf)
+
+
+# --------------------------------------------------------------------------
+# KIND_CLIENT group envelope (docs/SHARDING.md)
+#
+# Sharded deployments prefix the client submission body with a 6-byte
+# envelope header so one connection can multiplex submissions to a node's
+# co-hosted groups.  The decode path is versioned-compat: a payload without
+# the envelope magic is a legacy single-group submission and decodes as
+# group 0 with the whole payload as body, so old clients and recorded
+# streams keep working unchanged.  The magic byte cannot collide with a
+# legacy payload in practice: legacy bodies start with an 8-byte big-endian
+# req_no, whose first byte only reaches 0xC1 for req_no >= 0xC1 << 56.
+
+CLIENT_ENV_MAGIC = 0xC1
+CLIENT_ENV_VERSION = 1
+_CLIENT_ENV = struct.Struct(">BBI")  # magic, version, group id
+
+
+def encode_client_envelope(group_id: int, body: bytes) -> bytes:
+    """Wrap a client submission body with its destination group id."""
+    return _CLIENT_ENV.pack(CLIENT_ENV_MAGIC, CLIENT_ENV_VERSION, group_id) + body
+
+
+def decode_client_envelope(payload: bytes) -> Tuple[int, bytes]:
+    """``(group_id, body)`` from a KIND_CLIENT payload; legacy payloads
+    (no envelope magic) imply group 0.  Raises :class:`FrameError` on an
+    envelope from a future version."""
+    if len(payload) >= _CLIENT_ENV.size and payload[0] == CLIENT_ENV_MAGIC:
+        _magic, version, group_id = _CLIENT_ENV.unpack_from(payload)
+        if version != CLIENT_ENV_VERSION:
+            raise FrameError(
+                f"unsupported client envelope version {version}"
+            )
+        return group_id, payload[_CLIENT_ENV.size:]
+    return 0, payload

@@ -48,6 +48,7 @@ from .framing import (
     FrameDecoder,
     FrameError,
     KIND_CLIENT,
+    KIND_GROUP,
     KIND_HANDSHAKE,
     KIND_MSG,
     KIND_SNAPSHOT,
@@ -160,6 +161,7 @@ class TcpTransport:
         self._on_message: Optional[Callable[[int, object], None]] = None
         self._on_client: Optional[Callable[[bytes, Callable], None]] = None
         self._on_snapshot: Optional[Callable[[bytes], Optional[bytes]]] = None
+        self._on_group: Optional[Callable[[bytes, Callable], None]] = None
         self._stop = threading.Event()
         self._threads: list = []
         self._conns: list = []
@@ -187,6 +189,7 @@ class TcpTransport:
         on_message: Callable[[int, object], None],
         on_client: Optional[Callable[[bytes, Callable], None]] = None,
         on_snapshot: Optional[Callable[[bytes], Optional[bytes]]] = None,
+        on_group: Optional[Callable[[bytes, Callable], None]] = None,
     ) -> None:
         """Begin accepting and dialing.  ``on_message(source, msg)`` is
         invoked on reader threads for every inbound protocol message (the
@@ -194,10 +197,14 @@ class TcpTransport:
         KIND_CLIENT frames (``reply(payload)`` answers on the same
         connection — the mirnet submission path); ``on_snapshot(digest)``
         returns the local snapshot body (or None) for KIND_SNAPSHOT
-        state-transfer requests (storage/snapshot.py)."""
+        state-transfer requests (storage/snapshot.py); ``on_group(payload,
+        send)`` handles KIND_GROUP sharding-plane frames — ``send(payload)``
+        answers (and may keep answering: log-ship subscriptions hold the
+        connection open) on the same connection (groups/ship.py)."""
         self._on_message = on_message
         self._on_client = on_client
         self._on_snapshot = on_snapshot
+        self._on_group = on_group
         accept = threading.Thread(
             target=self._accept_loop,
             name=f"net{self.node_id}-accept",
@@ -421,9 +428,22 @@ class TcpTransport:
     def _reader_loop(self, conn: socket.socket) -> None:
         decoder = FrameDecoder()
         source: Optional[int] = None
+        # Group-plane pushes (ShipFeed) come from the node's app thread
+        # while this reader may be answering on the same socket, so every
+        # send on this connection goes through one lock.
+        send_lock = threading.Lock()
 
         def reply(payload: bytes) -> None:
-            conn.sendall(encode_frame(KIND_CLIENT, payload))
+            frame = encode_frame(KIND_CLIENT, payload)
+            with send_lock:
+                conn.sendall(frame)
+            self._tx_bytes.inc(len(frame))
+
+        def group_send(payload: bytes) -> None:
+            frame = encode_frame(KIND_GROUP, payload)
+            with send_lock:
+                conn.sendall(frame)
+            self._tx_bytes.inc(len(frame))
 
         try:
             while not self._stop.is_set():
@@ -460,6 +480,11 @@ class TcpTransport:
                             self._log_drop("unexpected snapshot frame")
                             return
                         self._serve_snapshot(conn, payload)
+                    elif kind == KIND_GROUP:
+                        if self._on_group is None:
+                            self._log_drop("unexpected group frame")
+                            return
+                        self._on_group(payload, group_send)
         except FrameError as exc:
             self._log_drop(f"frame error from peer {source}: {exc}")
         except Exception as exc:  # decode error, stopped node, ...

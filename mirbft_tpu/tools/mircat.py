@@ -31,12 +31,20 @@ a SIGKILLed node leaves a torn gzip — are tolerated and reported, never
 fatal.  This is the judge ``tools/mirnet.py --scenario`` runs verdicts
 against (docs/FAULTS.md "Doctor-judgment contract").
 
+Several directories at once — or a sharded root whose ``group-<g>``
+children each hold one group's deployment (docs/SHARDING.md) — run
+:func:`doctor_sharded` instead: one :func:`doctor_deployment` per group,
+aggregated into a single verdict with the fault ledger re-keyed
+``<group>/<peer>:<kind>``, healthy only when every group is.
+
 Usage:
     python -m mirbft_tpu.tools.mircat LOG.gz [--node N ...]
         [--event-type TYPE ...] [--step-type TYPE ...]
         [--interactive] [--status-index IDX ...] [--verbose-text]
         [--trace OUT.json] [--doctor] [--doctor-json OUT.json]
     python -m mirbft_tpu.tools.mircat DEPLOY_DIR --doctor
+    python -m mirbft_tpu.tools.mircat SHARD_ROOT --doctor
+    python -m mirbft_tpu.tools.mircat DIR_A DIR_B ... --doctor
 """
 
 from __future__ import annotations
@@ -77,7 +85,13 @@ def _parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser = argparse.ArgumentParser(
         prog="mircat", description="mirbft_tpu event-log viewer/replayer"
     )
-    parser.add_argument("log", help="gzip event log file")
+    parser.add_argument(
+        "log",
+        nargs="+",
+        help="gzip event log file, or (with --doctor) one or more "
+        "deployment directories; a sharded root containing group-* "
+        "subdirectories expands to one doctor run per group",
+    )
     parser.add_argument(
         "--node", type=int, action="append", help="only events for these node ids"
     )
@@ -333,6 +347,77 @@ def _print_deployment_report(report: dict) -> None:
     )
 
 
+def _sharded_group_dirs(path: Path) -> List[Tuple[str, Path]]:
+    """``(label, deployment_dir)`` pairs for one doctor input path.
+
+    A sharded mirnet root (``--groups``) holds one full deployment
+    directory per group under ``group-<g>/``; expand it so every group
+    is judged independently.  A plain deployment directory is a single
+    unlabelled group of its own.
+    """
+    groups = sorted(
+        (d for d in path.glob("group-*") if d.is_dir()),
+        key=lambda d: int(d.name.split("-", 1)[1]),
+    )
+    if groups:
+        return [(d.name, d) for d in groups]
+    return [(path.name, path)]
+
+
+def doctor_sharded(
+    paths, thresholds: Optional[HealthThresholds] = None
+) -> dict:
+    """Judge several deployment directories as one sharded verdict.
+
+    Each input path expands via :func:`_sharded_group_dirs` (a sharded
+    root becomes its ``group-*`` children) and runs through
+    :func:`doctor_deployment` unchanged — groups are independent
+    consensus instances, so per-group thresholds come from each group's
+    own ``cluster.json``.  The aggregate is healthy only when every
+    group is, and the fault ledger is re-keyed ``<group>/<peer>:<kind>``
+    so cross-group collisions stay distinguishable.
+    """
+    per_group: Dict[str, dict] = {}
+    faults: Dict[str, float] = {}
+    anomaly_count = 0
+    truncated: List[str] = []
+    for path in paths:
+        for label, group_dir in _sharded_group_dirs(Path(path)):
+            report = doctor_deployment(group_dir, thresholds=thresholds)
+            per_group[label] = report
+            anomaly_count += report["anomaly_count"]
+            truncated.extend(report["truncated_logs"])
+            for key, count in report["faults"].items():
+                faults[f"{label}/{key}"] = faults.get(f"{label}/{key}", 0.0) + count
+    return {
+        "roots": [str(p) for p in paths],
+        "healthy": all(r["healthy"] for r in per_group.values()),
+        "anomaly_count": anomaly_count,
+        "faults": dict(sorted(faults.items())),
+        "per_group": per_group,
+        "truncated_logs": truncated,
+    }
+
+
+def _print_sharded_report(report: dict) -> None:
+    for label in report["per_group"]:
+        group = report["per_group"][label]
+        print(
+            f"=== {label}: "
+            f"{'HEALTHY' if group['healthy'] else 'UNHEALTHY'} "
+            f"({group['anomaly_count']} anomalies, "
+            f"{len(group['per_node'])} nodes) ==="
+        )
+        _print_deployment_report(group)
+    print(
+        f"sharded verdict: "
+        f"{'HEALTHY' if report['healthy'] else 'UNHEALTHY'} "
+        f"({report['anomaly_count']} anomalies, "
+        f"{len(report['faults'])} fault keys across "
+        f"{len(report['per_group'])} groups)"
+    )
+
+
 def _print_wal_report(report: dict) -> None:
     print(f"wal dir: {report['dir']}")
     print(f"low index: {report['low_index']}")
@@ -361,26 +446,50 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.wal:
         from ..storage import wal_segment_report
 
-        if not Path(args.log).is_dir():
-            print("mircat: --wal requires a WAL directory", file=sys.stderr)
+        if len(args.log) != 1 or not Path(args.log[0]).is_dir():
+            print("mircat: --wal requires one WAL directory", file=sys.stderr)
             return 2
-        report = wal_segment_report(args.log)
+        report = wal_segment_report(args.log[0])
         _print_wal_report(report)
         return 0 if report["ok"] else 1
 
-    if Path(args.log).is_dir():
+    if any(Path(p).is_dir() for p in args.log):
+        if not all(Path(p).is_dir() for p in args.log):
+            print(
+                "mircat: cannot mix log files and directories",
+                file=sys.stderr,
+            )
+            return 2
         if not (args.doctor or args.doctor_json):
             print(
                 "mircat: directory input requires --doctor", file=sys.stderr
             )
             return 2
-        report = doctor_deployment(args.log)
-        _print_deployment_report(report)
+        # One plain deployment dir keeps the classic single-deployment
+        # report; multiple dirs or a sharded root (group-* children)
+        # aggregate per group.
+        expanded = [
+            pair for p in args.log for pair in _sharded_group_dirs(Path(p))
+        ]
+        if len(expanded) == 1 and expanded[0][1] == Path(args.log[0]):
+            report = doctor_deployment(args.log[0])
+            _print_deployment_report(report)
+        else:
+            report = doctor_sharded(args.log)
+            _print_sharded_report(report)
         if args.doctor_json:
             with open(args.doctor_json, "w") as f:
                 json.dump(report, f, indent=2)
             print(f"doctor report -> {args.doctor_json}")
         return 0 if report["healthy"] else 1
+
+    if len(args.log) != 1:
+        print(
+            "mircat: multiple inputs are only supported with --doctor "
+            "directories",
+            file=sys.stderr,
+        )
+        return 2
 
     machines: Dict[int, StateMachine] = defaultdict(StateMachine)
     replay_time: Dict[int, float] = defaultdict(float)
@@ -411,7 +520,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     doctor_registry = metrics.Registry() if args.doctor else None
     doctor_clock = {"t": 0.0}
 
-    with open(args.log, "rb") as f:
+    log_path = args.log[0]
+    with open(log_path, "rb") as f:
         for index, record in enumerate(read_event_log(f)):
             shown = _matches(record, args)
             # --trace / --doctor without --interactive are pure analysis
@@ -555,7 +665,7 @@ def _doctor_report(
         with open(args.doctor_json, "w") as f:
             json.dump(
                 {
-                    "log": args.log,
+                    "log": args.log[0],
                     "healthy": healthy,
                     "anomaly_count": total_anomalies,
                     "peer_faults": {

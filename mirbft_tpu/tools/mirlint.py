@@ -974,6 +974,11 @@ REQUIRED_METRIC_NAMES = (
     "admission_window_size",
     "pipeline_depth_limit",
     "pipeline_autotune_adjustments_total",
+    # Sharding plane: router + log-ship feed + observer/learner
+    # (groups/, docs/SHARDING.md).
+    "group_commits_total",
+    "router_redirects_total",
+    "observer_lag_batches",
 )
 
 
@@ -1487,6 +1492,78 @@ def wire_dynamic_pass() -> List[Finding]:
     return findings
 
 
+def check_frame_subtypes(ship_module=None) -> List[Finding]:
+    """Rule id: frame-subtype.  The KIND_GROUP subframe registry
+    (groups/ship.py) must stay in lockstep with its SHIP_*/MAP_*
+    constants: every constant named and unique, every registered subtype
+    covered by :func:`sample_payloads`, and every sample decoding back to
+    its own subtype and re-encoding byte-identically.
+
+    ``ship_module`` is injectable for tests; default is the real module.
+    """
+    if ship_module is None:
+        from ..groups import ship as ship_module
+
+    where = "mirbft_tpu/groups/ship.py"
+    findings: List[Finding] = []
+
+    def flag(message: str) -> None:
+        findings.append(Finding(where, 0, "frame-subtype", message))
+
+    names = getattr(ship_module, "SUBTYPE_NAMES", None)
+    if not isinstance(names, dict) or not names:
+        flag("SUBTYPE_NAMES registry is missing or empty")
+        return findings
+
+    constants = {
+        attr: value
+        for attr, value in vars(ship_module).items()
+        if attr.startswith(("SHIP_", "MAP_")) and isinstance(value, int)
+    }
+    for attr, value in sorted(constants.items()):
+        if value not in names:
+            flag(f"{attr} = {value} is not registered in SUBTYPE_NAMES")
+    for value in sorted(names):
+        if value not in constants.values():
+            flag(
+                f"SUBTYPE_NAMES[{value}] has no matching SHIP_*/MAP_* "
+                "constant"
+            )
+    if len(set(constants.values())) != len(constants):
+        flag(f"duplicate subtype values in {sorted(constants.items())}")
+    seen_names: Dict[str, int] = {}
+    for value, name in names.items():
+        if not _SNAKE_CASE.match(name):
+            flag(f"subtype name {name!r} is not snake_case")
+        if name in seen_names:
+            flag(f"subtype name {name!r} used by {seen_names[name]} and {value}")
+        seen_names[name] = value
+
+    try:
+        samples = ship_module.sample_payloads()
+    except Exception as exc:  # noqa: BLE001 — report, don't crash lint
+        flag(f"sample_payloads() raised: {exc}")
+        return findings
+    for value, name in sorted(names.items()):
+        if value not in samples:
+            flag(f"sample_payloads() does not cover {name} ({value})")
+    for value, payload in sorted(samples.items()):
+        try:
+            subtype, group_id, seq, body = ship_module.decode(payload)
+        except Exception as exc:  # noqa: BLE001
+            flag(f"sample for subtype {value} does not decode: {exc}")
+            continue
+        if subtype != value:
+            flag(
+                f"sample registered under subtype {value} decodes as "
+                f"{subtype}"
+            )
+            continue
+        if ship_module.encode(subtype, group_id, seq, body) != payload:
+            flag(f"subtype {value} re-encode is not byte-identical")
+    return findings
+
+
 def wire_pass(root: Path) -> List[Finding]:
     pkg = root / "mirbft_tpu"
     findings = wire_static_pass(
@@ -1498,6 +1575,7 @@ def wire_pass(root: Path) -> List[Finding]:
     ]
     if root == repo_root():
         findings += wire_dynamic_pass()
+        findings += check_frame_subtypes()
     return findings
 
 
@@ -1557,7 +1635,7 @@ def sched_pass(
     """Rule ids: sleep-poll."""
     if files is None:
         files = []
-        for sub in ("processor", "testengine"):
+        for sub in ("processor", "testengine", "groups"):
             files.extend(sorted((root / "mirbft_tpu" / sub).rglob("*.py")))
         files.append(root / "mirbft_tpu" / "node.py")
     findings: List[Finding] = []

@@ -30,13 +30,26 @@ One module, three roles:
   accounting, written to ``scenario.json`` and the ``scenario_verdict``
   gauge.
 
+* **Sharded parent (``--groups S``)** — S independent consensus groups
+  behind the client-routing tier (docs/SHARDING.md): one full cluster per
+  group under ``<dir>/group-<g>/``, a ``shard.json`` topology file, the
+  route-aware :class:`~mirbft_tpu.groups.routing.RoutedClient` driving
+  traffic, and optional observer children
+  (:func:`~mirbft_tpu.groups.observer.Observer`) tailing each group.
+  Layouts: **disjoint** (default, one process per (group, node) — clean
+  per-group doctor attribution) and **cohost** (one process per host
+  index runs that node of every group; any one client connection
+  multiplexes submissions to all co-hosted groups).
+
 The harness is also importable: tests and ``bench.py`` call
-:func:`run_deployment` and :func:`run_scenario` directly (see
-tests/test_mirnet.py and the ``net_loopback_4n_commit_s`` bench key).
+:func:`run_deployment`, :func:`run_sharded_deployment`, and
+:func:`run_scenario` directly (see tests/test_mirnet.py and the
+``net_loopback_4n_commit_s`` bench key).
 
 Usage::
 
     python -m mirbft_tpu.tools.mirnet --nodes 4 --reqs 20 --kill-restart
+    python -m mirbft_tpu.tools.mirnet --groups 2 --observers 1
     python -m mirbft_tpu.tools.mirnet --scenario partition-minority
     python -m mirbft_tpu.tools.mirnet --list-scenarios
 """
@@ -57,11 +70,19 @@ import time
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Tuple
 
-# Client-frame payloads: 8-byte big-endian req_no + opaque request body.
-# Replies are a 1-byte status.
-_CLIENT_REQ = struct.Struct(">Q")
-CLIENT_OK = b"\x01"
-CLIENT_BUSY = b"\x00"
+# Client-frame payloads (8-byte big-endian req_no + opaque request body)
+# and the 1-byte reply statuses are shared with the routing tier —
+# mirbft_tpu/groups/routing.py is the source of truth; the old local
+# names stay as aliases for embedders and tests.
+from mirbft_tpu.groups.routing import (
+    CLIENT_BUSY,
+    CLIENT_OK,
+    CLIENT_REDIRECT,
+    GroupMap,
+    RoutedClient,
+    client_for_group,
+)
+from mirbft_tpu.groups.routing import CLIENT_REQ as _CLIENT_REQ
 
 _METRICS_SNAPSHOT_S = 0.5
 _PROPOSE_RETRY_S = 10.0
@@ -100,6 +121,18 @@ def _node_dir(root: Path, node_id: int) -> Path:
     return root / f"node-{node_id}"
 
 
+def _shard_path(root: Path) -> Path:
+    return root / "shard.json"
+
+
+def _group_dir(root: Path, group_id: int) -> Path:
+    return root / f"group-{group_id}"
+
+
+def _observer_dir(root: Path, group_id: int, obs_idx: int) -> Path:
+    return _group_dir(root, group_id) / f"observer-{obs_idx}"
+
+
 def _write_json_atomic(path: Path, obj: dict) -> None:
     """Readers (polling children) never see a torn file."""
     tmp = path.with_suffix(path.suffix + ".tmp")
@@ -121,31 +154,40 @@ def _write_cluster(
     byzantine: Optional[dict] = None,
     unreachable_after_s: float = 5.0,
     pipeline: bool = True,
+    group_id: Optional[int] = None,
+    num_groups: int = 1,
+    group_map: Optional[dict] = None,
 ) -> None:
     """``cluster.json``: everything a child needs to boot.  The fault
     plane keys are optional — plain deployments (``run_deployment``) leave
     them at their inert defaults.  The pipelined schedule is the default;
     ``pipeline=False`` (the ``--classic`` flag) selects the reference
-    coordinator, and the active schedule is recorded under ``schedule``."""
-    _write_json_atomic(
-        _cluster_path(root),
-        {
-            "node_count": node_count,
-            "client_ids": client_ids,
-            "ports": {str(i): ports[i] for i in range(node_count)},
-            "seed": seed,
-            "faults": faults,
-            "record_events": record_events,
-            "thresholds": thresholds,
-            "node_config": node_config,
-            "byzantine": {
-                str(k): v for k, v in (byzantine or {}).items()
-            },
-            "unreachable_after_s": unreachable_after_s,
-            "pipeline": pipeline,
-            "schedule": "pipelined" if pipeline else "classic",
+    coordinator, and the active schedule is recorded under ``schedule``.
+    Sharded deployments (docs/SHARDING.md) additionally record the node's
+    ``group_id`` and the full serialized group map so children can answer
+    MAP_REQUEST frames and redirect misrouted submissions without ever
+    reaching outside their own group directory."""
+    doc = {
+        "node_count": node_count,
+        "client_ids": client_ids,
+        "ports": {str(i): ports[i] for i in range(node_count)},
+        "seed": seed,
+        "faults": faults,
+        "record_events": record_events,
+        "thresholds": thresholds,
+        "node_config": node_config,
+        "byzantine": {
+            str(k): v for k, v in (byzantine or {}).items()
         },
-    )
+        "unreachable_after_s": unreachable_after_s,
+        "pipeline": pipeline,
+        "schedule": "pipelined" if pipeline else "classic",
+    }
+    if group_id is not None:
+        doc["group_id"] = int(group_id)
+        doc["num_groups"] = int(num_groups)
+        doc["group_map"] = group_map or {}
+    _write_json_atomic(_cluster_path(root), doc)
 
 
 def _load_fault_plan(root: Path, node_id: int):
@@ -197,7 +239,14 @@ class _CommitLogApp:
     retry, so transient unavailability costs latency, never liveness.
     Without a snapstore the legacy inline format (digest ‖ body) is kept."""
 
-    def __init__(self, log_path: Path, snapstore=None, peer_addrs=None):
+    def __init__(
+        self,
+        log_path: Path,
+        snapstore=None,
+        peer_addrs=None,
+        feed=None,
+        checkpoint_log: Optional[Path] = None,
+    ):
         self._file = open(log_path, "a", buffering=1)
         # Harness-side observation ledger; the append/record methods all
         # take the lock, and the summary readers run after the child
@@ -208,11 +257,23 @@ class _CommitLogApp:
         self.state_transfers: List[int] = []
         self.snapstore = snapstore
         self.peer_addrs = list(peer_addrs or [])
+        # Sharded deployments attach a groups.ship.ShipFeed so committed
+        # batches and checkpoints fan out to observers, plus a node-side
+        # checkpoints.log (the bit-identity evidence observers diff
+        # against).  App actions are processed serially, so _last_seq at
+        # snap() time is exactly the checkpoint boundary sequence.
+        self.feed = feed
+        self._checkpoint_log = checkpoint_log
+        self._last_seq = 0
 
     def apply(self, entry) -> None:
         reqs = ",".join(f"{r.client_id}:{r.req_no}" for r in entry.requests)
+        line = f"{entry.seq_no} {entry.digest.hex()} {reqs}"
         with self._lock:
-            self._file.write(f"{entry.seq_no} {entry.digest.hex()} {reqs}\n")
+            self._file.write(line + "\n")
+            self._last_seq = entry.seq_no
+        if self.feed is not None:
+            self.feed.note_commit(entry.seq_no, line)
 
     def snap(self, network_config, client_states):
         import hashlib
@@ -227,7 +288,15 @@ class _CommitLogApp:
         )
         encoded = wire.encode(state)
         if self.snapstore is not None:
-            return self.snapstore.save(encoded), ()
+            digest = self.snapstore.save(encoded)
+            with self._lock:
+                seq = self._last_seq
+            if self._checkpoint_log is not None:
+                with open(self._checkpoint_log, "a") as f:
+                    f.write(f"{seq} {digest.hex()}\n")
+            if self.feed is not None:
+                self.feed.note_checkpoint(seq, digest)
+            return digest, ()
         return hashlib.sha256(encoded).digest() + encoded, ()
 
     def transfer_to(self, seq_no, snap):
@@ -235,6 +304,7 @@ class _CommitLogApp:
 
         with self._lock:
             self.state_transfers.append(seq_no)
+            self._last_seq = max(self._last_seq, seq_no)
         if self.snapstore is None:
             return wire.decode(snap[32:])
         blob = self.snapstore.load(snap)
@@ -255,191 +325,404 @@ class _CommitLogApp:
             self._file.close()
 
 
-def run_node(root: Path, node_id: int) -> int:
-    """Child entry point: node ``node_id`` of the cluster described by
-    ``<root>/cluster.json``, serving protocol traffic and client frames
-    until SIGTERM."""
-    from mirbft_tpu.config import Config, standard_initial_network_state
-    from mirbft_tpu.health import HealthThresholds
-    from mirbft_tpu.net.tcp import TcpTransport, config_fingerprint
-    from mirbft_tpu.node import Node, ProcessorConfig
-    from mirbft_tpu.ops import CpuHasher
-    from mirbft_tpu.storage import GroupCommitWAL, LogStore, SnapshotStore
+def _group_fingerprint(group_id: Optional[int], fingerprint: bytes) -> bytes:
+    """Salt the protocol-handshake fingerprint with the group id so a
+    cross-group protocol connection fails the handshake outright instead
+    of ever mixing two groups' consensus traffic.  Ungrouped (legacy)
+    nodes keep the unsalted fingerprint, wire-compatible with old peers."""
+    if group_id is None:
+        return fingerprint
+    import hashlib
 
-    cluster = json.loads(_cluster_path(root).read_text())
-    node_count = cluster["node_count"]
-    client_ids = cluster["client_ids"]
-    ports: Dict[int, int] = {int(k): v for k, v in cluster["ports"].items()}
-    network_state = standard_initial_network_state(node_count, *client_ids)
+    digest = hashlib.sha256(
+        struct.pack(">I", int(group_id)) + fingerprint
+    ).digest()
+    return digest[: len(fingerprint)] if len(fingerprint) <= 32 else digest
 
-    ndir = _node_dir(root, node_id)
-    ndir.mkdir(parents=True, exist_ok=True)
-    marker = ndir / "initialized"
-    restarting = marker.exists()
 
-    injector = None
-    faults_version = -1
-    if cluster.get("faults"):
-        from mirbft_tpu.net.faults import FaultInjector
+class _Instance:
+    """One booted node runtime: transport + node + durable stores, plus
+    the group-plane surfaces when the cluster file names a group — the
+    client-envelope router, the MAP_REQUEST/SHIP_SUBSCRIBE handler, and
+    the :class:`~mirbft_tpu.groups.ship.ShipFeed` the app wrapper feeds.
 
-        faults_version, plan = _load_fault_plan(root, node_id)
-        injector = FaultInjector(node_id, plan)
+    ``run_node`` owns exactly one; ``run_host`` (the cohost layout) boots
+    one per co-hosted group in a single process and installs a shared
+    ``submit_router`` so any of the host's listening ports serves client
+    envelopes for every co-hosted group."""
 
-    transport = TcpTransport(
-        node_id,
-        peers={pid: ("127.0.0.1", port) for pid, port in ports.items()},
-        listen_port=ports[node_id],
-        fingerprint=config_fingerprint(network_state),
-        unreachable_after_s=float(cluster.get("unreachable_after_s", 5.0)),
-        fault_injector=injector,
-    )
+    def __init__(self, root: Path, node_id: int, submit_router=None):
+        from mirbft_tpu import metrics as metrics_mod
+        from mirbft_tpu.config import Config, standard_initial_network_state
+        from mirbft_tpu.health import HealthThresholds
+        from mirbft_tpu.net.framing import decode_client_envelope
+        from mirbft_tpu.net.tcp import TcpTransport, config_fingerprint
+        from mirbft_tpu.node import Node, ProcessorConfig
+        from mirbft_tpu.ops import CpuHasher
+        from mirbft_tpu.storage import GroupCommitWAL, LogStore, SnapshotStore
 
-    link = transport
-    byz_link = None
-    byz_spec = (cluster.get("byzantine") or {}).get(str(node_id))
-    if byz_spec is not None:
-        from mirbft_tpu.net.byzantine import ByzantineBehaviors, ByzantineLink
+        self.root = root
+        self.node_id = node_id
+        self._decode_env = decode_client_envelope
+        self._submit_router = submit_router
 
-        byz_link = ByzantineLink(
-            transport,
-            node_id,
-            ByzantineBehaviors.from_dict(byz_spec),
-            seed=int(cluster.get("seed", 0)),
-        )
-        link = byz_link
-
-    recorder = None
-    events_file = None
-    if cluster.get("record_events"):
-        from mirbft_tpu.eventlog.record import Recorder
-
-        boot = len(list(ndir.glob("events-*.gz")))
-        events_file = open(ndir / f"events-{boot:03d}.gz", "wb")
-        recorder = Recorder(
-            node_id,
-            events_file,
-            # Monotonic ms: the doctor pins its replay clock to these.
-            time_source=lambda: time.monotonic_ns() // 1_000_000,
-            retain_request_data=True,
+        cluster = json.loads(_cluster_path(root).read_text())
+        node_count = cluster["node_count"]
+        self.client_ids = cluster["client_ids"]
+        ports: Dict[int, int] = {
+            int(k): v for k, v in cluster["ports"].items()
+        }
+        network_state = standard_initial_network_state(
+            node_count, *self.client_ids
         )
 
-    cfg = {"id": node_id, "batch_size": 1}
-    cfg.update(cluster.get("node_config") or {})
-    snapstore = SnapshotStore(str(ndir / "snaps"))
-    app = _CommitLogApp(
-        ndir / "commits.log",
-        snapstore=snapstore,
-        peer_addrs=[
-            ("127.0.0.1", port)
-            for pid, port in ports.items()
-            if pid != node_id
-        ],
-    )
-    wal = GroupCommitWAL(str(ndir / "wal"))
-    request_store = LogStore(str(ndir / "reqs"))
-    pipeline = None
-    if cluster.get("pipeline"):
-        from mirbft_tpu.processor.pipeline import PipelineConfig
+        self.group_id: Optional[int] = cluster.get("group_id")
+        self.map_bytes: Optional[bytes] = None
+        self.feed = None
+        self._redirects = None
+        if self.group_id is not None:
+            from mirbft_tpu.groups.ship import ShipFeed
 
-        pipeline = PipelineConfig()
-    node = Node(
-        node_id,
-        Config(**cfg),
-        ProcessorConfig(
-            link=link,
-            hasher=CpuHasher(),
-            app=app,
-            wal=wal,
-            request_store=request_store,
-            interceptor=recorder,
-        ),
-        pipeline=pipeline,
-    )
-    thresholds = cluster.get("thresholds")
-    node.health_monitor.configure(
-        thresholds=(
-            HealthThresholds.from_dict(thresholds) if thresholds else None
-        ),
-        num_nodes=node_count,
-    )
-    transport.health_monitor = node.health_monitor
+            gmap = GroupMap(
+                {
+                    int(g): [(h, int(p)) for h, p in members]
+                    for g, members in cluster["group_map"].items()
+                }
+            )
+            self.map_bytes = gmap.to_json_bytes()
+            self.feed = ShipFeed(self.group_id)
+            self._redirects = metrics_mod.default_registry.counter(
+                "router_redirects_total",
+                labels={"group": str(self.group_id)},
+            )
 
-    def on_message(source: int, msg) -> None:
+        ndir = _node_dir(root, node_id)
+        ndir.mkdir(parents=True, exist_ok=True)
+        self._marker = ndir / "initialized"
+        self.restarting = self._marker.exists()
+
+        self.injector = None
+        self.faults_version = -1
+        if cluster.get("faults"):
+            from mirbft_tpu.net.faults import FaultInjector
+
+            self.faults_version, plan = _load_fault_plan(root, node_id)
+            self.injector = FaultInjector(node_id, plan)
+
+        self.transport = TcpTransport(
+            node_id,
+            peers={pid: ("127.0.0.1", port) for pid, port in ports.items()},
+            listen_port=ports[node_id],
+            fingerprint=_group_fingerprint(
+                self.group_id, config_fingerprint(network_state)
+            ),
+            unreachable_after_s=float(
+                cluster.get("unreachable_after_s", 5.0)
+            ),
+            fault_injector=self.injector,
+        )
+
+        link = self.transport
+        self.byz_link = None
+        byz_spec = (cluster.get("byzantine") or {}).get(str(node_id))
+        if byz_spec is not None:
+            from mirbft_tpu.net.byzantine import (
+                ByzantineBehaviors,
+                ByzantineLink,
+            )
+
+            self.byz_link = ByzantineLink(
+                self.transport,
+                node_id,
+                ByzantineBehaviors.from_dict(byz_spec),
+                seed=int(cluster.get("seed", 0)),
+            )
+            link = self.byz_link
+
+        self.recorder = None
+        self.events_file = None
+        if cluster.get("record_events"):
+            from mirbft_tpu.eventlog.record import Recorder
+
+            boot = len(list(ndir.glob("events-*.gz")))
+            self.events_file = open(ndir / f"events-{boot:03d}.gz", "wb")
+            self.recorder = Recorder(
+                node_id,
+                self.events_file,
+                # Monotonic ms: the doctor pins its replay clock to these.
+                time_source=lambda: time.monotonic_ns() // 1_000_000,
+                retain_request_data=True,
+            )
+
+        cfg = {"id": node_id, "batch_size": 1}
+        cfg.update(cluster.get("node_config") or {})
+        self.snapstore = SnapshotStore(str(ndir / "snaps"))
+        self.app = _CommitLogApp(
+            ndir / "commits.log",
+            snapstore=self.snapstore,
+            peer_addrs=[
+                ("127.0.0.1", port)
+                for pid, port in ports.items()
+                if pid != node_id
+            ],
+            feed=self.feed,
+            checkpoint_log=(
+                ndir / "checkpoints.log" if self.feed is not None else None
+            ),
+        )
+        self.wal = GroupCommitWAL(str(ndir / "wal"))
+        self.request_store = LogStore(str(ndir / "reqs"))
+        pipeline = None
+        if cluster.get("pipeline"):
+            from mirbft_tpu.processor.pipeline import PipelineConfig
+
+            pipeline = PipelineConfig()
+        self.node = Node(
+            node_id,
+            Config(**cfg),
+            ProcessorConfig(
+                link=link,
+                hasher=CpuHasher(),
+                app=self.app,
+                wal=self.wal,
+                request_store=self.request_store,
+                interceptor=self.recorder,
+            ),
+            pipeline=pipeline,
+        )
+        thresholds = cluster.get("thresholds")
+        self.node.health_monitor.configure(
+            thresholds=(
+                HealthThresholds.from_dict(thresholds) if thresholds else None
+            ),
+            num_nodes=node_count,
+        )
+        self.transport.health_monitor = self.node.health_monitor
+        self._network_state = network_state
+        self.metrics_path = ndir / "metrics.prom"
+
+    # --- wire surfaces ---
+
+    def _on_message(self, source: int, msg) -> None:
         try:
-            node.step(source, msg)
+            self.node.step(source, msg)
         except Exception:
             pass  # node stopping; the reader connection just drops
 
-    def on_client(payload: bytes, reply) -> None:
-        (req_no,) = _CLIENT_REQ.unpack_from(payload)
-        data = payload[_CLIENT_REQ.size :]
+    def serve_client(self, body: bytes, reply) -> None:
+        """Propose one de-enveloped client submission on this instance and
+        ack it on the requester's connection."""
+        (req_no,) = _CLIENT_REQ.unpack_from(body)
+        data = body[_CLIENT_REQ.size :]
         deadline = time.monotonic() + _PROPOSE_RETRY_S
         while time.monotonic() < deadline:
             try:
-                node.client(client_ids[0]).propose(req_no, data)
+                self.node.client(self.client_ids[0]).propose(req_no, data)
                 reply(CLIENT_OK)
                 return
             except KeyError:
                 time.sleep(0.02)  # client window not allocated yet
         reply(CLIENT_BUSY)
 
+    def redirect(self, reply) -> None:
+        """Misrouted submission: answer with the authoritative group map
+        so the client heals its routing in one round trip."""
+        self._redirects.inc()
+        reply(CLIENT_REDIRECT + self.map_bytes)
+
+    def _on_client(self, payload: bytes, reply) -> None:
+        env_group, body = self._decode_env(payload)
+        if self._submit_router is not None:
+            self._submit_router(env_group, body, reply)
+        elif self.group_id is not None and env_group != self.group_id:
+            self.redirect(reply)
+        else:
+            self.serve_client(body, reply)
+
+    def _on_group(self, payload: bytes, send) -> None:
+        from mirbft_tpu.groups import ship
+
+        try:
+            subtype, group, seq, _body = ship.decode(payload)
+        except ValueError:
+            return  # garbage subframe: drop, never kill the connection
+        if subtype == ship.MAP_REQUEST:
+            send(ship.encode_map_reply(self.map_bytes))
+        elif subtype == ship.SHIP_SUBSCRIBE and group == self.group_id:
+            self.feed.handle_subscribe(seq, send)
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        self.transport.start(
+            self._on_message,
+            on_client=self._on_client,
+            on_snapshot=self.snapstore.load,
+            on_group=(
+                self._on_group if self.group_id is not None else None
+            ),
+        )
+        if self.restarting:
+            self.node.restart_processing(tick_interval=0.02)
+        else:
+            self.node.process_as_new_node(
+                self._network_state, b"initial", tick_interval=0.02
+            )
+            self._marker.write_text("1")
+
+    def snapshot_metrics(self) -> None:
+        # Atomic snapshot: readers (the parent) never see a torn file.
+        tmp = self.metrics_path.with_suffix(".prom.tmp")
+        tmp.write_text(self.node.metrics_text())
+        tmp.replace(self.metrics_path)
+
+    def poll_faults(self) -> None:
+        if self.injector is None:
+            return
+        version, plan = _load_fault_plan(self.root, self.node_id)
+        if version != self.faults_version:
+            self.faults_version = version
+            self.injector.reconfigure(plan)
+
+    def err(self):
+        return self.node.notifier.err()
+
+    def stop(self) -> None:
+        self.node.stop()
+        self.transport.stop()
+        if self.byz_link is not None:
+            self.byz_link.stop()
+        if self.recorder is not None:
+            try:
+                self.recorder.stop()
+            except RuntimeError:
+                pass  # writer already failed; the log tail is simply torn
+            self.events_file.close()
+        try:
+            self.snapshot_metrics()  # final ledger for the doctor
+        except Exception:
+            pass
+        self.app.close()
+        try:
+            self.wal.close()
+            self.request_store.close()
+        except Exception:
+            pass  # workers drained; a close race is not a node failure
+
+
+def _child_loop(instances: List[_Instance], stop: threading.Event) -> int:
+    """Shared child main loop: metrics snapshots and fault-plan polling
+    for every booted instance until SIGTERM (or a node error)."""
+    next_snapshot = 0.0
+    try:
+        while not stop.is_set():
+            now = time.monotonic()
+            if now >= next_snapshot:
+                next_snapshot = now + _METRICS_SNAPSHOT_S
+                for inst in instances:
+                    inst.snapshot_metrics()
+                    err = inst.err()
+                    if err is not None:
+                        print(
+                            f"node {inst.node_id} failed: {err!r}",
+                            file=sys.stderr,
+                        )
+                        stop.set()
+            for inst in instances:
+                inst.poll_faults()
+            stop.wait(_FAULT_POLL_S)
+    finally:
+        for inst in instances:
+            inst.stop()
+    return 0
+
+
+def run_node(root: Path, node_id: int) -> int:
+    """Child entry point: node ``node_id`` of the cluster described by
+    ``<root>/cluster.json``, serving protocol traffic, client frames, and
+    (in sharded deployments) group-plane frames until SIGTERM."""
+    inst = _Instance(root, node_id)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
+    inst.start()
+    return _child_loop([inst], stop)
 
-    transport.start(on_message, on_client=on_client,
-                    on_snapshot=snapstore.load)
-    if restarting:
-        node.restart_processing(tick_interval=0.02)
-    else:
-        node.process_as_new_node(network_state, b"initial", tick_interval=0.02)
-        marker.write_text("1")
 
-    metrics_path = ndir / "metrics.prom"
+def run_host(root: Path, host_id: int) -> int:
+    """Cohost child: one OS process running node index ``host_id`` of
+    *every* group in the shard (shard.json layout "cohost").  The
+    co-hosted instances share the client plane: a KIND_CLIENT envelope
+    arriving on any of this host's listening ports is dispatched to the
+    co-hosted group it names — one client connection multiplexes
+    submissions to all of them — and an envelope for a group this host
+    does not serve earns a redirect carrying the group map.
+
+    The co-hosted instances share the process-wide metrics registry, so
+    their metrics.prom snapshots are a merged view; per-group doctor
+    attribution needs the default disjoint layout (docs/SHARDING.md)."""
+    shard = json.loads(_shard_path(root).read_text())
+    instances: Dict[int, _Instance] = {}
+
+    def router(env_group: int, body: bytes, reply) -> None:
+        inst = instances.get(env_group)
+        if inst is None:
+            next(iter(instances.values())).redirect(reply)
+        else:
+            inst.serve_client(body, reply)
+
+    for g in range(int(shard["groups"])):
+        instances[g] = _Instance(
+            _group_dir(root, g), host_id, submit_router=router
+        )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    for inst in instances.values():
+        inst.start()
+    return _child_loop(list(instances.values()), stop)
+
+
+def run_observer(root: Path, group_id: int, obs_idx: int) -> int:
+    """Observer child: non-voting learner tailing group ``group_id`` into
+    ``<root>/group-<g>/observer-<idx>/`` — snapshot bootstrap over
+    KIND_SNAPSHOT when it starts below the feed's backlog, then committed
+    -batch log tailing (docs/SHARDING.md)."""
+    from mirbft_tpu import metrics as metrics_mod
+    from mirbft_tpu.groups.observer import Observer
+
+    shard = json.loads(_shard_path(root).read_text())
+    members = [(h, int(p)) for h, p in shard["map"][str(group_id)]]
+    odir = _observer_dir(root, group_id, obs_idx)
+    obs = Observer(group_id, members, odir)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    tail = threading.Thread(
+        target=obs.run,
+        args=(stop,),
+        name=f"observer-{group_id}-{obs_idx}",
+        daemon=True,
+    )
+    tail.start()
+
+    metrics_path = odir / "metrics.prom"
 
     def snapshot_metrics() -> None:
-        # Atomic snapshot: readers (the parent) never see a torn file.
         tmp = metrics_path.with_suffix(".prom.tmp")
-        tmp.write_text(node.metrics_text())
+        tmp.write_text(metrics_mod.render_prometheus())
         tmp.replace(metrics_path)
 
-    next_snapshot = 0.0
     while not stop.is_set():
-        now = time.monotonic()
-        if now >= next_snapshot:
-            snapshot_metrics()
-            next_snapshot = now + _METRICS_SNAPSHOT_S
-            err = node.notifier.err()
-            if err is not None:
-                print(f"node {node_id} failed: {err!r}", file=sys.stderr)
-                break
-        if injector is not None:
-            version, plan = _load_fault_plan(root, node_id)
-            if version != faults_version:
-                faults_version = version
-                injector.reconfigure(plan)
-        stop.wait(_FAULT_POLL_S)
-
-    node.stop()
-    transport.stop()
-    if byz_link is not None:
-        byz_link.stop()
-    if recorder is not None:
-        try:
-            recorder.stop()
-        except RuntimeError:
-            pass  # writer already failed; the log tail is simply torn
-        events_file.close()
+        snapshot_metrics()
+        stop.wait(_METRICS_SNAPSHOT_S)
+    tail.join(timeout=5)
     try:
-        snapshot_metrics()  # final ledger for the doctor's live stream
+        snapshot_metrics()
     except Exception:
         pass
-    app.close()
-    try:
-        wal.close()
-        request_store.close()
-    except Exception:
-        pass  # workers already drained; a close race is not a node failure
+    obs.close()
     return 0
 
 
@@ -570,8 +853,7 @@ def _committed_reqs(lines: List[str]) -> set:
     return done
 
 
-def _metric_value(root: Path, node_id: int, name: str) -> float:
-    path = _node_dir(root, node_id) / "metrics.prom"
+def _metric_file_value(path: Path, name: str) -> float:
     if not path.exists():
         return 0.0
     total = 0.0
@@ -582,6 +864,10 @@ def _metric_value(root: Path, node_id: int, name: str) -> float:
             except ValueError:
                 pass
     return total
+
+
+def _metric_value(root: Path, node_id: int, name: str) -> float:
+    return _metric_file_value(_node_dir(root, node_id) / "metrics.prom", name)
 
 
 def _diff_commit_logs(root: Path, node_ids: List[int]) -> List[str]:
@@ -815,6 +1101,622 @@ def _kill_restart_drill(
     else:
         raise TimeoutError("no survivor ever recorded a reconnect")
     procs[victim] = _spawn(root, victim)
+
+
+# --------------------------------------------------------------------------
+# Sharded parent role: S groups behind the routing tier (docs/SHARDING.md)
+# --------------------------------------------------------------------------
+
+
+def _write_shard(
+    root: Path,
+    groups: int,
+    nodes_per_group: int,
+    layout: str,
+    ports: List[int],
+    client_ids: List[int],
+) -> GroupMap:
+    """``shard.json``: the deployment-wide topology file — group count,
+    layout, the authoritative group map, and each group's home client."""
+    gmap = GroupMap(
+        {
+            g: [
+                ("127.0.0.1", ports[g * nodes_per_group + i])
+                for i in range(nodes_per_group)
+            ]
+            for g in range(groups)
+        }
+    )
+    _write_json_atomic(
+        _shard_path(root),
+        {
+            "groups": groups,
+            "nodes_per_group": nodes_per_group,
+            "layout": layout,
+            "map": {
+                str(g): [[h, p] for h, p in gmap.members(g)]
+                for g in range(groups)
+            },
+            "client_ids": {str(g): client_ids[g] for g in range(groups)},
+        },
+    )
+    return gmap
+
+
+def _spawn_host(root: Path, host_id: int) -> subprocess.Popen:
+    log = open(root / f"host-{host_id}.log", "ab")
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "mirbft_tpu.tools.mirnet",
+            "--host",
+            str(host_id),
+            "--dir",
+            str(root),
+        ],
+        stdout=log,
+        stderr=log,
+    )
+
+
+def _spawn_observer(root: Path, group_id: int, obs_idx: int) -> subprocess.Popen:
+    odir = _observer_dir(root, group_id, obs_idx)
+    odir.mkdir(parents=True, exist_ok=True)
+    log = open(odir / "stdio.log", "ab")
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "mirbft_tpu.tools.mirnet",
+            "--observer",
+            str(obs_idx),
+            "--group",
+            str(group_id),
+            "--dir",
+            str(root),
+        ],
+        stdout=log,
+        stderr=log,
+    )
+
+
+def _connect_routed(
+    bootstrap: Tuple[str, int], timeout_s: float
+) -> RoutedClient:
+    """Route-aware client whose map is *discovered* over MAP_REQUEST from
+    a bootstrap node, retried while the children boot."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            return RoutedClient(bootstrap=bootstrap)
+        except (OSError, ConnectionError):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "sharded cluster never answered MAP_REQUEST"
+                )
+            time.sleep(0.1)
+
+
+class _ShardedCluster:
+    """Parent-side handle for a multi-group deployment: one full cluster
+    directory per group under ``<root>/group-<g>/`` (each a complete
+    legacy deployment dir — cluster.json, faults.json, node dirs — so the
+    single-group doctor and fault choreography reuse apply per group), a
+    ``shard.json`` topology file, and one child process per (group, node)
+    in the default **disjoint** layout or per host index in the
+    **cohost** layout (one process runs that node index of every group,
+    multiplexing the client plane over any of its connections)."""
+
+    def __init__(
+        self,
+        root,
+        *,
+        groups: int = 2,
+        nodes_per_group: int = 2,
+        layout: str = "disjoint",
+        seed: int = 0,
+        faults: bool = False,
+        record_events: bool = True,
+        thresholds: Optional[dict] = None,
+        node_config: Optional[dict] = None,
+        unreachable_after_s: float = 5.0,
+        timeout_s: float = 120.0,
+        pipeline: bool = True,
+    ):
+        if layout not in ("disjoint", "cohost"):
+            raise ValueError(f"unknown shard layout {layout!r}")
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.groups = groups
+        self.nodes_per_group = nodes_per_group
+        self.layout = layout
+        self.timeout_s = timeout_s
+        # Each group's home client: the smallest id hashing to the group,
+        # so disjointness across groups holds by construction.
+        self.client_ids = [
+            client_for_group(g, groups) for g in range(groups)
+        ]
+        ports = _reserve_ports(groups * nodes_per_group)
+        self.map = _write_shard(
+            self.root, groups, nodes_per_group, layout, ports,
+            self.client_ids,
+        )
+        map_doc = {
+            str(g): [[h, p] for h, p in self.map.members(g)]
+            for g in range(groups)
+        }
+        merged_thresholds = dict(_WIRE_THRESHOLDS)
+        merged_thresholds.update(thresholds or {})
+        for g in range(groups):
+            gdir = _group_dir(self.root, g)
+            gdir.mkdir(parents=True, exist_ok=True)
+            _write_cluster(
+                gdir,
+                nodes_per_group,
+                [p for _h, p in self.map.members(g)],
+                [self.client_ids[g]],
+                seed=seed + g,
+                faults=faults,
+                record_events=record_events,
+                thresholds=merged_thresholds,
+                node_config=dict(
+                    _STEADY_CONFIG if node_config is None else node_config
+                ),
+                unreachable_after_s=unreachable_after_s,
+                pipeline=pipeline,
+                group_id=g,
+                num_groups=groups,
+                group_map=map_doc,
+            )
+            if faults:
+                _write_json_atomic(
+                    _faults_path(gdir), {"version": 0, "plans": {}}
+                )
+            for i in range(nodes_per_group):
+                _node_dir(gdir, i).mkdir(parents=True, exist_ok=True)
+        self.procs: Dict[Tuple[str, int, int], subprocess.Popen] = {}
+        self._faults_version = {g: 0 for g in range(groups)}
+        self._stopped = False
+
+    def __enter__(self) -> "_ShardedCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def start(self) -> None:
+        if self.layout == "cohost":
+            for h in range(self.nodes_per_group):
+                self.procs[("host", h, -1)] = _spawn_host(self.root, h)
+        else:
+            for g in range(self.groups):
+                for i in range(self.nodes_per_group):
+                    self.procs[("node", g, i)] = _spawn(
+                        _group_dir(self.root, g), i
+                    )
+
+    def spawn_observer(self, group_id: int, obs_idx: int = 0) -> None:
+        self.procs[("obs", group_id, obs_idx)] = _spawn_observer(
+            self.root, group_id, obs_idx
+        )
+
+    def group_procs(self, g: int) -> Dict[int, subprocess.Popen]:
+        if self.layout == "cohost":
+            return {
+                h: p
+                for (kind, h, _x), p in self.procs.items()
+                if kind == "host"
+            }
+        return {
+            i: p
+            for (kind, gg, i), p in self.procs.items()
+            if kind == "node" and gg == g
+        }
+
+    # --- traffic ---
+
+    def submit_group(
+        self,
+        g: int,
+        start: int,
+        stop: int,
+        timeout_s: Optional[float] = None,
+        client: Optional[RoutedClient] = None,
+    ) -> None:
+        """Submit requests ``[start, stop)`` for group ``g``'s home client
+        to every group member (the reference stress shape; commit-once is
+        enforced by the protocol) through the routing tier."""
+        own = client is None
+        if own:
+            client = RoutedClient(group_map=self.map)
+        try:
+            deadline = time.monotonic() + (
+                timeout_s if timeout_s is not None else self.timeout_s
+            )
+            cid = self.client_ids[g]
+            for req_no in range(start, stop):
+                data = b"mirnet-%d" % req_no
+                for member in range(self.nodes_per_group):
+                    while not client.submit(cid, req_no, data, member=member):
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                f"group {g} kept refusing request {req_no}"
+                            )
+                        time.sleep(0.05)
+        finally:
+            if own:
+                client.close()
+
+    def wait_commits(
+        self,
+        g: int,
+        reqs: int,
+        quorum: Optional[int] = None,
+        timeout_s: Optional[float] = None,
+        first_req: int = 0,
+    ) -> None:
+        npg = self.nodes_per_group
+        _wait_commits(
+            _group_dir(self.root, g),
+            self.group_procs(g),
+            list(range(npg)),
+            self.client_ids[g],
+            reqs,
+            quorum if quorum is not None else npg - (npg - 1) // 3,
+            timeout_s if timeout_s is not None else self.timeout_s,
+            first_req=first_req,
+        )
+
+    # --- fault choreography (per group) ---
+
+    def set_group_faults(self, g: int, plans: dict) -> None:
+        self._faults_version[g] += 1
+        _write_json_atomic(
+            _faults_path(_group_dir(self.root, g)),
+            {
+                "version": self._faults_version[g],
+                "plans": {str(i): p.as_dict() for i, p in plans.items()},
+            },
+        )
+        time.sleep(3 * _FAULT_POLL_S)
+
+    def partition_group(self, g: int, victims: Iterable[int]) -> None:
+        """Netsplit inside one group: block every link crossing the
+        victim/survivor cut, both directions, leaving every other group's
+        wire untouched."""
+        from mirbft_tpu.net.faults import FaultPlan, FaultProfile
+
+        cut = set(victims)
+        plans = {}
+        for i in range(self.nodes_per_group):
+            links = {}
+            for j in range(self.nodes_per_group):
+                if j != i and (i in cut) != (j in cut):
+                    links[(i, j)] = FaultProfile(partition=True)
+            plans[i] = FaultPlan(links=links)
+        self.set_group_faults(g, plans)
+
+    def heal_group(self, g: int) -> None:
+        self.set_group_faults(g, {})
+
+    # --- observability ---
+
+    def last_seq(self, g: int, node_id: int = 0) -> int:
+        lines = _read_commits(_group_dir(self.root, g), node_id)
+        return int(lines[-1].split(" ", 1)[0]) if lines else 0
+
+    def head(self, g: int) -> int:
+        """The group's commit head: the furthest member's last sequence."""
+        return max(
+            self.last_seq(g, i) for i in range(self.nodes_per_group)
+        )
+
+    def group_metric(self, g: int, name: str) -> float:
+        return sum(
+            _metric_value(_group_dir(self.root, g), i, name)
+            for i in range(self.nodes_per_group)
+        )
+
+    def observer_metric(self, g: int, obs_idx: int, name: str) -> float:
+        return _metric_file_value(
+            _observer_dir(self.root, g, obs_idx) / "metrics.prom", name
+        )
+
+    # --- process control ---
+
+    def stop_all(self) -> None:
+        """Graceful SIGTERM stop so event recorders flush and final
+        metrics snapshots land before judging."""
+        if self._stopped:
+            return
+        self._stopped = True
+        for process in self.procs.values():
+            if process.poll() is None:
+                process.terminate()
+        for process in self.procs.values():
+            try:
+                process.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                process.kill()
+                process.wait(timeout=5)
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        for process in self.procs.values():
+            if process.poll() is None:
+                process.terminate()
+        for process in self.procs.values():
+            try:
+                process.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                try:
+                    process.kill()
+                    process.wait(timeout=5)
+                except Exception:
+                    pass
+
+
+def _observer_head(root: Path, g: int, obs_idx: int) -> int:
+    """The observer's applied head: max sequence across its journal and
+    recorded checkpoints (a fresh bootstrap may have checkpoints only)."""
+    head = 0
+    for name in ("commits.log", "checkpoints.log"):
+        path = _observer_dir(root, g, obs_idx) / name
+        if path.exists():
+            lines = [ln for ln in path.read_text().splitlines() if ln]
+            if lines:
+                head = max(head, int(lines[-1].split(" ", 1)[0]))
+    return head
+
+
+def wait_observer_synced(
+    root, group_id: int, obs_idx: int, target_seq: int,
+    timeout_s: float = 60.0,
+) -> None:
+    """Block until the observer's applied head reaches ``target_seq``."""
+    root = Path(root)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if _observer_head(root, group_id, obs_idx) >= target_seq:
+            return
+        time.sleep(0.2)
+    raise TimeoutError(
+        f"observer {group_id}/{obs_idx} stuck at "
+        f"{_observer_head(root, group_id, obs_idx)}, wanted {target_seq}"
+    )
+
+
+def observer_identity_problems(root, group_id: int, obs_idx: int) -> List[str]:
+    """Bit-identity check for one observer against its group's members:
+    every journal line the observer holds must be byte-identical to a
+    member's line at the same sequence, and the observer's latest stable
+    checkpoint (seq, digest, snapshot body) must match a member's."""
+    root = Path(root)
+    gdir = _group_dir(root, group_id)
+    odir = _observer_dir(root, group_id, obs_idx)
+    problems: List[str] = []
+
+    member_lines: Dict[int, str] = {}
+    for ndir in sorted(gdir.glob("node-*")):
+        node_id = int(ndir.name.split("-", 1)[1])
+        for line in _read_commits(gdir, node_id):
+            member_lines.setdefault(int(line.split(" ", 1)[0]), line)
+    obs_commits = odir / "commits.log"
+    if obs_commits.exists():
+        for line in obs_commits.read_text().splitlines():
+            if not line:
+                continue
+            seq = int(line.split(" ", 1)[0])
+            want = member_lines.get(seq)
+            if want is None:
+                problems.append(
+                    f"observer holds seq {seq} no member committed"
+                )
+            elif want != line:
+                problems.append(
+                    f"observer diverges at seq {seq}: "
+                    f"{line!r} vs {want!r}"
+                )
+
+    obs_ck = odir / "checkpoints.log"
+    ck_lines = (
+        [ln for ln in obs_ck.read_text().splitlines() if ln]
+        if obs_ck.exists()
+        else []
+    )
+    if not ck_lines:
+        problems.append("observer recorded no stable checkpoint")
+        return problems
+    last = ck_lines[-1]
+    member_cks = set()
+    for ndir in sorted(gdir.glob("node-*")):
+        path = ndir / "checkpoints.log"
+        if path.exists():
+            member_cks.update(
+                ln for ln in path.read_text().splitlines() if ln
+            )
+    if last not in member_cks:
+        problems.append(
+            f"observer checkpoint {last!r} matches no member checkpoint"
+        )
+        return problems
+    # The snapshot body itself must be on the observer's disk, byte-equal
+    # to a member's copy of the same digest.
+    digest_hex = last.split(" ", 1)[1]
+    obs_snaps = sorted(p for p in (odir / "snaps").glob("*") if p.is_file())
+    obs_blob = None
+    for p in obs_snaps:
+        if digest_hex in p.name:
+            obs_blob = p.read_bytes()
+    if obs_blob is None:
+        problems.append(
+            f"observer never persisted snapshot {digest_hex[:12]}"
+        )
+        return problems
+    for ndir in sorted(gdir.glob("node-*")):
+        for p in (ndir / "snaps").glob("*"):
+            if p.is_file() and digest_hex in p.name:
+                if p.read_bytes() != obs_blob:
+                    problems.append(
+                        f"snapshot {digest_hex[:12]} differs between "
+                        f"observer and {ndir.name}"
+                    )
+                return problems
+    problems.append(
+        f"no member holds snapshot {digest_hex[:12]} to compare against"
+    )
+    return problems
+
+
+def run_sharded_deployment(
+    root_dir: Optional[str] = None,
+    groups: int = 2,
+    nodes_per_group: int = 2,
+    reqs_per_group: int = 6,
+    layout: str = "disjoint",
+    observers_per_group: int = 0,
+    timeout_s: float = 120.0,
+    pipeline: bool = True,
+    probe_redirect: bool = True,
+) -> dict:
+    """Run ``groups`` independent consensus groups behind the routing
+    tier and return a summary: per-group commit counts, the disjointness
+    and exactly-once verdicts, redirect accounting, and (with observers)
+    per-observer sync state.  Raises on timeout, divergence, cross-group
+    leakage, or duplicate commits."""
+    owned_tmp = root_dir is None
+    if owned_tmp:
+        root_dir = tempfile.mkdtemp(prefix="mirnet-sharded-")
+    started = time.monotonic()
+    redirects_followed = 0
+    with _ShardedCluster(
+        root_dir,
+        groups=groups,
+        nodes_per_group=nodes_per_group,
+        layout=layout,
+        timeout_s=timeout_s,
+        pipeline=pipeline,
+    ) as cluster:
+        cluster.start()
+        # Map discovery over the wire, not hand-delivered configuration.
+        client = _connect_routed(cluster.map.members(0)[0], timeout_s)
+        try:
+            if probe_redirect and groups >= 2 and layout == "disjoint":
+                # Aim one group's request at the wrong group's node: the
+                # redirect reply must carry a map that heals the client's
+                # routing in one round trip.  (A cohost process serves
+                # every group, so only the disjoint layout redirects.)
+                wrong = GroupMap(
+                    {g: cluster.map.members(0) for g in range(groups)}
+                )
+                probe = RoutedClient(group_map=wrong)
+                try:
+                    if not probe.submit(
+                        cluster.client_ids[1], 0, b"mirnet-0"
+                    ):
+                        raise AssertionError(
+                            "redirected probe was refused after reroute"
+                        )
+                    redirects_followed = probe.redirects_followed
+                finally:
+                    probe.close()
+                if redirects_followed < 1:
+                    raise AssertionError(
+                        "misrouted probe was accepted without a redirect"
+                    )
+            for g in range(groups):
+                cluster.submit_group(
+                    g, 0, reqs_per_group, client=client
+                )
+        finally:
+            client.close()
+        for k in range(observers_per_group):
+            for g in range(groups):
+                cluster.spawn_observer(g, k)
+        for g in range(groups):
+            cluster.wait_commits(g, reqs_per_group)
+        observer_state: Dict[str, dict] = {}
+        if observers_per_group:
+            for g in range(groups):
+                target = cluster.head(g)
+                for k in range(observers_per_group):
+                    wait_observer_synced(
+                        cluster.root, g, k, target, timeout_s=timeout_s
+                    )
+                    observer_state[f"{g}/{k}"] = {
+                        "head": _observer_head(cluster.root, g, k),
+                        "lag": cluster.observer_metric(
+                            g, k, "observer_lag_batches"
+                        ),
+                    }
+
+        problems: List[str] = []
+        per_group_commits: Dict[int, int] = {}
+        per_group_reqs: Dict[int, set] = {}
+        for g in range(groups):
+            gdir = _group_dir(cluster.root, g)
+            ids = list(range(nodes_per_group))
+            problems += [
+                f"group {g}: {p}" for p in _agreement_by_seq(gdir, ids)
+            ]
+            lines = _read_commits(gdir, 0)
+            per_group_commits[g] = len(lines)
+            committed = _committed_reqs(lines)
+            per_group_reqs[g] = committed
+            foreign = {c for c, _r in committed} - {cluster.client_ids[g]}
+            if foreign:
+                problems.append(
+                    f"group {g} committed foreign clients {sorted(foreign)}"
+                )
+            counts: Dict[Tuple[int, int], int] = {}
+            for line in lines:
+                for ref in line.split(" ", 2)[2].split(","):
+                    if ref:
+                        c, r = ref.split(":")
+                        key = (int(c), int(r))
+                        counts[key] = counts.get(key, 0) + 1
+            dups = {k: v for k, v in counts.items() if v > 1}
+            if dups:
+                problems.append(f"group {g} committed duplicates: {dups}")
+        for g in range(groups):
+            for h in range(g + 1, groups):
+                overlap = per_group_reqs[g] & per_group_reqs[h]
+                if overlap:
+                    problems.append(
+                        f"groups {g}/{h} overlap on "
+                        f"{sorted(overlap)[:4]}..."
+                    )
+        if problems:
+            raise AssertionError(
+                "sharded deployment failed:\n" + "\n".join(problems)
+            )
+        # Graceful stop first: each child flushes a final metrics
+        # snapshot, so the sums below see every commit.
+        cluster.stop_all()
+        result = {
+            "root": str(cluster.root),
+            "layout": layout,
+            "groups": groups,
+            "nodes_per_group": nodes_per_group,
+            "client_ids": list(cluster.client_ids),
+            "per_group_commits": per_group_commits,
+            "unique_reqs_total": sum(
+                len(s) for s in per_group_reqs.values()
+            ),
+            "redirects_followed": redirects_followed,
+            "router_redirects": sum(
+                cluster.group_metric(g, "router_redirects_total")
+                for g in range(groups)
+            ),
+            "group_commits_total": sum(
+                cluster.group_metric(g, "group_commits_total")
+                for g in range(groups)
+            ),
+            "observers": observer_state,
+            "elapsed_s": time.monotonic() - started,
+        }
+        return result
 
 
 # --------------------------------------------------------------------------
@@ -1734,8 +2636,125 @@ def _scenario_kill_under_write(root: Path, seed: int, *, pipeline: bool = True) 
     return verdict
 
 
+def _scenario_cross_group_partition(
+    root: Path, seed: int, *, pipeline: bool = True
+) -> dict:
+    """Blast-radius isolation across groups: partition one node of group
+    0 (a 2-node group needs both members for quorum, so group 0's commit
+    head freezes) and prove group 1 keeps committing *throughout* the
+    window — its head must advance across repeated samples while group
+    0's stands still — then heal and require group 0 to resume.  Judged
+    per group: the unpartitioned group's doctor must be clean; the
+    partitioned group may attribute exactly the injected outage."""
+    groups, npg = 2, 2
+    with _ShardedCluster(
+        root,
+        groups=groups,
+        nodes_per_group=npg,
+        seed=seed,
+        faults=True,
+        record_events=True,
+        node_config=dict(_VIEWCHANGE_CONFIG),
+        unreachable_after_s=0.8,
+        timeout_s=90.0,
+        pipeline=pipeline,
+    ) as cluster:
+        cluster.start()
+        client = _connect_routed(cluster.map.members(0)[0], 60.0)
+        samples: List[dict] = []
+        try:
+            for g in range(groups):
+                cluster.submit_group(g, 0, 3, client=client)
+            for g in range(groups):
+                cluster.wait_commits(g, 3)
+
+            cluster.partition_group(0, {1})
+            time.sleep(1.0)  # drain in-flight commits before the baseline
+            frozen = cluster.head(0)
+            advancing = 0
+            prev = cluster.head(1)
+            for step in range(4):
+                cluster.submit_group(
+                    1, 3 + step, 4 + step, client=client
+                )
+                cluster.wait_commits(
+                    1, 4 + step, first_req=3 + step, timeout_s=30.0
+                )
+                cur = cluster.head(1)
+                if cur > prev:
+                    advancing += 1
+                prev = cur
+                samples.append(
+                    {"group0": cluster.head(0), "group1": cur}
+                )
+            frozen_after = cluster.head(0)
+
+            cluster.heal_group(0)
+            time.sleep(1.0)  # let reconnects land before fresh traffic
+            cluster.submit_group(0, 3, 5, client=client, timeout_s=60.0)
+            cluster.wait_commits(0, 5, first_req=3, timeout_s=60.0)
+            resumed = cluster.head(0)
+        finally:
+            client.close()
+        cluster.stop_all()
+
+        from mirbft_tpu.tools.mircat import doctor_deployment
+
+        doctors = {
+            g: doctor_deployment(_group_dir(cluster.root, g))
+            for g in range(groups)
+        }
+        agreement = {
+            g: _agreement_by_seq(_group_dir(cluster.root, g),
+                                 list(range(npg)))
+            for g in range(groups)
+        }
+
+    failures: List[str] = []
+    if advancing < 3:
+        failures.append(
+            f"group 1's head advanced in only {advancing}/4 windows while "
+            f"group 0 was partitioned: {samples}"
+        )
+    if frozen_after > frozen + 1:
+        failures.append(
+            f"partitioned group 0 kept committing ({frozen} -> "
+            f"{frozen_after}) with its quorum cut"
+        )
+    if resumed <= frozen_after:
+        failures.append(
+            f"group 0 never resumed after the heal (head {resumed})"
+        )
+    clean = doctors[1]
+    if not clean["healthy"]:
+        failures.append(
+            f"unpartitioned group 1 doctor unhealthy: "
+            f"faults={clean['faults']} anomalies={clean['anomaly_count']}"
+        )
+    hurt_kinds = {
+        key.split(":", 1)[1] for key in doctors[0]["faults"]
+    }
+    if hurt_kinds - {"peer_unreachable", "suspicion_vote"}:
+        failures.append(
+            f"group 0 attributed unexpected fault kinds: "
+            f"{sorted(hurt_kinds)}"
+        )
+    for g in range(groups):
+        if agreement[g]:
+            failures.append(f"group {g}: " + "; ".join(agreement[g]))
+    res = {
+        "samples": samples,
+        "frozen_head": frozen,
+        "advancing_windows": advancing,
+        "resumed_head": resumed,
+        "doctor": doctors,
+    }
+    return _verdict(root, "cross-group-partition", res, failures)
+
+
 SCENARIOS = {
     "control": _scenario_control,
+    "cross-group-partition": _scenario_cross_group_partition,
     "partition-minority": _scenario_partition_minority,
     "partition-leader": _scenario_partition_leader,
     "flap": _scenario_flap,
@@ -1768,9 +2787,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument("--node", type=int, default=None,
                         help="(internal) run as node process with this id")
+    parser.add_argument("--host", type=int, default=None,
+                        help="(internal) run as a cohost process serving "
+                             "this node index of every group")
+    parser.add_argument("--observer", type=int, default=None,
+                        help="(internal) run as observer child with this "
+                             "index (requires --group)")
+    parser.add_argument("--group", type=int, default=None,
+                        help="(internal) group id for --observer")
     parser.add_argument("--dir", default=None,
                         help="deployment directory (default: fresh tempdir)")
     parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--groups", type=int, default=None,
+                        help="run a sharded deployment with this many "
+                             "consensus groups behind the routing tier")
+    parser.add_argument("--nodes-per-group", type=int, default=2)
+    parser.add_argument("--layout", choices=("disjoint", "cohost"),
+                        default="disjoint",
+                        help="sharded process packaging: one process per "
+                             "(group, node) or one per host index")
+    parser.add_argument("--observers", type=int, default=0,
+                        help="observers per group for --groups runs")
     parser.add_argument("--reqs", type=int, default=10)
     parser.add_argument("--kill-restart", action="store_true",
                         help="SIGKILL+restart one node mid-run")
@@ -1803,6 +2840,36 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.dir is None:
             parser.error("--node requires --dir")
         return run_node(Path(args.dir), args.node)
+
+    if args.host is not None:
+        if args.dir is None:
+            parser.error("--host requires --dir")
+        return run_host(Path(args.dir), args.host)
+
+    if args.observer is not None:
+        if args.dir is None or args.group is None:
+            parser.error("--observer requires --dir and --group")
+        return run_observer(Path(args.dir), args.group, args.observer)
+
+    if args.groups is not None:
+        result = run_sharded_deployment(
+            root_dir=args.dir,
+            groups=args.groups,
+            nodes_per_group=args.nodes_per_group,
+            reqs_per_group=args.reqs,
+            layout=args.layout,
+            observers_per_group=args.observers,
+            timeout_s=args.timeout,
+            pipeline=pipeline,
+        )
+        print(json.dumps(result, indent=2, sort_keys=True))
+        print(
+            f"mirnet: {args.groups} groups x {args.nodes_per_group} nodes "
+            f"({args.layout}) committed {result['unique_reqs_total']} "
+            f"unique requests in {result['elapsed_s']:.1f}s",
+            file=sys.stderr,
+        )
+        return 0
 
     if args.scenario is not None:
         try:

@@ -459,6 +459,70 @@ def test_wire_dynamic_roundtrip_on_real_registry():
     assert mirlint.wire_dynamic_pass() == []
 
 
+def test_frame_subtypes_real_registry_clean():
+    """The real KIND_GROUP subframe registry (groups/ship.py) is in
+    lockstep: every constant named, every subtype sampled, every sample
+    round-tripping byte-identically."""
+    assert mirlint.check_frame_subtypes() == []
+
+
+def test_frame_subtypes_detect_drift():
+    """An unregistered constant, a registry orphan, and a missing sample
+    each fire ``frame-subtype`` (injectable module, no real file edits)."""
+    import types
+
+    from mirbft_tpu.groups import ship
+
+    fake = types.SimpleNamespace(
+        SHIP_SUBSCRIBE=ship.SHIP_SUBSCRIBE,
+        SHIP_BATCH=ship.SHIP_BATCH,
+        SHIP_ROGUE=77,  # constant missing from the registry
+        SUBTYPE_NAMES={
+            ship.SHIP_SUBSCRIBE: "ship_subscribe",
+            ship.SHIP_BATCH: "ship_batch",
+            99: "orphan_entry",  # registry entry with no constant
+        },
+        sample_payloads=lambda: {
+            ship.SHIP_SUBSCRIBE: ship.encode_subscribe(1, 4)
+            # ship_batch and orphan_entry have no sample
+        },
+        decode=ship.decode,
+        encode=ship.encode,
+    )
+    messages = [f.message for f in mirlint.check_frame_subtypes(fake)]
+    assert all(
+        f.rule == "frame-subtype" for f in mirlint.check_frame_subtypes(fake)
+    )
+    assert any("SHIP_ROGUE" in m for m in messages)
+    assert any("orphan_entry" in m or "99" in m for m in messages)
+    assert any("does not cover" in m for m in messages)
+
+
+def test_frame_subtypes_detect_lossy_sample():
+    """A sample that decodes to a different subtype than it is registered
+    under is a hard finding — the table itself must be trustworthy."""
+    import types
+
+    from mirbft_tpu.groups import ship
+
+    fake = types.SimpleNamespace(
+        SHIP_SUBSCRIBE=ship.SHIP_SUBSCRIBE,
+        SHIP_BATCH=ship.SHIP_BATCH,
+        SUBTYPE_NAMES={
+            ship.SHIP_SUBSCRIBE: "ship_subscribe",
+            ship.SHIP_BATCH: "ship_batch",
+        },
+        sample_payloads=lambda: {
+            ship.SHIP_SUBSCRIBE: ship.encode_subscribe(1, 4),
+            ship.SHIP_BATCH: ship.encode_subscribe(1, 4),  # wrong subtype
+        },
+        decode=ship.decode,
+        encode=ship.encode,
+    )
+    messages = [f.message for f in mirlint.check_frame_subtypes(fake)]
+    assert any("decodes as" in m for m in messages)
+
+
 # ---------------------------------------------------------------------------
 # Pass 5: scheduler-path fixtures
 

@@ -1,0 +1,272 @@
+"""Multi-group sharding plane (mirbft_tpu/groups/, docs/SHARDING.md).
+
+Three tiers in one file: pure codec/routing units, in-process
+ShipFeed/Observer logic, and real multi-process deployments through
+``tools/mirnet.py --groups`` — two groups of two nodes each on localhost
+TCP with durable stores, the same "as real as possible" tier as
+tests/test_mirnet.py.  The cross-group partition soak is slow-marked.
+"""
+
+import pytest
+
+from mirbft_tpu import metrics
+from mirbft_tpu.groups import ship
+from mirbft_tpu.groups.observer import Observer
+from mirbft_tpu.groups.routing import (
+    GroupMap,
+    client_for_group,
+    group_for_client,
+)
+from mirbft_tpu.net.framing import (
+    FrameError,
+    decode_client_envelope,
+    encode_client_envelope,
+)
+
+# --------------------------------------------------------------------------
+# Routing units
+# --------------------------------------------------------------------------
+
+
+def test_group_for_client_deterministic_and_spread():
+    for s in (1, 2, 3, 8):
+        seen = set()
+        for client in range(256):
+            g = group_for_client(client, s)
+            assert 0 <= g < s
+            assert group_for_client(client, s) == g
+            seen.add(g)
+        # sha256 over 256 client ids covers every group for small S.
+        assert seen == set(range(s))
+
+
+def test_client_for_group_inverts_the_hash():
+    for s in (1, 2, 4):
+        ids = [client_for_group(g, s) for g in range(s)]
+        assert len(set(ids)) == s  # disjoint by construction
+        for g, client in enumerate(ids):
+            assert group_for_client(client, s) == g
+
+
+def test_group_map_json_roundtrip():
+    gmap = GroupMap({0: [("127.0.0.1", 9000)], 1: [("127.0.0.1", 9010)]})
+    back = GroupMap.from_json_bytes(gmap.to_json_bytes())
+    assert back == gmap
+    assert back.members(1) == [("127.0.0.1", 9010)]
+
+
+# --------------------------------------------------------------------------
+# Client envelope: versioned compatibility both ways
+# --------------------------------------------------------------------------
+
+
+def test_client_envelope_roundtrip():
+    body = b"\x00" * 8 + b"payload"
+    for group in (0, 1, 7, 2**31):
+        assert decode_client_envelope(
+            encode_client_envelope(group, body)
+        ) == (group, body)
+
+
+def test_client_envelope_legacy_payload_is_group_zero():
+    # A pre-sharding KIND_CLIENT payload has no envelope magic: it must
+    # decode as group 0 with the payload untouched.
+    legacy = b"\x00\x00\x00\x00\x00\x00\x00\x05hello"
+    assert decode_client_envelope(legacy) == (0, legacy)
+
+
+def test_client_envelope_unknown_version_rejected():
+    framed = bytearray(encode_client_envelope(1, b"x"))
+    framed[1] = 9  # future version: drop, never guess
+    with pytest.raises(FrameError):
+        decode_client_envelope(bytes(framed))
+
+
+# --------------------------------------------------------------------------
+# Ship subframe codec
+# --------------------------------------------------------------------------
+
+
+def test_ship_samples_roundtrip_every_subtype():
+    samples = ship.sample_payloads()
+    assert set(samples) == set(ship.SUBTYPE_NAMES)
+    for subtype, payload in samples.items():
+        back_subtype, group, seq, body = ship.decode(payload)
+        assert back_subtype == subtype
+        assert ship.encode(back_subtype, group, seq, body) == payload
+
+
+def test_ship_decode_rejects_garbage():
+    with pytest.raises(ValueError):
+        ship.decode(b"\x01\x02")  # shorter than the header
+    with pytest.raises(ValueError):
+        ship.decode(b"\xff" + b"\x00" * 12)  # unknown subtype
+    with pytest.raises(ValueError):
+        ship.encode(201, 0, 0)
+
+
+# --------------------------------------------------------------------------
+# In-process feed + observer logic
+# --------------------------------------------------------------------------
+
+
+def _collector():
+    frames = []
+
+    def send(payload):
+        frames.append(ship.decode(payload))
+
+    return frames, send
+
+
+def test_ship_feed_replays_backlog_and_resets_below_checkpoint():
+    feed = ship.ShipFeed(3, registry=metrics.Registry())
+    for seq in (1, 2, 3):
+        feed.note_commit(seq, f"{seq} aa ")
+    digest = b"\x07" * 32
+    feed.note_checkpoint(2, digest)  # prunes the backlog to (2, head]
+
+    # Subscriber starting above the checkpoint: plain replay, no RESET.
+    frames, send = _collector()
+    feed.handle_subscribe(2, send)
+    assert [(f[0], f[2]) for f in frames] == [
+        (ship.SHIP_BATCH, 3),
+        (ship.SHIP_CHECKPOINT, 2),
+    ]
+
+    # Subscriber starting from genesis: its start predates the retained
+    # backlog, so bootstrap via RESET at the checkpoint, then the tail.
+    frames, send = _collector()
+    feed.handle_subscribe(0, send)
+    assert frames[0][:3] == (ship.SHIP_RESET, 3, 2)
+    assert frames[0][3] == digest
+    assert [(f[0], f[2]) for f in frames[1:]] == [
+        (ship.SHIP_BATCH, 3),
+        (ship.SHIP_CHECKPOINT, 2),
+    ]
+
+    # Live pushes reach both subscribers; a dead one is pruned.
+    feed.note_commit(4, "4 bb ")
+    assert frames[-1][:3] == (ship.SHIP_BATCH, 3, 4)
+
+    calls = {"n": 0}
+
+    def dead(_payload):
+        # Survives the subscribe-time replay, dies on the first live push.
+        if calls["n"]:
+            raise OSError("gone")
+        calls["n"] += 1
+
+    feed.handle_subscribe(4, dead)
+    assert feed.state()["subscribers"] == 3
+    feed.note_commit(5, "5 cc ")
+    assert feed.state()["subscribers"] == 2
+
+
+def test_observer_handlers_apply_and_checkpoint(tmp_path):
+    reg = metrics.Registry()
+    obs = Observer(1, [("127.0.0.1", 1)], tmp_path / "obs", registry=reg)
+    obs._on_batch(1, b"1 aa 0:0")
+    obs._on_batch(2, b"2 bb 0:1")
+    obs._on_batch(2, b"2 bb 0:1")  # duplicate: filtered by sequence
+    blob = b"snapshot-state"
+    digest = obs.snapstore.save(blob)
+    obs._on_checkpoint(2, digest)
+    obs.close()
+
+    assert (tmp_path / "obs" / "commits.log").read_text() == (
+        "1 aa 0:0\n2 bb 0:1\n"
+    )
+    assert (tmp_path / "obs" / "checkpoints.log").read_text() == (
+        f"2 {digest.hex()}\n"
+    )
+
+    # A restart resumes from the journal: same state, nothing re-applied.
+    again = Observer(1, [("127.0.0.1", 1)], tmp_path / "obs",
+                     registry=metrics.Registry())
+    assert again.applied_seq == 2
+    assert again.stable_checkpoint == (2, digest)
+    again.close()
+
+
+# --------------------------------------------------------------------------
+# Real multi-process deployments
+# --------------------------------------------------------------------------
+
+
+def test_sharded_two_group_smoke(tmp_path):
+    """Two groups x two nodes, one process each: disjoint client orders,
+    exactly-once commits, a healed redirect, and a clean per-group
+    doctor — the tentpole acceptance run."""
+    from mirbft_tpu.tools.mircat import doctor_sharded
+    from mirbft_tpu.tools.mirnet import run_sharded_deployment
+
+    res = run_sharded_deployment(
+        root_dir=str(tmp_path), groups=2, nodes_per_group=2,
+        reqs_per_group=4, timeout_s=90,
+    )
+    assert res["unique_reqs_total"] == 8
+    assert all(count >= 4 for count in res["per_group_commits"].values())
+    assert len(set(res["client_ids"])) == 2
+    # The misrouted probe was redirected exactly once and then accepted.
+    assert res["redirects_followed"] >= 1
+    assert res["router_redirects"] >= 1
+    assert res["group_commits_total"] > 0
+
+    report = doctor_sharded([str(tmp_path)])
+    assert set(report["per_group"]) == {"group-0", "group-1"}
+    assert report["healthy"], report["faults"]
+
+
+def test_sharded_cohost_multiplexes_one_connection(tmp_path):
+    """Cohost layout: one process per host index serves its node of
+    every group, one client connection multiplexes both groups through
+    the group envelope — no redirects needed or taken."""
+    from mirbft_tpu.tools.mirnet import run_sharded_deployment
+
+    res = run_sharded_deployment(
+        root_dir=str(tmp_path), groups=2, nodes_per_group=2,
+        reqs_per_group=4, layout="cohost", timeout_s=90,
+    )
+    assert res["unique_reqs_total"] == 8
+    assert res["redirects_followed"] == 0
+
+
+def test_observer_bootstraps_and_reaches_bit_identity(tmp_path):
+    """A late observer per group (spawned after all traffic committed,
+    history pruned past several checkpoints) must bootstrap over the
+    KIND_SNAPSHOT plane and reach byte-identical journal + checkpoint
+    state."""
+    from mirbft_tpu.tools import mirnet
+
+    res = mirnet.run_sharded_deployment(
+        root_dir=str(tmp_path), groups=2, nodes_per_group=2,
+        reqs_per_group=25, observers_per_group=1, timeout_s=120,
+    )
+    assert res["unique_reqs_total"] == 50
+    for g in range(2):
+        state = res["observers"][f"{g}/0"]
+        # The lag gauge snapshot may trail the disk state by one metrics
+        # interval; bit-identity below is the authoritative sync check.
+        assert state["lag"] is None or state["lag"] <= 1.0
+        assert mirnet.observer_identity_problems(tmp_path, g, 0) == []
+        prom = tmp_path / f"group-{g}" / "observer-0" / "metrics.prom"
+        # Nonzero transfer bytes prove the snapshot bootstrap actually
+        # ran (the backlog was pruned past the observer's start).
+        assert mirnet._metric_file_value(
+            prom, "snapshot_transfer_bytes_total"
+        ) > 0
+        assert mirnet._metric_file_value(
+            prom, "observer_checkpoints_total"
+        ) > 0
+
+
+@pytest.mark.slow
+def test_cross_group_partition_scenario(tmp_path):
+    """Shard isolation as a doctor-judged verdict: partition one group's
+    node — the other group keeps committing while the partitioned group
+    freezes, then heals and resumes."""
+    from mirbft_tpu.tools.mirnet import run_scenario
+
+    doc = run_scenario("cross-group-partition", root_dir=str(tmp_path))
+    assert doc["verdict"] == "pass", doc.get("failures")
