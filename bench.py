@@ -1732,6 +1732,97 @@ def bench_sharded(detail, reqs_per_group=30, nodes_per_group=2,
     detail["c6_scaling_ratio"] = round(rates[2] / max(rates[1], 1e-9), 2)
 
 
+def bench_fleet_scrape(detail, cycles=20, events_per_cycle=200,
+                       interval_s=1.0):
+    """Fleet-plane cost accounting (fleet.py, docs/OBSERVABILITY.md
+    "Fleet plane"): one full TEL_PULL/TEL_REPORT scrape cycle — child
+    report build (metrics snapshot + trace-ring drain), wire encode +
+    decode, collector ingest, history append, and the rolling
+    latest/history/trace flush — against a node-shaped registry (dozens
+    of instruments, loaded histograms) and a busy tracer emitting
+    ``events_per_cycle`` spans per collector interval.  Socketless on
+    purpose: the quantity is CPU overhead, not loopback latency.
+
+    On record: ``fleet_scrape_cycle_ms`` (mean cycle cost) and
+    ``fleet_scrape_overhead_pct`` (cycle cost as a share of the
+    collector's default 1 s interval; the amortized trace.json cadence
+    is part of what it measures).  Guard: the overhead must stay under
+    2% — observability that taxes the observed plane measurably is a
+    regression, not a feature."""
+    import shutil
+    import tempfile
+
+    from mirbft_tpu import fleet as fleet_mod
+    from mirbft_tpu import metrics as metrics_mod
+    from mirbft_tpu import tracing
+    from mirbft_tpu.net import telemetry
+
+    # A node-shaped registry: the instrument mix a busy member carries.
+    reg = metrics_mod.Registry()
+    for i in range(40):
+        reg.counter(f"bench_fleet_c{i}", labels={"node": "0"}).inc(i)
+    for i in range(8):
+        reg.gauge(f"bench_fleet_g{i}").set(float(i))
+    for i in range(12):
+        h = reg.histogram(f"bench_fleet_h{i}", labels={"node": "0"})
+        for j in range(512):
+            h.observe(j * 1e-4)
+    trc = tracing.Tracer(capacity=65536, enabled=True)
+
+    out_dir = tempfile.mkdtemp(prefix="bench-fleet-")
+    try:
+        collector = fleet_mod.FleetCollector(
+            out_dir,
+            [{"group": 0, "node": "g0n0", "host": "127.0.0.1", "port": 1}],
+            registry=metrics_mod.Registry(),
+        )
+        ep = collector._endpoints[0]
+        cursor = 0
+
+        def cycle():
+            nonlocal cursor
+            t0 = tracing.wall_clock_us()
+            report = fleet_mod.build_report(
+                0, "g0n0", cursor, registry=reg, tracer=trc
+            )
+            payload = telemetry.encode_report(0, int(t0), report)
+            _sub, _node, echo_t0, body = telemetry.decode(payload)
+            collector.ingest_report(
+                ep, float(echo_t0), tracing.wall_clock_us(),
+                telemetry.decode_body(body),
+            )
+            cursor = ep.cursor
+            collector._record_history()
+            collector.flush()
+
+        # Warm-up drains the pre-filled ring and warms the file paths.
+        for _ in range(events_per_cycle):
+            trc.complete("request_commit", 0.0, 50_000.0, pid=0, tid=7,
+                         args={"trace": "ab" * 8, "seq_no": 1})
+        cycle()
+
+        elapsed = 0.0
+        for _ in range(cycles):
+            for _ in range(events_per_cycle):
+                trc.complete("request_commit", 0.0, 50_000.0, pid=0,
+                             tid=7, args={"trace": "ab" * 8, "seq_no": 1})
+            t0 = time.perf_counter()
+            cycle()
+            elapsed += time.perf_counter() - t0
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+    mean_s = elapsed / cycles
+    overhead_pct = 100.0 * mean_s / interval_s
+    detail["fleet_scrape_cycle_ms"] = round(mean_s * 1e3, 3)
+    detail["fleet_scrape_overhead_pct"] = round(overhead_pct, 3)
+    if overhead_pct >= 2.0:
+        raise RuntimeError(
+            f"fleet scrape overhead {overhead_pct:.2f}% of the "
+            f"{interval_s}s collector interval breaches the 2% budget"
+        )
+
+
 def guard_pipeline_planes(detail):
     """The pipeline must not tax the planes it composes, and the pipelined
     headline must hold what it won: this run's ``wal_append_mb_s``,
@@ -2068,6 +2159,11 @@ def main():
         bench_sharded(detail)
     except Exception as exc:
         detail["sharded_error"] = f"{type(exc).__name__}: {exc}"[:160]
+    try:
+        # Fleet observability plane: scrape-cycle cost + the <2% guard.
+        bench_fleet_scrape(detail)
+    except Exception as exc:
+        detail["fleet_scrape_error"] = f"{type(exc).__name__}: {exc}"[:160]
     try:
         # Regression guard: the pipeline must not tax the planes it
         # composes (keys above are already recorded either way).

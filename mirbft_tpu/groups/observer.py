@@ -27,12 +27,14 @@ snapshots, tests) tolerate a stale view, so the observer needs no locks.
 
 from __future__ import annotations
 
+import json
 import socket
 import threading
 from pathlib import Path
 from typing import List, Optional, Tuple
 
 from .. import metrics as metrics_mod
+from .. import tracing
 from ..net.framing import KIND_GROUP, FrameDecoder, encode_frame
 from . import ship
 
@@ -124,10 +126,33 @@ class Observer:
 
     def _on_batch(self, seq: int, line: bytes) -> None:
         self.head_seq = max(self.head_seq, seq)
+        # A NUL separates the journal line from the optional trace-id
+        # trailer (ship.ShipFeed.note_commit); only the line part lands in
+        # commits.log so it stays byte-identical to the members'.
+        line, _, trailer = line.partition(b"\x00")
         if seq > self.applied_seq:
+            start = tracing.default_tracer.now()
             self._commits.write(line.decode() + "\n")
             self.applied_seq = seq
             self._applied.inc()
+            if tracing.default_tracer.enabled:
+                args = {"seq_no": seq}
+                if trailer:
+                    try:
+                        traces = json.loads(trailer.decode())
+                    except ValueError:
+                        traces = {}
+                    if traces:
+                        args["traces"] = traces
+                        if len(traces) == 1:
+                            args["trace"] = next(iter(traces.values()))
+                tracing.default_tracer.complete(
+                    "observer_apply",
+                    start,
+                    pid=self.group_id,
+                    tid=0,
+                    args=args,
+                )
         self._lag.set(max(0, self.head_seq - self.applied_seq))
 
     def _on_checkpoint(self, seq: int, digest: bytes) -> None:

@@ -58,6 +58,22 @@ def group_for_client(client_id: int, num_groups: int) -> int:
     return int.from_bytes(digest[:8], "big") % num_groups
 
 
+_TRACE_INPUT = struct.Struct(">QQ")
+
+
+def trace_id_for(client_id: int, req_no: int) -> int:
+    """Deterministic nonzero u64 fleet trace id for one client request.
+
+    Derived (sha256 over the identity, low bit forced) rather than drawn
+    at random so the id survives redirects and resubmission without any
+    coordination — every retry of the same request stamps the same id,
+    and tests can predict it (docs/OBSERVABILITY.md "Fleet plane")."""
+    digest = hashlib.sha256(
+        b"trace" + _TRACE_INPUT.pack(client_id, req_no)
+    ).digest()
+    return int.from_bytes(digest[:8], "big") | 1
+
+
 def client_for_group(group_id: int, num_groups: int, start: int = 0) -> int:
     """Smallest client id >= ``start`` that hashes to ``group_id`` —
     the deployment harness picks per-group client identities with it."""
@@ -209,6 +225,9 @@ class RoutedClient:
         default rotates by attempt.  Redirect replies update the map and
         retry; connection errors rotate to the next member."""
         body = CLIENT_REQ.pack(req_no) + data
+        # One id for the request's whole lifetime: redirects and retries
+        # re-stamp the same value, so downstream spans always join.
+        trace_id = trace_id_for(client_id, req_no)
         last_err: Optional[Exception] = None
         group_id = 0
         for attempt in range(self.attempts):
@@ -216,7 +235,8 @@ class RoutedClient:
             # map (and with it the group count and membership).
             group_id = group_for_client(client_id, self.map.num_groups)
             frame = encode_frame(
-                KIND_CLIENT, encode_client_envelope(group_id, body)
+                KIND_CLIENT,
+                encode_client_envelope(group_id, body, trace_id=trace_id),
             )
             members = self.map.members(group_id)
             idx = member if member is not None else attempt

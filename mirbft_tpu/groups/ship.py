@@ -29,6 +29,7 @@ round-trip through :func:`encode`/:func:`decode`.
 
 from __future__ import annotations
 
+import json
 import struct
 import threading
 from typing import Callable, List, Optional, Tuple
@@ -162,9 +163,16 @@ class ShipFeed:
                 dead.append(send)
         return dead
 
-    def note_commit(self, seq: int, line: str) -> None:
+    def note_commit(self, seq: int, line: str, trace=None) -> None:
+        """Ship one committed batch line.  ``trace`` optionally maps
+        ``"client:req"`` -> trace id hex; it rides after the line behind a
+        NUL separator (a commit line is space-separated hex/decimal text,
+        so NUL can never appear in it) and observers strip it before
+        writing ``commits.log`` — byte identity with members is kept."""
         self._commits.inc()
         data = line.encode()
+        if trace:
+            data += b"\x00" + json.dumps(trace, sort_keys=True).encode()
         with self._lock:
             self._tail.append((seq, data))
             self._head_seq = max(self._head_seq, seq)

@@ -149,6 +149,12 @@ class Registry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        # Highest value ever reported per monotonic key (counters and
+        # histogram _count/_sum): snapshot() clamps to these so a scrape
+        # racing an unlocked `value += delta` (a torn read-modify-write
+        # can briefly publish a stale lower value) never shows a counter
+        # going backward across two snapshots.
+        self._last_mono: Dict[str, float] = {}
 
     def counter(
         self, name: str, labels: Optional[Dict[str, str]] = None
@@ -208,17 +214,27 @@ class Registry:
         properly-labeled exposition surface."""
         counters, gauges, histograms = self._instruments()
         out: Dict[str, float] = {}
-        for c in counters:
-            out[c.name + format_labels(c.labels)] = c.value
+
+        def mono(key: str, value: float) -> float:
+            prev = self._last_mono.get(key)
+            if prev is not None and value < prev:
+                return prev
+            self._last_mono[key] = value
+            return value
+
+        with self._lock:
+            for c in counters:
+                key = c.name + format_labels(c.labels)
+                out[key] = mono(key, c.value)
+            for h in histograms:
+                key = h.name + format_labels(h.labels)
+                out[f"{key}_count"] = mono(f"{key}_count", h.total_count)
+                out[f"{key}_sum"] = mono(f"{key}_sum", h.total_sum)
+                out[f"{key}_mean"] = h.mean()
+                out[f"{key}_p50"] = h.percentile(50)
+                out[f"{key}_p99"] = h.percentile(99)
         for g in gauges:
             out[g.name + format_labels(g.labels)] = g.value
-        for h in histograms:
-            key = h.name + format_labels(h.labels)
-            out[f"{key}_count"] = h.total_count
-            out[f"{key}_sum"] = h.total_sum
-            out[f"{key}_mean"] = h.mean()
-            out[f"{key}_p50"] = h.percentile(50)
-            out[f"{key}_p99"] = h.percentile(99)
         return out
 
     def reset(self) -> None:
@@ -226,6 +242,7 @@ class Registry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+            self._last_mono.clear()
 
 
 def _fmt_value(value: float) -> str:

@@ -36,12 +36,15 @@ FRAME_HEADER_LEN = 12
 # carries one snapshot state-transfer subframe — request, chunk, or
 # missing (storage/snapshot.py); KIND_GROUP carries one sharding-plane
 # subframe — group-map discovery or committed-batch log shipping
-# (groups/ship.py, docs/SHARDING.md).
+# (groups/ship.py, docs/SHARDING.md); KIND_TELEMETRY carries one fleet
+# observability subframe — metrics/trace pull, report, or trace-binding
+# announce (net/telemetry.py, docs/OBSERVABILITY.md "Fleet plane").
 KIND_HANDSHAKE = 0
 KIND_MSG = 1
 KIND_CLIENT = 2
 KIND_SNAPSHOT = 3
 KIND_GROUP = 4
+KIND_TELEMETRY = 5
 
 # Upper bound on a single payload.  Generous against the largest legitimate
 # protocol message (a MsgBatch of a full iteration's sends), tight against
@@ -112,6 +115,7 @@ class FrameDecoder:
                     KIND_CLIENT,
                     KIND_SNAPSHOT,
                     KIND_GROUP,
+                    KIND_TELEMETRY,
                 ):
                     raise FrameError(f"unknown frame kind {kind}")
                 if length > self._max_payload:
@@ -153,23 +157,45 @@ class FrameDecoder:
 
 CLIENT_ENV_MAGIC = 0xC1
 CLIENT_ENV_VERSION = 1
+CLIENT_ENV_VERSION_TRACED = 2
 _CLIENT_ENV = struct.Struct(">BBI")  # magic, version, group id
+_CLIENT_ENV_TRACE = struct.Struct(">BBIQ")  # + u64 trace id (version 2)
 
 
-def encode_client_envelope(group_id: int, body: bytes) -> bytes:
-    """Wrap a client submission body with its destination group id."""
+def encode_client_envelope(
+    group_id: int, body: bytes, trace_id: int = 0
+) -> bytes:
+    """Wrap a client submission body with its destination group id.
+
+    A nonzero ``trace_id`` upgrades the envelope to version 2, which
+    appends the 8-byte id after the group id (docs/OBSERVABILITY.md
+    "Fleet plane"); ``trace_id == 0`` emits the byte-identical version-1
+    envelope, so untraced submissions stay compatible with old decoders.
+    """
+    if trace_id:
+        return _CLIENT_ENV_TRACE.pack(
+            CLIENT_ENV_MAGIC, CLIENT_ENV_VERSION_TRACED, group_id, trace_id
+        ) + body
     return _CLIENT_ENV.pack(CLIENT_ENV_MAGIC, CLIENT_ENV_VERSION, group_id) + body
 
 
-def decode_client_envelope(payload: bytes) -> Tuple[int, bytes]:
-    """``(group_id, body)`` from a KIND_CLIENT payload; legacy payloads
-    (no envelope magic) imply group 0.  Raises :class:`FrameError` on an
+def decode_client_envelope(payload: bytes) -> Tuple[int, int, bytes]:
+    """``(group_id, trace_id, body)`` from a KIND_CLIENT payload; legacy
+    payloads (no envelope magic) imply group 0, and version-1 envelopes
+    imply trace id 0 (untraced).  Raises :class:`FrameError` on an
     envelope from a future version."""
     if len(payload) >= _CLIENT_ENV.size and payload[0] == CLIENT_ENV_MAGIC:
         _magic, version, group_id = _CLIENT_ENV.unpack_from(payload)
-        if version != CLIENT_ENV_VERSION:
-            raise FrameError(
-                f"unsupported client envelope version {version}"
+        if version == CLIENT_ENV_VERSION:
+            return group_id, 0, payload[_CLIENT_ENV.size:]
+        if version == CLIENT_ENV_VERSION_TRACED:
+            if len(payload) < _CLIENT_ENV_TRACE.size:
+                raise FrameError("truncated traced client envelope")
+            _m, _v, group_id, trace_id = _CLIENT_ENV_TRACE.unpack_from(
+                payload
             )
-        return group_id, payload[_CLIENT_ENV.size:]
-    return 0, payload
+            return group_id, trace_id, payload[_CLIENT_ENV_TRACE.size:]
+        raise FrameError(
+            f"unsupported client envelope version {version}"
+        )
+    return 0, 0, payload

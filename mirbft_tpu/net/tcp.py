@@ -52,6 +52,7 @@ from .framing import (
     KIND_HANDSHAKE,
     KIND_MSG,
     KIND_SNAPSHOT,
+    KIND_TELEMETRY,
     encode_frame,
 )
 
@@ -162,6 +163,7 @@ class TcpTransport:
         self._on_client: Optional[Callable[[bytes, Callable], None]] = None
         self._on_snapshot: Optional[Callable[[bytes], Optional[bytes]]] = None
         self._on_group: Optional[Callable[[bytes, Callable], None]] = None
+        self._on_telemetry: Optional[Callable[[bytes, Callable], None]] = None
         self._stop = threading.Event()
         self._threads: list = []
         self._conns: list = []
@@ -171,6 +173,13 @@ class TcpTransport:
         self._rx_bytes = metrics_mod.counter("net_rx_bytes_total")
         self._tx_dropped = metrics_mod.counter("net_tx_dropped_total")
         self._reconnects = metrics_mod.counter("net_reconnects_total")
+        # Wait to acquire a reader connection's send lock: reply traffic
+        # and ship-feed pushes contend on it, and this histogram is the
+        # measured answer to whether that contention matters
+        # (docs/OBSERVABILITY.md, ROADMAP item 3).
+        self._send_lock_wait = metrics_mod.histogram(
+            "net_send_lock_wait_seconds"
+        )
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -190,6 +199,7 @@ class TcpTransport:
         on_client: Optional[Callable[[bytes, Callable], None]] = None,
         on_snapshot: Optional[Callable[[bytes], Optional[bytes]]] = None,
         on_group: Optional[Callable[[bytes, Callable], None]] = None,
+        on_telemetry: Optional[Callable[[bytes, Callable], None]] = None,
     ) -> None:
         """Begin accepting and dialing.  ``on_message(source, msg)`` is
         invoked on reader threads for every inbound protocol message (the
@@ -200,11 +210,14 @@ class TcpTransport:
         state-transfer requests (storage/snapshot.py); ``on_group(payload,
         send)`` handles KIND_GROUP sharding-plane frames — ``send(payload)``
         answers (and may keep answering: log-ship subscriptions hold the
-        connection open) on the same connection (groups/ship.py)."""
+        connection open) on the same connection (groups/ship.py);
+        ``on_telemetry(payload, send)`` handles KIND_TELEMETRY fleet
+        observability frames the same way (net/telemetry.py)."""
         self._on_message = on_message
         self._on_client = on_client
         self._on_snapshot = on_snapshot
         self._on_group = on_group
+        self._on_telemetry = on_telemetry
         accept = threading.Thread(
             target=self._accept_loop,
             name=f"net{self.node_id}-accept",
@@ -433,17 +446,22 @@ class TcpTransport:
         # send on this connection goes through one lock.
         send_lock = threading.Lock()
 
-        def reply(payload: bytes) -> None:
-            frame = encode_frame(KIND_CLIENT, payload)
+        def locked_send(kind: int, payload: bytes) -> None:
+            frame = encode_frame(kind, payload)
+            t0 = time.perf_counter()
             with send_lock:
+                self._send_lock_wait.observe(time.perf_counter() - t0)
                 conn.sendall(frame)
             self._tx_bytes.inc(len(frame))
 
+        def reply(payload: bytes) -> None:
+            locked_send(KIND_CLIENT, payload)
+
         def group_send(payload: bytes) -> None:
-            frame = encode_frame(KIND_GROUP, payload)
-            with send_lock:
-                conn.sendall(frame)
-            self._tx_bytes.inc(len(frame))
+            locked_send(KIND_GROUP, payload)
+
+        def telemetry_send(payload: bytes) -> None:
+            locked_send(KIND_TELEMETRY, payload)
 
         try:
             while not self._stop.is_set():
@@ -485,6 +503,11 @@ class TcpTransport:
                             self._log_drop("unexpected group frame")
                             return
                         self._on_group(payload, group_send)
+                    elif kind == KIND_TELEMETRY:
+                        if self._on_telemetry is None:
+                            self._log_drop("unexpected telemetry frame")
+                            return
+                        self._on_telemetry(payload, telemetry_send)
         except FrameError as exc:
             self._log_drop(f"frame error from peer {source}: {exc}")
         except Exception as exc:  # decode error, stopped node, ...

@@ -30,8 +30,9 @@ from __future__ import annotations
 
 import queue
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from . import health as health_mod
 from . import metrics as metrics_mod
@@ -186,6 +187,20 @@ class Node:
         self.span_tracker = tracing.CommitSpanTracker(
             tracing.default_tracer, node_id
         )
+        # Fleet trace-id bindings (docs/OBSERVABILITY.md "Fleet plane"):
+        # (client_id, req_no) -> u64 id, learned from traced client
+        # envelopes served locally or TEL_ANNOUNCE pushes from peers.
+        # Bounded LRU-ish: oldest binding evicted past the cap.  Writers
+        # are transport reader threads and readers the result worker; dict
+        # ops are atomic under the GIL and a stale miss only costs one
+        # span its trace tag, so no lock.
+        self._trace_bindings: "OrderedDict[Tuple[int, int], int]" = (
+            OrderedDict()
+        )
+        self._trace_bindings_total = metrics_mod.counter(
+            "trace_bindings_total"
+        )
+        self.span_tracker.trace_resolver = self.trace_id_of
         # Protocol health plane (docs/OBSERVABILITY.md): the event stream
         # feeds it on the result worker, periodic status snapshots on the
         # coordinator (every tick, whenever no state-machine batch is in
@@ -288,6 +303,28 @@ class Node:
             health_monitor=self.health_monitor,
             admission=self.scheduler.admission,
         )
+
+    # --- fleet trace bindings (docs/OBSERVABILITY.md "Fleet plane") ---
+
+    _TRACE_BINDINGS_CAP = 8192
+
+    def note_trace(self, client_id: int, req_no: int, trace_id: int) -> None:
+        """Record a ``(client, req) -> trace id`` binding so the commit
+        span this node eventually emits carries the fleet trace id."""
+        if not trace_id:
+            return
+        key = (client_id, req_no)
+        if key not in self._trace_bindings:
+            self._trace_bindings_total.inc()
+        self._trace_bindings[key] = trace_id
+        while len(self._trace_bindings) > self._TRACE_BINDINGS_CAP:
+            try:
+                self._trace_bindings.popitem(last=False)
+            except KeyError:
+                break
+
+    def trace_id_of(self, client_id: int, req_no: int) -> Optional[int]:
+        return self._trace_bindings.get((client_id, req_no))
 
     def tick(self) -> None:
         self.inbox.put(("tick", None))

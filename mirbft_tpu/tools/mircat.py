@@ -87,7 +87,7 @@ def _parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     )
     parser.add_argument(
         "log",
-        nargs="+",
+        nargs="*",
         help="gzip event log file, or (with --doctor) one or more "
         "deployment directories; a sharded root containing group-* "
         "subdirectories expands to one doctor run per group",
@@ -147,6 +147,20 @@ def _parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         "segments offline (record CRCs, index continuity, torn-tail "
         "report); exits 1 on problems",
     )
+    parser.add_argument(
+        "--fleet",
+        metavar="DIR",
+        help="report on a fleet collector output directory (a mirnet "
+        "--fleet run's <root>/fleet/, or the root itself): cross-group "
+        "SLO table, per-node vitals, trend findings",
+    )
+    parser.add_argument(
+        "--trace-id",
+        metavar="HEX",
+        help="with --fleet: print the causal timeline of one request — "
+        "every span in the merged fleet trace carrying this trace id, "
+        "in aligned-clock order",
+    )
     return parser.parse_args(argv)
 
 
@@ -203,6 +217,46 @@ def _node_prom(node_dir: Path, name: str) -> List[Tuple[Dict[str, str], float]]:
     return parse_prom_samples(path.read_text(), name)
 
 
+def _fleet_node_traces(root: Path, group_id) -> Dict[int, List[str]]:
+    """Best-effort fault attribution from the fleet plane: for each node
+    of ``group_id``, the trace ids of the most recent request spans on
+    that node in the merged fleet trace (``fleet/trace.json`` beside or
+    above the deployment dir).  Empty when no collector ran."""
+    if group_id is None:
+        return {}
+    trace_path = None
+    for candidate in (root / "fleet", root.parent / "fleet"):
+        if (candidate / "trace.json").exists():
+            trace_path = candidate / "trace.json"
+            break
+    if trace_path is None:
+        return {}
+    try:
+        doc = json.loads(trace_path.read_text())
+    except ValueError:
+        return {}
+    per_node: Dict[int, List[Tuple[float, str]]] = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("pid") != group_id or ev.get("ph") == "M":
+            continue
+        trace = (ev.get("args") or {}).get("trace")
+        if not trace:
+            continue
+        per_node.setdefault(int(ev.get("tid", 0)), []).append(
+            (float(ev.get("ts", 0.0)), str(trace))
+        )
+    out: Dict[int, List[str]] = {}
+    for node_id, stamped in per_node.items():
+        stamped.sort()
+        seen: List[str] = []
+        for _ts, trace in stamped:
+            if trace in seen:
+                seen.remove(trace)
+            seen.append(trace)
+        out[node_id] = seen[-3:]
+    return out
+
+
 def doctor_deployment(
     root, thresholds: Optional[HealthThresholds] = None
 ) -> dict:
@@ -232,6 +286,7 @@ def doctor_deployment(
     if thresholds is None:
         thresholds = HealthThresholds.from_dict(cluster.get("thresholds") or {})
     num_nodes = cluster.get("node_count")
+    node_traces = _fleet_node_traces(root, cluster.get("group_id"))
 
     per_node: Dict[int, dict] = {}
     aggregate_faults: Dict[str, float] = {}
@@ -310,6 +365,7 @@ def doctor_deployment(
             "boots": boots,
             "stall_windows": report["stall_windows"],
             "observations": report["observations"],
+            "recent_traces": node_traces.get(node_id, []),
         }
 
     healthy = total_anomalies == 0 and not aggregate_faults
@@ -334,9 +390,13 @@ def _print_deployment_report(report: dict) -> None:
         )
         for kind in node["anomaly_kinds"]:
             print(f"  anomaly kind: {kind}")
+        # The trace column: the requests most recently in flight on this
+        # node per the fleet trace — what a fault likely interrupted.
+        traces = node.get("recent_traces") or []
+        trace_col = f" trace={traces[-1]}" if traces else ""
         for key, count in node["faults"].items():
             peer, kind = key.split(":", 1)
-            print(f"  fault: peer {peer} {kind} x{count:g}")
+            print(f"  fault: peer {peer} {kind} x{count:g}{trace_col}")
     for line in report["truncated_logs"]:
         print(f"truncated log (tolerated): {line}")
     print(
@@ -440,8 +500,100 @@ def _print_wal_report(report: dict) -> None:
         print("no problems found")
 
 
+# ---------------------------------------------------------------------------
+# Fleet query surface: SLO tables and per-request causal timelines
+# ---------------------------------------------------------------------------
+
+
+def _fleet_dir(path: Path) -> Path:
+    """Accept the deployment root or the ``fleet/`` directory itself."""
+    if (path / "fleet" / "latest.json").exists():
+        return path / "fleet"
+    return path
+
+
+def _fmt_cell(value) -> str:
+    return "-" if value is None else f"{value:g}"
+
+
+def fleet_report(fleet_dir, trace_id: Optional[str] = None) -> int:
+    """``--fleet``: print the cross-group SLO table, trend findings, and
+    (with ``--trace-id``) one request's causal timeline from the merged
+    fleet trace.  Exits 2 when the directory has no collector output."""
+    from .. import fleet as fleet_mod
+
+    root = _fleet_dir(Path(fleet_dir))
+    doc = fleet_mod.load_fleet(root)
+    if not doc["latest"] and not doc["history"]:
+        print(f"mircat: no fleet collector output under {root}",
+              file=sys.stderr)
+        return 2
+
+    rows = fleet_mod.slo_rows(doc["history"])
+    print(f"fleet dir: {root}")
+    header = (
+        f"{'group':>5} {'commit p50 ms':>14} {'commit p99 ms':>14} "
+        f"{'obs lag':>8} {'stall p99 ms':>13} {'lock p99 ms':>12} "
+        f"{'fsync %':>8}"
+    )
+    print(header)
+    print("-" * len(header))
+    for row in rows:
+        print(
+            f"{row['group']:>5} {_fmt_cell(row['commit_p50_ms']):>14} "
+            f"{_fmt_cell(row['commit_p99_ms']):>14} "
+            f"{_fmt_cell(row['observer_lag']):>8} "
+            f"{_fmt_cell(row['admission_stall_p99_ms']):>13} "
+            f"{_fmt_cell(row['send_lock_wait_p99_ms']):>12} "
+            f"{_fmt_cell(row['wal_fsync_share_pct']):>8}"
+        )
+    if not rows:
+        print("(no history samples yet)")
+
+    findings = fleet_mod.detect_trends(doc["history"])
+    for finding in findings:
+        print(
+            f"trend: {finding['node']} {finding['kind']}: "
+            f"{finding['detail']}"
+        )
+
+    if trace_id:
+        # tid -> node label from the merged trace's thread_name metadata,
+        # so the timeline reads g0n1, not a bare thread number.
+        names: Dict[Tuple[int, int], str] = {}
+        for ev in doc["trace"].get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+                names[(ev.get("pid"), ev.get("tid"))] = (
+                    (ev.get("args") or {}).get("name", "")
+                )
+        timeline = fleet_mod.trace_timeline(doc["trace"], trace_id)
+        print(f"trace {trace_id}: {len(timeline)} spans")
+        for ev in timeline:
+            where = names.get(
+                (ev.get("pid"), ev.get("tid")),
+                f"{ev.get('pid')}/{ev.get('tid')}",
+            )
+            dur = ev.get("dur")
+            dur_txt = f" dur={dur / 1000.0:.3f}ms" if dur is not None else ""
+            print(
+                f"  {ev.get('ts', 0.0) / 1000.0:>12.3f}ms "
+                f"{where:>10} {ev.get('name')}{dur_txt}"
+            )
+        if not timeline:
+            return 1
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _parse_args(argv)
+
+    if args.fleet:
+        return fleet_report(args.fleet, trace_id=args.trace_id)
+
+    if not args.log:
+        print("mircat: need a log file or deployment directory "
+              "(or --fleet DIR)", file=sys.stderr)
+        return 2
 
     if args.wal:
         from ..storage import wal_segment_report

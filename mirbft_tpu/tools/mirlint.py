@@ -979,6 +979,15 @@ REQUIRED_METRIC_NAMES = (
     "group_commits_total",
     "router_redirects_total",
     "observer_lag_batches",
+    # Fleet observability plane (fleet.py, net/telemetry.py,
+    # docs/OBSERVABILITY.md "Fleet plane").
+    "net_send_lock_wait_seconds",
+    "fleet_pulls_total",
+    "fleet_pull_seconds",
+    "fleet_clock_offset_us",
+    "fleet_trace_events_total",
+    "fleet_trace_dropped_total",
+    "trace_bindings_total",
 )
 
 
@@ -1564,6 +1573,77 @@ def check_frame_subtypes(ship_module=None) -> List[Finding]:
     return findings
 
 
+def check_telemetry_subtypes(telemetry_module=None) -> List[Finding]:
+    """Rule id: telemetry-subtype.  The KIND_TELEMETRY registry
+    (net/telemetry.py) mirrors the frame-subtype contract: every TEL_*
+    constant named and unique in SUBTYPE_NAMES, every registered subtype
+    covered by :func:`sample_payloads`, and every sample decoding back to
+    its own subtype and re-encoding byte-identically through the 4-tuple
+    ``(subtype, node_id, clock_us, body)`` codec.
+
+    ``telemetry_module`` is injectable for tests; default is the real
+    module.
+    """
+    if telemetry_module is None:
+        from ..net import telemetry as telemetry_module
+
+    where = "mirbft_tpu/net/telemetry.py"
+    findings: List[Finding] = []
+
+    def flag(message: str) -> None:
+        findings.append(Finding(where, 0, "telemetry-subtype", message))
+
+    names = getattr(telemetry_module, "SUBTYPE_NAMES", None)
+    if not isinstance(names, dict) or not names:
+        flag("SUBTYPE_NAMES registry is missing or empty")
+        return findings
+
+    constants = {
+        attr: value
+        for attr, value in vars(telemetry_module).items()
+        if attr.startswith("TEL_") and isinstance(value, int)
+    }
+    for attr, value in sorted(constants.items()):
+        if value not in names:
+            flag(f"{attr} = {value} is not registered in SUBTYPE_NAMES")
+    for value in sorted(names):
+        if value not in constants.values():
+            flag(f"SUBTYPE_NAMES[{value}] has no matching TEL_* constant")
+    if len(set(constants.values())) != len(constants):
+        flag(f"duplicate subtype values in {sorted(constants.items())}")
+    seen_names: Dict[str, int] = {}
+    for value, name in names.items():
+        if not _SNAKE_CASE.match(name):
+            flag(f"subtype name {name!r} is not snake_case")
+        if name in seen_names:
+            flag(f"subtype name {name!r} used by {seen_names[name]} and {value}")
+        seen_names[name] = value
+
+    try:
+        samples = telemetry_module.sample_payloads()
+    except Exception as exc:  # noqa: BLE001 — report, don't crash lint
+        flag(f"sample_payloads() raised: {exc}")
+        return findings
+    for value, name in sorted(names.items()):
+        if value not in samples:
+            flag(f"sample_payloads() does not cover {name} ({value})")
+    for value, payload in sorted(samples.items()):
+        try:
+            subtype, node_id, clock_us, body = telemetry_module.decode(payload)
+        except Exception as exc:  # noqa: BLE001
+            flag(f"sample for subtype {value} does not decode: {exc}")
+            continue
+        if subtype != value:
+            flag(
+                f"sample registered under subtype {value} decodes as "
+                f"{subtype}"
+            )
+            continue
+        if telemetry_module.encode(subtype, node_id, clock_us, body) != payload:
+            flag(f"subtype {value} re-encode is not byte-identical")
+    return findings
+
+
 def wire_pass(root: Path) -> List[Finding]:
     pkg = root / "mirbft_tpu"
     findings = wire_static_pass(
@@ -1576,6 +1656,7 @@ def wire_pass(root: Path) -> List[Finding]:
     if root == repo_root():
         findings += wire_dynamic_pass()
         findings += check_frame_subtypes()
+        findings += check_telemetry_subtypes()
     return findings
 
 

@@ -157,6 +157,7 @@ def _write_cluster(
     group_id: Optional[int] = None,
     num_groups: int = 1,
     group_map: Optional[dict] = None,
+    fleet: bool = False,
 ) -> None:
     """``cluster.json``: everything a child needs to boot.  The fault
     plane keys are optional — plain deployments (``run_deployment``) leave
@@ -182,6 +183,10 @@ def _write_cluster(
         "unreachable_after_s": unreachable_after_s,
         "pipeline": pipeline,
         "schedule": "pipelined" if pipeline else "classic",
+        # Fleet observability (docs/OBSERVABILITY.md "Fleet plane"):
+        # children enable the process tracer and serve KIND_TELEMETRY
+        # pulls; committed batches ship trace-id trailers to observers.
+        "fleet": fleet,
     }
     if group_id is not None:
         doc["group_id"] = int(group_id)
@@ -265,6 +270,10 @@ class _CommitLogApp:
         self.feed = feed
         self._checkpoint_log = checkpoint_log
         self._last_seq = 0
+        # Optional (client_id, req_no) -> trace id lookup (fleet mode):
+        # shipped batches then carry the trace trailer observers strip
+        # before journaling, which keeps commits.log byte-identical.
+        self.trace_lookup = None
 
     def apply(self, entry) -> None:
         reqs = ",".join(f"{r.client_id}:{r.req_no}" for r in entry.requests)
@@ -273,7 +282,16 @@ class _CommitLogApp:
             self._file.write(line + "\n")
             self._last_seq = entry.seq_no
         if self.feed is not None:
-            self.feed.note_commit(entry.seq_no, line)
+            trace = None
+            if self.trace_lookup is not None:
+                trace = {}
+                for r in entry.requests:
+                    trace_id = self.trace_lookup(r.client_id, r.req_no)
+                    if trace_id:
+                        trace[f"{r.client_id}:{r.req_no}"] = (
+                            "%016x" % trace_id
+                        )
+            self.feed.note_commit(entry.seq_no, line, trace=trace or None)
 
     def snap(self, network_config, client_states):
         import hashlib
@@ -372,6 +390,15 @@ class _Instance:
         ports: Dict[int, int] = {
             int(k): v for k, v in cluster["ports"].items()
         }
+        self._peer_ids = [pid for pid in ports if pid != node_id]
+        self.fleet = bool(cluster.get("fleet"))
+        if self.fleet:
+            from mirbft_tpu import tracing
+
+            # Fleet mode turns the process tracer on so commit spans land
+            # in the ring the collector drains.  Idempotent: cohost
+            # layouts boot several instances in one process.
+            tracing.default_tracer.enabled = True
         network_state = standard_initial_network_state(
             node_count, *self.client_ids
         )
@@ -500,6 +527,13 @@ class _Instance:
         self.transport.health_monitor = self.node.health_monitor
         self._network_state = network_state
         self.metrics_path = ndir / "metrics.prom"
+        self.node_label = (
+            f"g{self.group_id}n{node_id}"
+            if self.group_id is not None
+            else f"n{node_id}"
+        )
+        if self.fleet:
+            self.app.trace_lookup = self.node.trace_id_of
 
     # --- wire surfaces ---
 
@@ -509,20 +543,66 @@ class _Instance:
         except Exception:
             pass  # node stopping; the reader connection just drops
 
-    def serve_client(self, body: bytes, reply) -> None:
+    def serve_client(self, body: bytes, reply, trace_id: int = 0) -> None:
         """Propose one de-enveloped client submission on this instance and
-        ack it on the requester's connection."""
+        ack it on the requester's connection.  A traced envelope binds the
+        id locally and announces it to group peers (best-effort) so every
+        replica's commit span carries the request's trace id."""
+        from mirbft_tpu import tracing
+
         (req_no,) = _CLIENT_REQ.unpack_from(body)
         data = body[_CLIENT_REQ.size :]
+        client_id = self.client_ids[0]
+        if trace_id:
+            self.node.note_trace(client_id, req_no, trace_id)
+            if self.fleet:
+                self._announce_trace(client_id, req_no, trace_id)
+        tracer = tracing.default_tracer
+        start = tracer.now() if tracer.enabled else 0.0
         deadline = time.monotonic() + _PROPOSE_RETRY_S
         while time.monotonic() < deadline:
             try:
-                self.node.client(self.client_ids[0]).propose(req_no, data)
+                self.node.client(client_id).propose(req_no, data)
+                if tracer.enabled:
+                    # The routing tier's own span: admission of one routed
+                    # submission on this member, under the request's fleet
+                    # trace id when the envelope carried one.
+                    args = {
+                        "client": client_id,
+                        "req_no": req_no,
+                        "group": self.group_id,
+                    }
+                    if trace_id:
+                        args["trace"] = "%016x" % trace_id
+                    tracer.complete(
+                        "route_submit",
+                        start,
+                        pid=self.group_id or 0,
+                        tid=self.node_id,
+                        args=args,
+                    )
                 reply(CLIENT_OK)
                 return
             except KeyError:
                 time.sleep(0.02)  # client window not allocated yet
         reply(CLIENT_BUSY)
+
+    def _announce_trace(
+        self, client_id: int, req_no: int, trace_id: int
+    ) -> None:
+        """Push a TEL_ANNOUNCE binding to every peer over the existing
+        protocol links (best-effort: a down peer just misses the tag)."""
+        from mirbft_tpu.net import telemetry
+        from mirbft_tpu.net.framing import KIND_TELEMETRY, encode_frame
+
+        frame = encode_frame(
+            KIND_TELEMETRY,
+            telemetry.encode_announce(
+                self.node_id, [(client_id, req_no, "%016x" % trace_id)]
+            ),
+        )
+        for pid in self._peer_ids:
+            self.transport._enqueue_frame(pid, frame)
 
     def redirect(self, reply) -> None:
         """Misrouted submission: answer with the authoritative group map
@@ -531,13 +611,13 @@ class _Instance:
         reply(CLIENT_REDIRECT + self.map_bytes)
 
     def _on_client(self, payload: bytes, reply) -> None:
-        env_group, body = self._decode_env(payload)
+        env_group, trace_id, body = self._decode_env(payload)
         if self._submit_router is not None:
-            self._submit_router(env_group, body, reply)
+            self._submit_router(env_group, body, reply, trace_id)
         elif self.group_id is not None and env_group != self.group_id:
             self.redirect(reply)
         else:
-            self.serve_client(body, reply)
+            self.serve_client(body, reply, trace_id=trace_id)
 
     def _on_group(self, payload: bytes, send) -> None:
         from mirbft_tpu.groups import ship
@@ -551,6 +631,36 @@ class _Instance:
         elif subtype == ship.SHIP_SUBSCRIBE and group == self.group_id:
             self.feed.handle_subscribe(seq, send)
 
+    def _on_telemetry(self, payload: bytes, send) -> None:
+        from mirbft_tpu import fleet as fleet_mod
+        from mirbft_tpu.net import telemetry
+
+        try:
+            subtype, _node, _clock, body = telemetry.decode(payload)
+        except ValueError:
+            return  # garbage subframe: drop, never kill the connection
+        if subtype == telemetry.TEL_PULL:
+            fleet_mod.serve_pull(
+                payload,
+                send,
+                self.group_id,
+                self.node_label,
+                node_id=self.node_id,
+            )
+        elif subtype == telemetry.TEL_ANNOUNCE:
+            try:
+                bindings = telemetry.decode_body(body).get("bindings", [])
+            except ValueError:
+                return
+            for binding in bindings:
+                try:
+                    client_id, req_no, trace_hex = binding
+                    self.node.note_trace(
+                        int(client_id), int(req_no), int(trace_hex, 16)
+                    )
+                except (ValueError, TypeError):
+                    continue
+
     # --- lifecycle ---
 
     def start(self) -> None:
@@ -561,6 +671,9 @@ class _Instance:
             on_group=(
                 self._on_group if self.group_id is not None else None
             ),
+            # Always registered: trace announces from peers must never
+            # cost a connection, and serving a pull is cheap.
+            on_telemetry=self._on_telemetry,
         )
         if self.restarting:
             self.node.restart_processing(tick_interval=0.02)
@@ -664,12 +777,12 @@ def run_host(root: Path, host_id: int) -> int:
     shard = json.loads(_shard_path(root).read_text())
     instances: Dict[int, _Instance] = {}
 
-    def router(env_group: int, body: bytes, reply) -> None:
+    def router(env_group: int, body: bytes, reply, trace_id: int = 0) -> None:
         inst = instances.get(env_group)
         if inst is None:
             next(iter(instances.values())).redirect(reply)
         else:
-            inst.serve_client(body, reply)
+            inst.serve_client(body, reply, trace_id=trace_id)
 
     for g in range(int(shard["groups"])):
         instances[g] = _Instance(
@@ -696,6 +809,25 @@ def run_observer(root: Path, group_id: int, obs_idx: int) -> int:
     odir = _observer_dir(root, group_id, obs_idx)
     obs = Observer(group_id, members, odir)
 
+    # Fleet mode: observers have no transport listener, so telemetry is
+    # served on a dedicated pre-reserved port recorded in shard.json.
+    telemetry_server = None
+    tel_port = (shard.get("observer_telemetry") or {}).get(
+        f"{group_id}:{obs_idx}"
+    )
+    if shard.get("fleet") and tel_port:
+        from mirbft_tpu import tracing
+        from mirbft_tpu.fleet import TelemetryServer
+
+        tracing.default_tracer.enabled = True
+        telemetry_server = TelemetryServer(
+            "127.0.0.1",
+            int(tel_port),
+            group_id,
+            f"g{group_id}obs{obs_idx}",
+        )
+        telemetry_server.start()
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
@@ -718,6 +850,8 @@ def run_observer(root: Path, group_id: int, obs_idx: int) -> int:
         snapshot_metrics()
         stop.wait(_METRICS_SNAPSHOT_S)
     tail.join(timeout=5)
+    if telemetry_server is not None:
+        telemetry_server.stop()
     try:
         snapshot_metrics()
     except Exception:
@@ -1115,9 +1249,14 @@ def _write_shard(
     layout: str,
     ports: List[int],
     client_ids: List[int],
+    fleet: bool = False,
+    observer_telemetry: Optional[Dict[str, int]] = None,
 ) -> GroupMap:
     """``shard.json``: the deployment-wide topology file — group count,
-    layout, the authoritative group map, and each group's home client."""
+    layout, the authoritative group map, each group's home client, and
+    (fleet deployments) the observers' telemetry listen ports keyed
+    ``"<group>:<obs_idx>"`` (members answer TEL_PULL on their transport
+    socket, observers need a dedicated listener)."""
     gmap = GroupMap(
         {
             g: [
@@ -1138,6 +1277,8 @@ def _write_shard(
                 for g in range(groups)
             },
             "client_ids": {str(g): client_ids[g] for g in range(groups)},
+            "fleet": bool(fleet),
+            "observer_telemetry": dict(observer_telemetry or {}),
         },
     )
     return gmap
@@ -1223,6 +1364,8 @@ class _ShardedCluster:
         unreachable_after_s: float = 5.0,
         timeout_s: float = 120.0,
         pipeline: bool = True,
+        fleet: bool = False,
+        fleet_observers: int = 0,
     ):
         if layout not in ("disjoint", "cohost"):
             raise ValueError(f"unknown shard layout {layout!r}")
@@ -1232,15 +1375,31 @@ class _ShardedCluster:
         self.nodes_per_group = nodes_per_group
         self.layout = layout
         self.timeout_s = timeout_s
+        self.fleet = bool(fleet)
+        self.collector = None
         # Each group's home client: the smallest id hashing to the group,
         # so disjointness across groups holds by construction.
         self.client_ids = [
             client_for_group(g, groups) for g in range(groups)
         ]
-        ports = _reserve_ports(groups * nodes_per_group)
+        # Fleet runs reserve one extra port per expected observer: the
+        # observer has no transport listener, so TEL_PULL needs a
+        # dedicated TelemetryServer port published in shard.json.
+        obs_count = groups * fleet_observers if fleet else 0
+        ports = _reserve_ports(groups * nodes_per_group + obs_count)
+        self.observer_telemetry: Dict[str, int] = {}
+        if obs_count:
+            obs_ports = ports[groups * nodes_per_group:]
+            for g in range(groups):
+                for k in range(fleet_observers):
+                    self.observer_telemetry[f"{g}:{k}"] = obs_ports[
+                        g * fleet_observers + k
+                    ]
         self.map = _write_shard(
             self.root, groups, nodes_per_group, layout, ports,
             self.client_ids,
+            fleet=self.fleet,
+            observer_telemetry=self.observer_telemetry,
         )
         map_doc = {
             str(g): [[h, p] for h, p in self.map.members(g)]
@@ -1268,6 +1427,7 @@ class _ShardedCluster:
                 group_id=g,
                 num_groups=groups,
                 group_map=map_doc,
+                fleet=self.fleet,
             )
             if faults:
                 _write_json_atomic(
@@ -1300,6 +1460,53 @@ class _ShardedCluster:
         self.procs[("obs", group_id, obs_idx)] = _spawn_observer(
             self.root, group_id, obs_idx
         )
+
+    # --- fleet telemetry ---
+
+    def fleet_endpoints(self) -> List[dict]:
+        """Every pullable telemetry endpoint: members answer TEL_PULL on
+        their transport port, observers on their dedicated port from
+        ``shard.json``."""
+        eps = []
+        for g in range(self.groups):
+            for i, (host, port) in enumerate(self.map.members(g)):
+                eps.append(
+                    {"group": g, "node": f"g{g}n{i}",
+                     "host": host, "port": port}
+                )
+        for key, port in sorted(self.observer_telemetry.items()):
+            g, k = key.split(":")
+            eps.append(
+                {"group": int(g), "node": f"g{g}obs{k}",
+                 "host": "127.0.0.1", "port": port}
+            )
+        return eps
+
+    def start_collector(self, interval_s: float = 1.0):
+        """Start the fleet collector writing ``<root>/fleet/``; no-op
+        unless the deployment was created with ``fleet=True``."""
+        if not self.fleet or self.collector is not None:
+            return self.collector
+        from mirbft_tpu import fleet as fleet_mod
+
+        self.collector = fleet_mod.FleetCollector(
+            self.root / "fleet",
+            self.fleet_endpoints(),
+            interval_s=interval_s,
+        )
+        self.collector.start()
+        return self.collector
+
+    def stop_collector(self) -> None:
+        if self.collector is not None:
+            # One last synchronous pull so the final commits land in the
+            # merged trace before the children go away.
+            try:
+                self.collector.pull_once()
+            except Exception:
+                pass
+            self.collector.stop()
+            self.collector = None
 
     def group_procs(self, g: int) -> Dict[int, subprocess.Popen]:
         if self.layout == "cohost":
@@ -1431,6 +1638,7 @@ class _ShardedCluster:
         if self._stopped:
             return
         self._stopped = True
+        self.stop_collector()
         for process in self.procs.values():
             if process.poll() is None:
                 process.terminate()
@@ -1443,6 +1651,7 @@ class _ShardedCluster:
 
     def shutdown(self) -> None:
         self._stopped = True
+        self.stop_collector()
         for process in self.procs.values():
             if process.poll() is None:
                 process.terminate()
@@ -1579,12 +1788,15 @@ def run_sharded_deployment(
     timeout_s: float = 120.0,
     pipeline: bool = True,
     probe_redirect: bool = True,
+    fleet: bool = False,
 ) -> dict:
     """Run ``groups`` independent consensus groups behind the routing
     tier and return a summary: per-group commit counts, the disjointness
     and exactly-once verdicts, redirect accounting, and (with observers)
     per-observer sync state.  Raises on timeout, divergence, cross-group
-    leakage, or duplicate commits."""
+    leakage, or duplicate commits.  ``fleet=True`` additionally runs the
+    fleet telemetry collector against every child and leaves its rolling
+    output under ``<root>/fleet/`` (docs/OBSERVABILITY.md)."""
     owned_tmp = root_dir is None
     if owned_tmp:
         root_dir = tempfile.mkdtemp(prefix="mirnet-sharded-")
@@ -1597,8 +1809,11 @@ def run_sharded_deployment(
         layout=layout,
         timeout_s=timeout_s,
         pipeline=pipeline,
+        fleet=fleet,
+        fleet_observers=observers_per_group,
     ) as cluster:
         cluster.start()
+        cluster.start_collector()
         # Map discovery over the wire, not hand-delivered configuration.
         client = _connect_routed(cluster.map.members(0)[0], timeout_s)
         try:
@@ -1637,7 +1852,25 @@ def run_sharded_deployment(
         for g in range(groups):
             cluster.wait_commits(g, reqs_per_group)
         observer_state: Dict[str, dict] = {}
+        total_reqs = reqs_per_group
         if observers_per_group:
+            for g in range(groups):
+                target = cluster.head(g)
+                for k in range(observers_per_group):
+                    wait_observer_synced(
+                        cluster.root, g, k, target, timeout_s=timeout_s
+                    )
+            if fleet:
+                # A second wave now that the observers tail the feed live:
+                # the first wave usually predates their snapshot bootstrap,
+                # so these are the batches whose trace trailers reach the
+                # observers — the merged fleet trace then carries
+                # router → members → observer spans for one request.
+                total_reqs = reqs_per_group + 2
+                for g in range(groups):
+                    cluster.submit_group(g, reqs_per_group, total_reqs)
+                for g in range(groups):
+                    cluster.wait_commits(g, total_reqs)
             for g in range(groups):
                 target = cluster.head(g)
                 for k in range(observers_per_group):
@@ -1692,7 +1925,8 @@ def run_sharded_deployment(
                 "sharded deployment failed:\n" + "\n".join(problems)
             )
         # Graceful stop first: each child flushes a final metrics
-        # snapshot, so the sums below see every commit.
+        # snapshot, so the sums below see every commit.  (stop_all runs a
+        # final collector pull while the children are still alive.)
         cluster.stop_all()
         result = {
             "root": str(cluster.root),
@@ -1716,6 +1950,8 @@ def run_sharded_deployment(
             "observers": observer_state,
             "elapsed_s": time.monotonic() - started,
         }
+        if fleet:
+            result["fleet_dir"] = str(cluster.root / "fleet")
         return result
 
 
@@ -2781,6 +3017,86 @@ def run_scenario(name: str, root_dir: Optional[str] = None,
     return SCENARIOS[name](Path(root_dir), seed, pipeline=pipeline)
 
 
+def _resolve_fleet_dir(path) -> Path:
+    """Accept either the deployment root or the ``fleet/`` dir itself."""
+    root = Path(path)
+    if (root / "fleet" / "latest.json").exists():
+        return root / "fleet"
+    return root
+
+
+def render_top(fleet_dir) -> str:
+    """One ``--top`` screen: the cross-group SLO table, per-node vitals,
+    and any trend findings, from the collector's rolling output."""
+    from mirbft_tpu import fleet as fleet_mod
+
+    doc = fleet_mod.load_fleet(fleet_dir)
+    lines = [f"mirnet --top  {fleet_dir}  {time.strftime('%H:%M:%S')}"]
+    rows = fleet_mod.slo_rows(doc["history"])
+    if rows:
+        lines.append(
+            f"{'group':>5} {'p50 ms':>8} {'p99 ms':>8} {'obs lag':>8} "
+            f"{'stall p99':>10} {'lock p99':>10} {'fsync %':>8}"
+        )
+        for row in rows:
+            def fmt(v):
+                return "-" if v is None else f"{v:g}"
+            lines.append(
+                f"{row['group']:>5} {fmt(row['commit_p50_ms']):>8} "
+                f"{fmt(row['commit_p99_ms']):>8} "
+                f"{fmt(row['observer_lag']):>8} "
+                f"{fmt(row['admission_stall_p99_ms']):>10} "
+                f"{fmt(row['send_lock_wait_p99_ms']):>10} "
+                f"{fmt(row['wal_fsync_share_pct']):>8}"
+            )
+    else:
+        lines.append("(no history yet)")
+    nodes = (doc["latest"] or {}).get("nodes") or {}
+    if nodes:
+        lines.append("")
+        lines.append(
+            f"{'node':>10} {'group':>5} {'rss kB':>9} {'fds':>5} "
+            f"{'offset us':>10} {'rtt us':>8} {'ok':>3}"
+        )
+        for label in sorted(nodes):
+            node = nodes[label]
+            lines.append(
+                f"{label:>10} {node.get('group', '-'):>5} "
+                f"{node.get('rss_kb') or '-':>9} "
+                f"{node.get('open_fds') or '-':>5} "
+                f"{node.get('offset_us', 0.0):>10.0f} "
+                f"{node.get('rtt_us', 0.0):>8.0f} "
+                f"{'y' if node.get('reachable') else 'n':>3}"
+            )
+    for finding in fleet_mod.detect_trends(doc["history"]):
+        lines.append(
+            f"trend: {finding['node']} {finding['kind']}: "
+            f"{finding['detail']}"
+        )
+    return "\n".join(lines)
+
+
+def run_top(
+    fleet_dir, interval_s: float = 1.0, iterations: Optional[int] = None
+) -> int:
+    """Live fleet view: redraw :func:`render_top` every ``interval_s``
+    until Ctrl-C (or ``iterations`` screens, for tests)."""
+    fleet_dir = _resolve_fleet_dir(fleet_dir)
+    count = 0
+    try:
+        while iterations is None or count < iterations:
+            # ANSI home+clear keeps the screen stable without curses.
+            sys.stdout.write("\x1b[H\x1b[2J" + render_top(fleet_dir) + "\n")
+            sys.stdout.flush()
+            count += 1
+            if iterations is not None and count >= iterations:
+                break
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="mirnet", description=__doc__.split("\n", 1)[0]
@@ -2824,6 +3140,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--classic", action="store_true",
                         help="run nodes on the classic depth-1 reference "
                              "schedule instead of the pipelined default")
+    parser.add_argument("--fleet", action="store_true",
+                        help="run the fleet telemetry collector against "
+                             "the deployment; its rolling output lands "
+                             "under <dir>/fleet/ (--groups runs only)")
+    parser.add_argument("--top", action="store_true",
+                        help="live fleet view over an existing --fleet "
+                             "run's output (requires --dir; Ctrl-C exits)")
     parser.add_argument("--list-scenarios", action="store_true")
     args = parser.parse_args(argv)
 
@@ -2835,6 +3158,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.pipeline and args.classic:
         parser.error("--pipeline and --classic are mutually exclusive")
     pipeline = not args.classic
+
+    if args.top:
+        if args.dir is None:
+            parser.error("--top requires --dir")
+        return run_top(args.dir)
 
     if args.node is not None:
         if args.dir is None:
@@ -2851,6 +3179,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             parser.error("--observer requires --dir and --group")
         return run_observer(Path(args.dir), args.group, args.observer)
 
+    if args.fleet and args.groups is None:
+        parser.error("--fleet requires --groups (the fleet plane is "
+                     "the sharded deployment's observability surface)")
+
     if args.groups is not None:
         result = run_sharded_deployment(
             root_dir=args.dir,
@@ -2861,6 +3193,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             observers_per_group=args.observers,
             timeout_s=args.timeout,
             pipeline=pipeline,
+            fleet=args.fleet,
         )
         print(json.dumps(result, indent=2, sort_keys=True))
         print(

@@ -30,6 +30,7 @@ Design (docs/OBSERVABILITY.md):
 from __future__ import annotations
 
 import json
+import threading
 import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
@@ -107,6 +108,14 @@ class Tracer:
         self.clock_domain = clock_domain
         self._events: Deque[Dict] = deque(maxlen=capacity)
         self._meta: List[Dict] = []
+        # Monotonic count of events ever pushed; with the ring length it
+        # yields a stable drain cursor.  The tiny lock pairs the append
+        # with the counter bump so a concurrent drain never sees one
+        # without the other (events silently lost or duplicated
+        # otherwise); emitters hold it for one append, off any sorted or
+        # serialized path.
+        self._emitted = 0
+        self._ring_lock = threading.Lock()  # mirlint: allow(lock-map) guards (_events, _emitted) pairing only
 
     def now(self) -> float:
         return float(self.clock())
@@ -115,8 +124,31 @@ class Tracer:
         return len(self._events)
 
     def clear(self) -> None:
-        self._events.clear()
+        with self._ring_lock:
+            self._events.clear()
+            self._emitted = 0
         self._meta.clear()
+
+    def _push(self, ev: Dict) -> None:
+        with self._ring_lock:
+            self._events.append(ev)
+            self._emitted += 1
+
+    def drain(self, cursor: int = 0) -> Tuple[int, List[Dict], int]:
+        """``(new_cursor, events, dropped)``: every event pushed after
+        ``cursor`` that is still in the ring, without consuming anything.
+
+        The cursor is the total-emitted count, so a collector polls with
+        the last returned cursor and gets exactly the delta; ``dropped``
+        counts events that were evicted by ring wraparound before this
+        drain saw them (cursor too old for the retained window)."""
+        with self._ring_lock:
+            emitted = self._emitted
+            events = list(self._events)
+        start = emitted - len(events)
+        skip = max(0, min(cursor, emitted) - start)
+        dropped = max(0, start - cursor)
+        return emitted, events[skip:], dropped
 
     # -- emit ---------------------------------------------------------------
 
@@ -140,7 +172,7 @@ class Tracer:
         }
         if args:
             ev["args"] = args
-        self._events.append(ev)
+        self._push(ev)
 
     def complete(
         self,
@@ -165,7 +197,7 @@ class Tracer:
         }
         if args:
             ev["args"] = args
-        self._events.append(ev)
+        self._push(ev)
 
     def counter_event(
         self,
@@ -177,7 +209,7 @@ class Tracer:
         """Chrome "C" record: Perfetto renders these as stacked counters."""
         if not self.enabled:
             return
-        self._events.append(
+        self._push(
             {
                 "name": name,
                 "ph": "C",
@@ -224,7 +256,9 @@ class Tracer:
         is *end* order, not start order; sorting by ``ts`` restores the
         monotonic start-time order viewers expect.
         """
-        events = sorted(self._events, key=lambda e: e["ts"])
+        with self._ring_lock:
+            snapshot = list(self._events)
+        events = sorted(snapshot, key=lambda e: e["ts"])
         return {
             "traceEvents": list(self._meta) + events,
             "displayTimeUnit": "ms",
@@ -286,6 +320,11 @@ class CommitSpanTracker:
         self._pending: Dict[Tuple[int, int, bytes], Dict[str, float]] = {}
         self._seen = 0
         self.committed = 0
+        # Optional (client_id, req_no) -> trace id resolver; when set (the
+        # socket runtime wires it to Node.trace_id_of) committed spans
+        # carry the fleet trace id in their args, which is what lets the
+        # fleet merge join one request's spans across processes.
+        self.trace_resolver: Optional[Callable[[int, int], Optional[int]]] = None
 
     def _mark(self, ack, phase: str) -> None:
         key = (ack.client_id, ack.req_no, ack.digest)
@@ -341,6 +380,10 @@ class CommitSpanTracker:
                     ph: rec[ph] - start for ph in _COMMIT_PHASES if ph in rec
                 },
             }
+            if self.trace_resolver is not None:
+                trace_id = self.trace_resolver(ack.client_id, ack.req_no)
+                if trace_id:
+                    args["trace"] = "%016x" % trace_id
             self.tracer.complete(
                 "request_commit",
                 start,

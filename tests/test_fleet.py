@@ -1,0 +1,380 @@
+"""Fleet observability plane (mirbft_tpu/fleet.py, net/telemetry.py,
+docs/OBSERVABILITY.md "Fleet plane").
+
+Four tiers in one file: the KIND_TELEMETRY codec, the trace-ring drain
+cursor and clock-alignment math, the collector over real localhost
+sockets against a TelemetryServer, and the query surface (SLO rows,
+trend detectors, per-request causal timelines).
+"""
+
+import json
+import threading
+
+import pytest
+
+from mirbft_tpu import fleet, metrics, tracing
+from mirbft_tpu.net import telemetry
+from mirbft_tpu.net.framing import FrameError
+
+# --------------------------------------------------------------------------
+# KIND_TELEMETRY codec
+# --------------------------------------------------------------------------
+
+
+def test_telemetry_samples_roundtrip_every_subtype():
+    samples = telemetry.sample_payloads()
+    assert set(samples) == set(telemetry.SUBTYPE_NAMES)
+    for subtype, payload in samples.items():
+        back, node_id, clock_us, body = telemetry.decode(payload)
+        assert back == subtype
+        assert telemetry.encode(back, node_id, clock_us, body) == payload
+
+
+def test_telemetry_decode_rejects_garbage():
+    with pytest.raises(FrameError):
+        telemetry.decode(b"\x01\x02")  # shorter than the header
+    with pytest.raises(FrameError):
+        telemetry.decode(b"\xff" + b"\x00" * 12)  # unknown subtype
+    with pytest.raises(FrameError):
+        telemetry.encode(201, 0, 0)
+    with pytest.raises(FrameError):
+        telemetry.decode_body(b"not json")
+    with pytest.raises(FrameError):
+        telemetry.decode_body(b"[1, 2]")  # JSON but not an object
+    assert telemetry.decode_body(b"") == {}
+
+
+def test_telemetry_pull_report_carry_clock_and_cursor():
+    pull = telemetry.encode_pull(0, 17_000_000, 42)
+    subtype, _node, t0, body = telemetry.decode(pull)
+    assert subtype == telemetry.TEL_PULL
+    assert t0 == 17_000_000
+    assert telemetry.decode_body(body) == {"cursor": 42}
+
+    report = telemetry.encode_report(3, t0, {"ts_us": 99.0})
+    subtype, node, echo, body = telemetry.decode(report)
+    assert (subtype, node, echo) == (telemetry.TEL_REPORT, 3, 17_000_000)
+    assert telemetry.decode_body(body)["ts_us"] == 99.0
+
+
+# --------------------------------------------------------------------------
+# Trace-ring drain cursor
+# --------------------------------------------------------------------------
+
+
+def _tracer(capacity=8):
+    clock = {"t": 0.0}
+
+    def tick():
+        clock["t"] += 1.0
+        return clock["t"]
+
+    return tracing.Tracer(capacity=capacity, clock=tick, enabled=True)
+
+
+def test_drain_is_incremental_and_non_consuming():
+    trc = _tracer(capacity=64)
+    for i in range(5):
+        trc.instant(f"e{i}")
+    cursor, events, dropped = trc.drain(0)
+    assert (cursor, len(events), dropped) == (5, 5, 0)
+    # Non-consuming: a second puller with its own cursor sees everything.
+    assert len(trc.drain(0)[1]) == 5
+    trc.instant("e5")
+    cursor, events, dropped = trc.drain(cursor)
+    assert (cursor, dropped) == (6, 0)
+    assert [e["name"] for e in events] == ["e5"]
+
+
+def test_drain_wraparound_reports_dropped():
+    trc = _tracer(capacity=8)
+    for i in range(20):
+        trc.instant(f"e{i}")
+    cursor, events, dropped = trc.drain(0)
+    # 20 emitted into an 8-slot ring: 12 evicted before this drain.
+    assert (cursor, len(events), dropped) == (20, 8, 12)
+    assert [e["name"] for e in events] == [f"e{i}" for i in range(12, 20)]
+    # Exactly at the boundary: cursor == start of the retained window.
+    assert trc.drain(12) == (20, events, 0)
+    # A cursor ahead of emitted (child restarted) clamps, never negative.
+    cursor, events, dropped = trc.drain(99)
+    assert (cursor, events, dropped) == (20, [], 0)
+    trc.clear()
+    assert trc.drain(0) == (0, [], 0)
+
+
+def test_drain_coherent_under_concurrent_emit():
+    """Pull in a tight loop while emitters hammer the ring: the cursor
+    deltas must account for every event exactly once (len(events) +
+    dropped == cursor advance)."""
+    trc = _tracer(capacity=256)
+    stop = threading.Event()
+
+    def emitter():
+        while not stop.is_set():
+            trc.instant("x")
+
+    threads = [threading.Thread(target=emitter) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        cursor = 0
+        total = 0
+        for _ in range(300):
+            new_cursor, events, dropped = trc.drain(cursor)
+            assert new_cursor - cursor == len(events) + dropped
+            total += len(events) + dropped
+            cursor = new_cursor
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert total > 0
+
+
+# --------------------------------------------------------------------------
+# Clock alignment
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("skew_us", [5_000.0, -5_000.0, 500_000.0, -500_000.0])
+def test_clock_aligner_recovers_constant_skew(skew_us):
+    aligner = fleet.ClockAligner()
+    parent = 1_000_000.0
+    for i in range(8):
+        t0 = parent + i * 10_000.0
+        rtt = 200.0 + 50.0 * (i % 3)  # symmetric, slightly jittery
+        child_ts = (t0 + rtt / 2.0) + skew_us
+        aligner.add(t0, t0 + rtt, child_ts)
+    assert aligner.offset_us == pytest.approx(skew_us, abs=1.0)
+    assert aligner.to_parent(2_000_000.0 + skew_us) == pytest.approx(
+        2_000_000.0, abs=1.0
+    )
+
+
+def test_clock_aligner_prefers_low_rtt_and_tracks_drift():
+    aligner = fleet.ClockAligner(window=4)
+    # A high-RTT asymmetric sample gives a bad offset estimate...
+    aligner.add(0.0, 10_000.0, 9_000.0)  # midpoint 5000 -> offset 4000
+    # ...but one tight sample wins regardless of arrival order.
+    aligner.add(20_000.0, 20_100.0, 20_050.0 + 1_000.0)
+    assert aligner.offset_us == pytest.approx(1_000.0, abs=1.0)
+    # Drift: the window evicts stale samples, so the estimate follows.
+    for i in range(4):
+        t0 = 100_000.0 + i * 10_000.0
+        drifted = 1_000.0 + 100.0 * i
+        aligner.add(t0, t0 + 100.0, t0 + 50.0 + drifted)
+    offsets_in_window = [1_000.0 + 100.0 * i for i in range(4)]
+    assert aligner.offset_us in [
+        pytest.approx(o, abs=1.0) for o in offsets_in_window
+    ]
+    assert len(aligner) == 4
+
+
+def test_merged_trace_aligns_spans_into_strict_nesting():
+    """Two children with wildly different clock epochs (+500ms, -5ms)
+    each hold one half of a nested request: after alignment the inner
+    span must nest strictly inside the outer one."""
+    collector = fleet.FleetCollector(
+        out_dir="/tmp/unused-fleet-test",  # never flushed in this test
+        endpoints=[
+            {"group": 0, "node": "g0n0", "host": "127.0.0.1", "port": 1},
+            {"group": 0, "node": "g0n1", "host": "127.0.0.1", "port": 2},
+        ],
+        registry=metrics.Registry(),
+    )
+    ep_outer, ep_inner = collector._endpoints
+    # Parent clock ~1.0s.  Outer child's clock runs 500ms ahead, inner's
+    # 5ms behind; perfect symmetric exchanges teach the aligners that.
+    for ep, skew in ((ep_outer, 500_000.0), (ep_inner, -5_000.0)):
+        t0 = 1_000_000.0
+        collector.ingest_report(
+            ep, t0, t0 + 100.0,
+            {"ts_us": t0 + 50.0 + skew, "metrics": {},
+             "trace": {"cursor": 0, "dropped": 0, "events": []}},
+        )
+    # True times: outer [1.10s, 1.18s], inner [1.12s, 1.15s] — nested.
+    ep_outer.events.append(
+        {"name": "request_commit", "ph": "X",
+         "ts": 1_100_000.0 + 500_000.0, "dur": 80_000.0,
+         "args": {"trace": "ab" * 8}}
+    )
+    ep_inner.events.append(
+        {"name": "request_commit", "ph": "X",
+         "ts": 1_120_000.0 - 5_000.0, "dur": 30_000.0,
+         "args": {"trace": "ab" * 8}}
+    )
+    doc = collector.merged_trace()
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(spans) == 2
+    outer = next(s for s in spans if s["dur"] == 80_000.0)
+    inner = next(s for s in spans if s["dur"] == 30_000.0)
+    assert outer["ts"] == pytest.approx(1_100_000.0, abs=2.0)
+    assert inner["ts"] == pytest.approx(1_120_000.0, abs=2.0)
+    # Strict nesting in the aligned clock domain.
+    assert outer["ts"] < inner["ts"]
+    assert inner["ts"] + inner["dur"] < outer["ts"] + outer["dur"]
+    # pid/tid rewritten to group / node-index-within-group.
+    assert {s["pid"] for s in spans} == {0}
+    assert {s["tid"] for s in spans} == {0, 1}
+    # The timeline query finds both halves, aligned order.
+    timeline = fleet.trace_timeline(doc, "ab" * 8)
+    assert [e["dur"] for e in timeline] == [80_000.0, 30_000.0]
+    assert fleet.trace_timeline(doc, "ff" * 8) == []
+
+
+# --------------------------------------------------------------------------
+# Child report + collector over real sockets
+# --------------------------------------------------------------------------
+
+
+def test_build_report_carries_metrics_trace_and_vitals():
+    reg = metrics.Registry()
+    reg.counter("group_commits_total", labels={"group": "1"}).inc(5)
+    trc = _tracer(capacity=64)
+    trc.instant("hello")
+    report = fleet.build_report(1, "g1n0", 0, registry=reg, tracer=trc)
+    assert report["group"] == 1 and report["node"] == "g1n0"
+    assert report["metrics"]['group_commits_total{group="1"}'] == 5
+    assert report["trace"]["cursor"] == 1
+    assert report["trace"]["events"][0]["name"] == "hello"
+    assert report["rss_kb"] > 0 and report["open_fds"] > 0
+    # JSON-clean end to end: this is exactly what rides in TEL_REPORT.
+    assert json.loads(json.dumps(report)) == report
+
+
+def test_collector_pulls_telemetry_server_end_to_end(tmp_path):
+    reg = metrics.Registry()
+    reg.counter("observer_lag_batches").inc(0)
+    trc = _tracer(capacity=64)
+    trc.complete("observer_apply", 1.0, 2.0, pid=0, tid=0,
+                 args={"trace": "cd" * 8})
+    server = fleet.TelemetryServer(
+        "127.0.0.1", 0, 0, "g0obs0", registry=reg, tracer=trc
+    )
+    server.start()
+    try:
+        host, port = server.address
+        collector = fleet.FleetCollector(
+            tmp_path / "fleet",
+            [{"group": 0, "node": "g0obs0", "host": host, "port": port}],
+            registry=metrics.Registry(),
+        )
+        collector.pull_once()
+        # The cursor advanced: a second pull must not re-ship the event.
+        trc.instant("later")
+        collector.pull_once()
+        collector.stop()
+    finally:
+        server.stop()
+
+    latest = json.loads((tmp_path / "fleet" / "latest.json").read_text())
+    node = latest["nodes"]["g0obs0"]
+    assert node["reachable"] is True
+    assert node["metrics"]["observer_lag_batches"] == 0
+    history = json.loads((tmp_path / "fleet" / "history.json").read_text())
+    assert len(history) == 2
+    trace = json.loads((tmp_path / "fleet" / "trace.json").read_text())
+    names = [e["name"] for e in trace["traceEvents"] if e.get("ph") != "M"]
+    assert names.count("observer_apply") == 1  # no duplicate across pulls
+    assert "later" in names
+    assert fleet.trace_timeline(trace, "cd" * 8)
+
+
+def test_collector_tolerates_unreachable_endpoint(tmp_path):
+    collector = fleet.FleetCollector(
+        tmp_path / "fleet",
+        [{"group": 0, "node": "g0n0", "host": "127.0.0.1", "port": 1}],
+        registry=metrics.Registry(),
+    )
+    collector.pull_once()  # connection refused: recorded, not raised
+    collector.stop()
+    latest = json.loads((tmp_path / "fleet" / "latest.json").read_text())
+    assert latest["nodes"] == {}
+
+
+# --------------------------------------------------------------------------
+# Query surface: SLO rows + trend detection
+# --------------------------------------------------------------------------
+
+
+def _history_entry(t_us, nodes):
+    return {"t_us": t_us, "wall": 0.0, "nodes": nodes}
+
+
+def test_slo_rows_aggregate_members_per_group():
+    nodes = {
+        "g0n0": {"group": 0, "metrics": {
+            'commit_latency_seconds{node="0"}_p50': 0.010,
+            'commit_latency_seconds{node="0"}_p99': 0.050,
+            "net_send_lock_wait_seconds_p99": 0.002,
+            "wal_fsync_seconds_sum": 2.0,
+        }},
+        "g0n1": {"group": 0, "metrics": {
+            'commit_latency_seconds{node="1"}_p50': 0.020,
+            'commit_latency_seconds{node="1"}_p99': 0.030,
+        }},
+        "g1n0": {"group": 1, "metrics": {
+            'commit_latency_seconds{node="0"}_p50': 0.100,
+            "observer_lag_batches": 4.0,
+        }},
+    }
+    first = {
+        "g0n0": {"group": 0, "metrics": {"wal_fsync_seconds_sum": 1.0}},
+    }
+    rows = fleet.slo_rows(
+        [_history_entry(0.0, first), _history_entry(10_000_000.0, nodes)]
+    )
+    assert [r["group"] for r in rows] == [0, 1]
+    g0, g1 = rows
+    assert g0["commit_p50_ms"] == 15.0  # median of 10ms and 20ms
+    assert g0["commit_p99_ms"] == 50.0  # max across members
+    assert g0["send_lock_wait_p99_ms"] == 2.0
+    # 1s more fsync over a 10s window = 10% of wall time.
+    assert g0["wal_fsync_share_pct"] == 10.0
+    assert g1["commit_p50_ms"] == 100.0
+    assert g1["observer_lag"] == 4.0
+    assert g1["commit_p99_ms"] is None
+    assert fleet.slo_rows([]) == []
+
+
+def test_detect_trends_flags_monotonic_growth_only():
+    grow = [
+        _history_entry(i * 1e6, {
+            "g0n0": {"group": 0, "rss_kb": 10_000 + i * 300,
+                     "open_fds": 32 + 2 * i,
+                     "metrics": {"observer_lag_batches": float(i)}},
+            # Sawtooth RSS: healthy GC churn must not be flagged.
+            "g0n1": {"group": 0, "rss_kb": 10_000 + (i % 2) * 5_000,
+                     "open_fds": 32, "metrics": {}},
+        })
+        for i in range(8)
+    ]
+    findings = fleet.detect_trends(grow, min_points=6)
+    kinds = {(f["node"], f["kind"]) for f in findings}
+    assert ("g0n0", "rss_monotonic_growth") in kinds
+    assert ("g0n0", "fd_growth") in kinds
+    assert ("g0n0", "observer_lag_widening") in kinds
+    assert not any(node == "g0n1" for node, _ in kinds)
+    # Too little history: no verdicts at all.
+    assert fleet.detect_trends(grow[:3], min_points=6) == []
+
+
+def test_mirlint_telemetry_check_passes_and_catches_drift():
+    from mirbft_tpu.tools import mirlint
+
+    assert mirlint.check_telemetry_subtypes() == []
+
+    class Broken:
+        TEL_PULL = 0
+        TEL_ROGUE = 7  # constant without a registry entry
+        SUBTYPE_NAMES = {0: "tel_pull"}
+
+        @staticmethod
+        def sample_payloads():
+            return {}
+
+    findings = mirlint.check_telemetry_subtypes(Broken)
+    messages = " / ".join(f.message for f in findings)
+    assert "TEL_ROGUE" in messages
+    assert "does not cover" in messages

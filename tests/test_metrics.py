@@ -94,6 +94,77 @@ def test_snapshot_safe_under_concurrent_creation():
     assert not errors
 
 
+def test_snapshot_counters_never_go_backward():
+    """The fleet collector scrapes snapshots and charts deltas; a counter
+    that dips (torn unlocked read-modify-write) would chart as negative
+    rate.  snapshot() clamps counters and histogram _count/_sum to their
+    last published value."""
+    reg = metrics.Registry()
+    c = reg.counter("c")
+    c.inc(10)
+    assert reg.snapshot()["c"] == 10
+    c.value = 7  # simulate a torn inc() read-modify-write going backward
+    assert reg.snapshot()["c"] == 10  # clamped, not 7
+    c.value = 12  # real progress resumes past the clamp
+    assert reg.snapshot()["c"] == 12
+
+    h = reg.histogram("h")
+    h.observe(1.0)
+    h.observe(2.0)
+    snap = reg.snapshot()
+    assert snap["h_count"] == 2 and snap["h_sum"] == 3.0
+    h.total_count = 1
+    h.total_sum = 1.0
+    snap = reg.snapshot()
+    assert snap["h_count"] == 2 and snap["h_sum"] == 3.0
+
+    # Gauges legitimately move both ways: never clamped.
+    g = reg.gauge("g")
+    g.set(5.0)
+    assert reg.snapshot()["g"] == 5.0
+    g.set(1.0)
+    assert reg.snapshot()["g"] == 1.0
+
+    # reset() forgets the high-water marks with the instruments.
+    reg.reset()
+    reg.counter("c").inc(3)
+    assert reg.snapshot()["c"] == 3
+
+
+def test_snapshot_monotonic_under_concurrent_scrape():
+    """Tight-loop scraping while writers hammer a counter: every scrape
+    must see a value >= the previous one (the fleet-plane coherence
+    contract, docs/OBSERVABILITY.md)."""
+    reg = metrics.Registry()
+    c = reg.counter("commits")
+    h = reg.histogram("lat")
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            c.inc()
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        regressions = []
+        last_c = last_n = -1.0
+        for _ in range(400):
+            snap = reg.snapshot()
+            if snap["commits"] < last_c or snap["lat_count"] < last_n:
+                regressions.append((last_c, snap["commits"],
+                                    last_n, snap["lat_count"]))
+            last_c, last_n = snap["commits"], snap["lat_count"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not regressions
+    assert last_c > 0
+
+
 def _parse_prometheus(text):
     """Minimal exposition-format parser: validates line shapes, returns
     (types, samples).  Raises AssertionError on any malformed line."""

@@ -65,14 +65,39 @@ def test_client_envelope_roundtrip():
     for group in (0, 1, 7, 2**31):
         assert decode_client_envelope(
             encode_client_envelope(group, body)
-        ) == (group, body)
+        ) == (group, 0, body)
+
+
+def test_client_envelope_traced_roundtrip():
+    # The v2 envelope carries a nonzero trace id; trace_id=0 must keep
+    # emitting the v1 layout so untraced deployments stay byte-identical.
+    body = b"\x00" * 8 + b"payload"
+    v1 = encode_client_envelope(3, body)
+    assert encode_client_envelope(3, body, trace_id=0) == v1
+    for trace_id in (1, 0xDEADBEEF, 2**64 - 1):
+        framed = encode_client_envelope(3, body, trace_id=trace_id)
+        assert framed != v1
+        assert decode_client_envelope(framed) == (3, trace_id, body)
+
+
+def test_trace_id_for_is_stable_and_nonzero():
+    from mirbft_tpu.groups.routing import trace_id_for
+
+    seen = set()
+    for client, req in ((0, 0), (1, 0), (1, 1), (7, 1234)):
+        tid = trace_id_for(client, req)
+        assert tid == trace_id_for(client, req)  # deterministic
+        assert 0 < tid < 2**64
+        assert tid & 1  # low bit forced: never the "untraced" zero
+        seen.add(tid)
+    assert len(seen) == 4
 
 
 def test_client_envelope_legacy_payload_is_group_zero():
     # A pre-sharding KIND_CLIENT payload has no envelope magic: it must
-    # decode as group 0 with the payload untouched.
+    # decode as group 0, untraced, with the payload untouched.
     legacy = b"\x00\x00\x00\x00\x00\x00\x00\x05hello"
-    assert decode_client_envelope(legacy) == (0, legacy)
+    assert decode_client_envelope(legacy) == (0, 0, legacy)
 
 
 def test_client_envelope_unknown_version_rejected():
@@ -161,6 +186,42 @@ def test_ship_feed_replays_backlog_and_resets_below_checkpoint():
     assert feed.state()["subscribers"] == 3
     feed.note_commit(5, "5 cc ")
     assert feed.state()["subscribers"] == 2
+
+
+def test_ship_trace_trailer_rides_behind_nul_and_observer_strips_it(tmp_path):
+    # note_commit(trace=...) appends the binding map behind a NUL; the
+    # subscriber sees it, but the observer's journal stays byte-identical
+    # to the members' (the seq-keyed agreement check depends on that).
+    feed = ship.ShipFeed(1, registry=metrics.Registry())
+    frames, send = _collector()
+    feed.handle_subscribe(0, send)
+    feed.note_commit(1, "1 aa 7:0", trace={"7:0": "00deadbeef00beef"})
+    feed.note_commit(2, "2 bb 7:1")  # untraced: no trailer at all
+    assert frames[0][3] == b'1 aa 7:0\x00{"7:0": "00deadbeef00beef"}'
+    assert frames[1][3] == b"2 bb 7:1"
+
+    from mirbft_tpu import tracing
+
+    obs = Observer(1, [("127.0.0.1", 1)], tmp_path / "obs",
+                   registry=metrics.Registry())
+    tracing.default_tracer.enabled = True
+    try:
+        obs._on_batch(1, frames[0][3])
+        obs._on_batch(2, frames[1][3])
+    finally:
+        tracing.default_tracer.enabled = False
+        obs.close()
+    assert (tmp_path / "obs" / "commits.log").read_text() == (
+        "1 aa 7:0\n2 bb 7:1\n"
+    )
+    spans = [
+        ev for ev in tracing.default_tracer.chrome_trace()["traceEvents"]
+        if ev.get("name") == "observer_apply"
+    ]
+    assert len(spans) == 2
+    assert spans[0]["args"]["trace"] == "00deadbeef00beef"
+    assert spans[0]["args"]["traces"] == {"7:0": "00deadbeef00beef"}
+    assert "trace" not in spans[1]["args"]
 
 
 def test_observer_handlers_apply_and_checkpoint(tmp_path):
@@ -259,6 +320,59 @@ def test_observer_bootstraps_and_reaches_bit_identity(tmp_path):
         assert mirnet._metric_file_value(
             prom, "observer_checkpoints_total"
         ) > 0
+
+
+def test_fleet_two_group_trace_correlation(tmp_path):
+    """The fleet-plane acceptance run (docs/OBSERVABILITY.md "Fleet
+    plane"): a 2-group fleet-enabled deployment must yield one merged
+    Chrome trace in which a single request's spans appear on the routing
+    tier, >=2f+1 group members, and the observer under one trace id,
+    causally ordered after clock alignment — plus per-group commit
+    percentiles from the same collector output."""
+    import json
+
+    from mirbft_tpu import fleet
+    from mirbft_tpu.tools import mirnet
+
+    res = mirnet.run_sharded_deployment(
+        root_dir=str(tmp_path), groups=2, nodes_per_group=2,
+        reqs_per_group=4, observers_per_group=1, timeout_s=120,
+        fleet=True,
+    )
+    fleet_dir = tmp_path / "fleet"
+    assert res["fleet_dir"] == str(fleet_dir)
+
+    trace = json.loads((fleet_dir / "trace.json").read_text())
+    spans_by_id = {}
+    for ev in trace["traceEvents"]:
+        tid_hex = (ev.get("args") or {}).get("trace")
+        if ev.get("ph") != "M" and tid_hex:
+            spans_by_id.setdefault(tid_hex, []).append(ev)
+    # n=2 -> f=0 -> 2f+1 = 1 commit span; the observer wave in fleet
+    # mode guarantees at least one id crosses all three roles.
+    full = {
+        t: spans
+        for t, spans in spans_by_id.items()
+        if {"route_submit", "request_commit", "observer_apply"}
+        <= {e["name"] for e in spans}
+    }
+    assert full, f"no trace id spans all roles (saw {len(spans_by_id)})"
+    for t, spans in full.items():
+        commits = [e for e in spans if e["name"] == "request_commit"]
+        for obs in (e for e in spans if e["name"] == "observer_apply"):
+            # Aligned clocks: the observer applies after every member's
+            # commit span has started.
+            assert all(obs["ts"] >= c["ts"] for c in commits)
+        # The timeline query resolves the same id.
+        assert fleet.trace_timeline(trace, t)
+
+    rows = fleet.slo_rows(
+        json.loads((fleet_dir / "history.json").read_text())
+    )
+    assert {row["group"] for row in rows} == {0, 1}
+    for row in rows:
+        assert row["commit_p50_ms"] > 0
+        assert row["commit_p99_ms"] >= row["commit_p50_ms"]
 
 
 @pytest.mark.slow
