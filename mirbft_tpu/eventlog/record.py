@@ -52,7 +52,11 @@ class Recorder:
         buffer_size: int = 5000,
     ):
         self.node_id = node_id
+        # Wall-clock default matches the reference; it is timestamp metadata
+        # on the record, never replay ordering.
+        # mirlint: allow(wall-clock)
         self.time_source = time_source or (lambda: int(_time.time() * 1000))
+        self.dropped_events = 0
         self.retain_request_data = retain_request_data
         self._queue: "queue.Queue" = queue.Queue(maxsize=buffer_size)
         self._gzip = gzip.GzipFile(
@@ -74,21 +78,32 @@ class Recorder:
         record = st.RecordedEvent(
             node_id=self.node_id, time=self.time_source(), state_event=event
         )
-        # Bounded put with a liveness escape: if the writer thread has died
-        # (disk full, closed dest) we must not block forever on a queue no
-        # consumer will drain (the reference selects on exitC here,
-        # interceptor.go:137-150).
-        while True:
-            try:
-                self._queue.put(record, timeout=0.1)
-                return
-            except queue.Full:
-                if self._error is not None:
-                    raise RuntimeError(
-                        "event recorder failed"
-                    ) from self._error
-                if self._done.is_set():
-                    raise RuntimeError("event recorder writer exited")
+        # Non-blocking overflow (flight-recorder policy, see journal.py):
+        # the old 0.1 s retry loop could stall consensus indefinitely behind
+        # an alive-but-slow writer.  On a full queue, evict the oldest
+        # buffered record so the log keeps the most recent window and the
+        # hot path never waits.
+        try:
+            self._queue.put_nowait(record)
+            return
+        except queue.Full:
+            pass
+        try:
+            victim = self._queue.get_nowait()
+            if victim is None:
+                # Never swallow the shutdown sentinel (stop() race).
+                try:
+                    self._queue.put_nowait(None)
+                except queue.Full:
+                    pass
+            else:
+                self.dropped_events += 1
+        except queue.Empty:
+            pass
+        try:
+            self._queue.put_nowait(record)
+        except queue.Full:  # lost the race for the freed slot: drop new
+            self.dropped_events += 1
 
     def _run(self) -> None:
         try:

@@ -35,6 +35,7 @@ from typing import List, Optional, Tuple
 
 from .. import metrics as metrics_mod
 from .. import tracing
+from ..eventlog import journal as journal_mod
 from ..net.framing import KIND_GROUP, FrameDecoder, encode_frame
 from . import ship
 
@@ -61,6 +62,18 @@ class Observer:
         self.snapstore = SnapshotStore(str(self.out_dir / "snaps"))
         self._checkpoints_path = self.out_dir / "checkpoints.log"
         self._commits = open(self.out_dir / "commits.log", "a", buffering=1)
+
+        # Flight recorder (docs/OBSERVABILITY.md): observers journal the
+        # applied-batch stream too — TAG_APPLY lines plus checkpoint
+        # markers in the same segmented, checkpoint-retained format as the
+        # members', so `mircat --audit` covers the learner plane.  The
+        # sink is single-writer by the observer's own contract (run
+        # thread), so appending here is safe without locks.
+        self._journal = journal_mod.SegmentSink(
+            self.out_dir / "journal",
+            group_id,
+            registry=registry,
+        )
 
         # Resume point after a restart: the highest sequence this
         # observer already applied (journal lines or recorded checkpoints).
@@ -117,6 +130,8 @@ class Observer:
             f.write(f"{seq} {digest.hex()}\n")
         self.stable_checkpoint = (seq, digest)
         self._checkpoints.inc()
+        # Checkpoint marker doubles as the journal's retention anchor.
+        self._journal.note_checkpoint(seq)
 
     def _on_reset(self, seq: int, digest: bytes) -> None:
         self._record_checkpoint(seq, digest)
@@ -133,6 +148,10 @@ class Observer:
         if seq > self.applied_seq:
             start = tracing.default_tracer.now()
             self._commits.write(line.decode() + "\n")
+            self._journal.append(
+                journal_mod.TAG_APPLY,
+                journal_mod._uvarint(seq) + line,
+            )
             self.applied_seq = seq
             self._applied.inc()
             if tracing.default_tracer.enabled:
@@ -216,6 +235,10 @@ class Observer:
 
     def close(self) -> None:
         self._commits.close()
+        try:
+            self._journal.close()
+        except OSError:
+            pass
 
     def state(self) -> dict:
         return {

@@ -215,6 +215,12 @@ class HealthMonitor:
         self._growth_count = 0
         self._growth_since: Optional[float] = None
         self._growth_flagged = False
+        # Flight-recorder auto-capture (docs/OBSERVABILITY.md "Flight
+        # recorder"): deployments bind an eventlog.incident.AnomalyCapture
+        # here; every emitted anomaly is offered for incident bundling.
+        # Best-effort by contract — a capture failure never reaches the
+        # detection path.
+        self.capture_hook: Optional[Callable[[Anomaly], None]] = None
 
     def configure(
         self,
@@ -252,6 +258,11 @@ class HealthMonitor:
                 since=anomaly.since,
                 **{k: v for k, v in anomaly.detail.items()},
             )
+        if self.capture_hook is not None:
+            try:
+                self.capture_hook(anomaly)
+            except Exception:
+                pass  # capture is evidence, never a failure mode
 
     def _set_status_gauge(self) -> None:
         self.registry.gauge(

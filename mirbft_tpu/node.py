@@ -201,6 +201,16 @@ class Node:
             "trace_bindings_total"
         )
         self.span_tracker.trace_resolver = self.trace_id_of
+        # Flight recorder (docs/OBSERVABILITY.md "Flight recorder"): an
+        # interceptor exposing an unbound ``trace_lookup`` slot (the
+        # eventlog JournalRecorder) gets the same binding LRU, so recorded
+        # EventSteps join the fleet causal graph.
+        interceptor = processor_config.interceptor
+        if (
+            interceptor is not None
+            and getattr(interceptor, "trace_lookup", False) is None
+        ):
+            interceptor.trace_lookup = self.trace_id_of
         # Protocol health plane (docs/OBSERVABILITY.md): the event stream
         # feeds it on the result worker, periodic status snapshots on the
         # coordinator (every tick, whenever no state-machine batch is in

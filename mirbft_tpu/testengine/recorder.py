@@ -503,6 +503,12 @@ class Recorder:
         # autotuned depths.  The simulated schedule is bit-identical with
         # or without it (the driver only touches the hash plane).
         self.pipeline = None
+        # Optional per-node interceptor factory (set before recording(),
+        # same pattern): called with the node index, returns an
+        # EventInterceptor (e.g. eventlog.JournalRecorder) attached to that
+        # SimNode.  event_log_writer wins when both are set — it carries
+        # the sim-clock annotation the replay tooling depends on.
+        self.interceptor_factory = None
 
     def recording(self) -> "Recording":
         event_queue = EventQueue(seed=self.random_seed, mangler=self.mangler)
@@ -580,6 +586,8 @@ class Recorder:
             if self.event_log_writer is not None:
                 writer = self.event_log_writer
                 interceptor = _Interceptor(i, event_queue, writer)
+            elif self.interceptor_factory is not None:
+                interceptor = self.interceptor_factory(i)
 
             node_logger = None
             if self.logger is not None:

@@ -45,6 +45,24 @@ Usage:
     python -m mirbft_tpu.tools.mircat DEPLOY_DIR --doctor
     python -m mirbft_tpu.tools.mircat SHARD_ROOT --doctor
     python -m mirbft_tpu.tools.mircat DIR_A DIR_B ... --doctor
+    python -m mirbft_tpu.tools.mircat DEPLOY_DIR --audit
+    python -m mirbft_tpu.tools.mircat DEPLOY_DIR --incident \\
+        [--trace-id HEX] [--window T0 T1]
+    python -m mirbft_tpu.tools.mircat BUNDLE_DIR --incident
+
+``--audit`` is the determinism invariant, continuously enforced on real
+deployments (docs/OBSERVABILITY.md "Flight recorder"): every boot's
+journal replays through a fresh state machine and the reconstructed
+commit/checkpoint stream must byte-match the live ``commits.log`` /
+``checkpoints.log``.  Any mismatch is a hard finding (exit 1); torn
+tails — SIGKILL mid-write — are clean-cut and reported as notes, never
+divergence.  Verdicts land in ``<dir>/audit.json``, which ``--fleet``
+surfaces as per-node ``audit=`` rows.
+
+``--incident`` cuts a self-contained ``incident-<id>/`` bundle (journal
+slices + spans + metrics + manifest) from a deployment directory and
+deterministically replays it, printing the causal commit/view-change
+timeline — the same bundles ``HealthMonitor`` anomalies auto-capture.
 """
 
 from __future__ import annotations
@@ -61,7 +79,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from .. import metrics, tracing
 from .. import state as st
 from .. import status as status_mod
-from ..eventlog import read_event_log
+from ..eventlog import load_boots, read_event_log
 from ..health import HealthMonitor, HealthThresholds
 from ..statemachine.machine import MachineState, StateMachine
 from .textmarshal import compact_text
@@ -141,6 +159,32 @@ def _parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         help="with --doctor: also write the full report as JSON",
     )
     parser.add_argument(
+        "--audit",
+        action="store_true",
+        help="divergence audit: replay each boot's journal through a "
+        "fresh state machine and byte-compare the reconstructed "
+        "commit/checkpoint stream against the live commits.log; any "
+        "mismatch is a hard finding (exit 1), torn tails are clean-cut "
+        "notes; writes <dir>/audit.json",
+    )
+    parser.add_argument(
+        "--incident",
+        action="store_true",
+        help="incident replay: with a deployment directory, capture an "
+        "incident-<id>/ bundle (slice by --trace-id and/or --window) "
+        "and deterministically replay it; with an existing bundle "
+        "directory, replay it as-is — printing the causal "
+        "commit/view-change timeline",
+    )
+    parser.add_argument(
+        "--window",
+        nargs=2,
+        type=float,
+        metavar=("T0", "T1"),
+        help="with --incident: the monotonic-millisecond window to slice "
+        "(defaults to the whole recorded run)",
+    )
+    parser.add_argument(
         "--wal",
         action="store_true",
         help="treat LOG as a group-commit WAL directory: dump/verify the "
@@ -159,7 +203,8 @@ def _parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         metavar="HEX",
         help="with --fleet: print the causal timeline of one request — "
         "every span in the merged fleet trace carrying this trace id, "
-        "in aligned-clock order",
+        "in aligned-clock order; with --incident: name the bundle after "
+        "this request and record it in the manifest",
     )
     return parser.parse_args(argv)
 
@@ -308,25 +353,36 @@ def doctor_deployment(
         )
         timeline: List[Tuple[float, int]] = []
         boots = 0
-        for log_path in sorted(node_dir.glob("events-*.gz")):
+        # load_boots covers both layouts: the flight recorder's segmented
+        # journal/ directory and legacy events-*.gz streams.  Torn tails
+        # come back clean-cut, reported under truncated_logs as before.
+        for boot_log in load_boots(node_dir):
             boots += 1
             sm = StateMachine()
             try:
-                with open(log_path, "rb") as f:
-                    for record in read_event_log(f):
-                        clock["t"] = float(record.time)
-                        actions = sm.apply_event(record.state_event)
-                        monitor.observe_events((record.state_event,), actions)
-                        if sm.state == MachineState.INITIALIZED:
-                            epoch = sm.epoch_tracker.current_epoch.number
-                            if not timeline or timeline[-1][1] != epoch:
-                                timeline.append((float(record.time), epoch))
-                        if isinstance(record.state_event, st.EventTickElapsed):
-                            monitor.observe_snapshot(
-                                status_mod.snapshot(sm), now=float(record.time)
-                            )
-            except Exception as exc:  # torn gzip / partial frame after SIGKILL
-                truncated.append(f"{log_path}: {exc!r}")
+                for record, _trace in boot_log.records:
+                    clock["t"] = float(record.time)
+                    actions = sm.apply_event(record.state_event)
+                    monitor.observe_events((record.state_event,), actions)
+                    if sm.state == MachineState.INITIALIZED:
+                        epoch = sm.epoch_tracker.current_epoch.number
+                        if not timeline or timeline[-1][1] != epoch:
+                            timeline.append((float(record.time), epoch))
+                    if isinstance(record.state_event, st.EventTickElapsed):
+                        monitor.observe_snapshot(
+                            status_mod.snapshot(sm), now=float(record.time)
+                        )
+            except Exception as exc:  # mid-boot replay break (pruned head)
+                truncated.append(
+                    f"{node_dir.name} boot {boot_log.boot}: {exc!r}"
+                )
+            if boot_log.error:
+                truncated.append(boot_log.error)
+            elif boot_log.torn:
+                truncated.append(
+                    f"{node_dir.name} boot {boot_log.boot}: torn tail "
+                    f"(clean-cut)"
+                )
 
         live_faults: Dict[Tuple[int, str], float] = {}
         for labels, value in _node_prom(node_dir, "peer_faults_total"):
@@ -478,6 +534,246 @@ def _print_sharded_report(report: dict) -> None:
     )
 
 
+# ---------------------------------------------------------------------------
+# Divergence audit: replayed journal vs live commit/checkpoint ground truth
+# ---------------------------------------------------------------------------
+
+
+def _read_log_lines(path: Path) -> List[str]:
+    if not path.exists():
+        return []
+    return [ln for ln in path.read_text().splitlines() if ln]
+
+
+def _commit_line(batch) -> str:
+    reqs = ",".join(f"{r.client_id}:{r.req_no}" for r in batch.requests)
+    return f"{batch.seq_no} {batch.digest.hex()} {reqs}"
+
+
+def audit_node(node_dir) -> dict:
+    """Continuously-enforced determinism invariant for one node dir:
+    replay every journaled boot through a fresh state machine and
+    byte-compare the reconstructed commit/checkpoint stream against the
+    live ``commits.log`` / ``checkpoints.log``.
+
+    Verdicts: ``clean`` (everything reconstructed matches), ``divergent``
+    (any byte mismatch — a hard finding), ``gapped`` (overflow dropped
+    events, replay is not faithful, compare skipped), ``pruned``
+    (retention removed the boot's head, replay cannot initialize),
+    ``no-journal``.  Torn tails are clean-cut by construction and only
+    noted — a crash is evidence, never divergence."""
+    node_dir = Path(node_dir)
+    live_commits: Dict[int, str] = {}
+    for line in _read_log_lines(node_dir / "commits.log"):
+        try:
+            live_commits[int(line.split(" ", 1)[0])] = line
+        except ValueError:
+            continue
+    live_max = max(live_commits, default=0)
+    live_checkpoints: Dict[int, str] = {}
+    for line in _read_log_lines(node_dir / "checkpoints.log"):
+        try:
+            seq_txt, digest_hex = line.split(" ", 1)
+            live_checkpoints[int(seq_txt)] = digest_hex.strip()
+        except ValueError:
+            continue
+
+    divergences: List[str] = []
+    notes: List[str] = []
+    boots = load_boots(node_dir)
+    gapped = False
+    pruned = False
+    compared = 0
+    for boot in boots:
+        where = f"boot {boot.boot}"
+        if boot.torn:
+            notes.append(f"{where}: torn tail (clean-cut)")
+        if boot.error:
+            notes.append(f"{where}: {boot.error}")
+        if boot.dropped:
+            gapped = True
+            notes.append(
+                f"{where}: {boot.dropped} events dropped under overflow; "
+                f"replay not faithful, compare skipped"
+            )
+            continue
+        if boot.pruned:
+            pruned = True
+            notes.append(
+                f"{where}: head pruned by retention; compare skipped"
+            )
+            continue
+
+        # Observer journals carry the applied stream directly.
+        for seq, line in boot.applies:
+            compared += 1
+            live = live_commits.get(seq)
+            if live is None:
+                if seq < live_max:
+                    divergences.append(
+                        f"{where}: applied seq {seq} missing from live "
+                        f"commits.log"
+                    )
+                continue
+            if live != line:
+                divergences.append(
+                    f"{where}: seq {seq} diverges: journal {line!r} vs "
+                    f"live {live!r}"
+                )
+
+        if not boot.records:
+            continue
+        sm = StateMachine()
+        try:
+            for record, _trace in boot.records:
+                actions = sm.apply_event(record.state_event)
+                for action in actions:
+                    if isinstance(action, st.ActionCommit):
+                        compared += 1
+                        seq = action.batch.seq_no
+                        line = _commit_line(action.batch)
+                        live = live_commits.get(seq)
+                        if live is None:
+                            # Tolerate tail loss only: the journal can be
+                            # ahead of a log torn by SIGKILL, but a hole
+                            # before the live head is hard divergence.
+                            if seq < live_max:
+                                divergences.append(
+                                    f"{where}: replayed seq {seq} missing "
+                                    f"from live commits.log"
+                                )
+                            continue
+                        if live != line:
+                            divergences.append(
+                                f"{where}: seq {seq} diverges: replay "
+                                f"{line!r} vs live {live!r}"
+                            )
+                event = record.state_event
+                if (
+                    isinstance(event, st.EventCheckpointResult)
+                    and len(event.value) == 32
+                    and event.seq_no in live_checkpoints
+                ):
+                    compared += 1
+                    if event.value.hex() != live_checkpoints[event.seq_no]:
+                        divergences.append(
+                            f"{where}: checkpoint {event.seq_no} diverges: "
+                            f"replay {event.value.hex()} vs live "
+                            f"{live_checkpoints[event.seq_no]}"
+                        )
+        except Exception as exc:
+            notes.append(f"{where}: replay stopped: {exc!r}")
+
+    if divergences:
+        verdict = "divergent"
+    elif not boots:
+        verdict = "no-journal"
+    elif gapped and compared == 0:
+        verdict = "gapped"
+    elif pruned and compared == 0:
+        verdict = "pruned"
+    else:
+        verdict = "clean"
+    return {
+        "verdict": verdict,
+        "boots": len(boots),
+        "compared": compared,
+        "divergences": divergences,
+        "notes": notes,
+    }
+
+
+def audit_deployment(root, write_json: bool = True) -> dict:
+    """Audit every node (and observer) of one deployment directory and —
+    by default — persist the verdicts to ``<root>/audit.json``, the file
+    ``mircat --fleet`` reads for its ``audit=`` rows."""
+    from ..eventlog.incident import _node_label_dirs
+
+    root = Path(root)
+    per_node: Dict[str, dict] = {}
+    for label, node_dir in _node_label_dirs(root):
+        per_node[label] = audit_node(node_dir)
+    divergence_count = sum(
+        len(node["divergences"]) for node in per_node.values()
+    )
+    report = {
+        "root": str(root),
+        "clean": divergence_count == 0,
+        "divergence_count": divergence_count,
+        "per_node": per_node,
+    }
+    if write_json:
+        try:
+            (root / "audit.json").write_text(
+                json.dumps(report, indent=2, sort_keys=True)
+            )
+        except OSError:
+            pass  # read-only deployment dir: verdict still printed
+    return report
+
+
+def audit_sharded(paths) -> dict:
+    """One :func:`audit_deployment` per group (same expansion as the
+    doctor), aggregated; per-group ``audit.json`` files are written so
+    each group's fleet view finds its own verdicts, plus a combined one
+    at each sharded root."""
+    per_group: Dict[str, dict] = {}
+    for path in paths:
+        for label, group_dir in _sharded_group_dirs(Path(path)):
+            per_group[label] = audit_deployment(group_dir)
+    combined = {
+        "roots": [str(p) for p in paths],
+        "clean": all(r["clean"] for r in per_group.values()),
+        "divergence_count": sum(
+            r["divergence_count"] for r in per_group.values()
+        ),
+        "per_group": per_group,
+    }
+    for path in paths:
+        root = Path(path)
+        if (root / "shard.json").exists() or list(root.glob("group-*")):
+            merged: Dict[str, dict] = {}
+            for group in sorted(per_group):
+                merged.update(per_group[group]["per_node"])
+            try:
+                (root / "audit.json").write_text(
+                    json.dumps(
+                        {
+                            "root": str(root),
+                            "clean": combined["clean"],
+                            "divergence_count": combined["divergence_count"],
+                            "per_node": merged,
+                        },
+                        indent=2,
+                        sort_keys=True,
+                    )
+                )
+            except OSError:
+                pass
+    return combined
+
+
+def _print_audit_report(report: dict) -> None:
+    groups = report.get("per_group") or {"": report}
+    for group_label in sorted(groups):
+        group = groups[group_label]
+        prefix = f"{group_label}: " if group_label else ""
+        for label in sorted(group["per_node"]):
+            node = group["per_node"][label]
+            print(
+                f"{prefix}{label}: {node['verdict'].upper()} "
+                f"({node['boots']} boots, {node['compared']} compared)"
+            )
+            for line in node["divergences"]:
+                print(f"  divergence: {line}")
+            for line in node["notes"]:
+                print(f"  note: {line}")
+    print(
+        f"audit verdict: {'CLEAN' if report['clean'] else 'DIVERGENT'} "
+        f"({report['divergence_count']} divergences)"
+    )
+
+
 def _print_wal_report(report: dict) -> None:
     print(f"wal dir: {report['dir']}")
     print(f"low index: {report['low_index']}")
@@ -581,6 +877,54 @@ def fleet_report(fleet_dir, trace_id: Optional[str] = None) -> int:
             )
         if not timeline:
             return 1
+
+    # The correctness plane in the same view: last `mircat --audit`
+    # verdict per node (audit.json lives at the deployment root, one
+    # level above fleet/).
+    audit_doc = None
+    audit_path = root.parent / "audit.json"
+    if audit_path.exists():
+        try:
+            audit_doc = json.loads(audit_path.read_text())
+        except ValueError:
+            audit_doc = None
+    if audit_doc and audit_doc.get("per_node"):
+        for label in sorted(audit_doc["per_node"]):
+            verdict = audit_doc["per_node"][label].get("verdict", "-")
+            print(f"  {label} audit={verdict}")
+    else:
+        print("  audit=- (no audit.json; run mircat --audit <root>)")
+    return 0
+
+
+def _incident_cli(args: argparse.Namespace) -> int:
+    """``--incident``: replay an existing bundle, or capture one from a
+    deployment directory first (module docstring)."""
+    from ..eventlog.incident import (
+        capture_incident,
+        format_replay,
+        replay_incident,
+    )
+
+    if len(args.log) != 1 or not Path(args.log[0]).is_dir():
+        print("mircat: --incident requires one directory (a deployment "
+              "root or an incident bundle)", file=sys.stderr)
+        return 2
+    path = Path(args.log[0])
+    if (path / "manifest.json").exists():
+        bundle = path
+    else:
+        window = (
+            (float(args.window[0]), float(args.window[1]))
+            if args.window
+            # No window: slice nothing out — the whole recorded run.
+            else (0.0, 1e15)
+        )
+        bundle = capture_incident(
+            path, window, trace_id=args.trace_id, reason="manual"
+        )
+        print(f"bundle -> {bundle}")
+    print(format_replay(replay_incident(bundle)))
     return 0
 
 
@@ -594,6 +938,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("mircat: need a log file or deployment directory "
               "(or --fleet DIR)", file=sys.stderr)
         return 2
+
+    if args.incident:
+        return _incident_cli(args)
+
+    if args.audit:
+        if not all(Path(p).is_dir() for p in args.log):
+            print("mircat: --audit requires deployment directories",
+                  file=sys.stderr)
+            return 2
+        expanded = [
+            pair for p in args.log for pair in _sharded_group_dirs(Path(p))
+        ]
+        if len(expanded) == 1 and expanded[0][1] == Path(args.log[0]):
+            report = audit_deployment(args.log[0])
+        else:
+            report = audit_sharded(args.log)
+        _print_audit_report(report)
+        return 0 if report["clean"] else 1
 
     if args.wal:
         from ..storage import wal_segment_report
@@ -614,7 +976,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         if not (args.doctor or args.doctor_json):
             print(
-                "mircat: directory input requires --doctor", file=sys.stderr
+                "mircat: directory input requires --doctor, --audit, or "
+                "--incident",
+                file=sys.stderr,
             )
             return 2
         # One plain deployment dir keeps the classic single-deployment

@@ -150,7 +150,7 @@ def _parse(path: Path) -> Tuple[str, ast.Module, Pragmas]:
 # Pass 1: determinism
 
 
-_ENGINE_DIRS = ("statemachine", "processor", "testengine")
+_ENGINE_DIRS = ("statemachine", "processor", "testengine", "eventlog")
 
 # Dotted wall-clock reads that leak real time into engine code.  Interval
 # metering via time.perf_counter/perf_counter_ns is deliberately exempt:
@@ -988,6 +988,11 @@ REQUIRED_METRIC_NAMES = (
     "fleet_trace_events_total",
     "fleet_trace_dropped_total",
     "trace_bindings_total",
+    # Flight recorder plane (eventlog/journal.py, eventlog/incident.py,
+    # docs/OBSERVABILITY.md "Flight recorder").
+    "eventlog_dropped_events_total",
+    "eventlog_bytes_total",
+    "flight_recorder_captures_total",
 )
 
 
@@ -1644,6 +1649,63 @@ def check_telemetry_subtypes(telemetry_module=None) -> List[Finding]:
     return findings
 
 
+def check_incident_manifest(incident_module=None) -> List[Finding]:
+    """Rule id: incident-manifest.  The incident-bundle ``manifest.json``
+    schema (eventlog/incident.py MANIFEST_KEYS) is a wire contract
+    between the capture side (``AnomalyCapture``/``capture_incident``)
+    and the readers (``replay_incident``, ``mircat --incident``): every
+    key named once, snake_case, sorted (capture writes with
+    ``sort_keys=True``, so the declared tuple is the on-disk order), and
+    :func:`sample_manifest` producing exactly those keys — a key added
+    on one side without the other breaks replay of archived bundles.
+
+    ``incident_module`` is injectable for tests; default is the real
+    module.
+    """
+    if incident_module is None:
+        from ..eventlog import incident as incident_module
+
+    where = "mirbft_tpu/eventlog/incident.py"
+    findings: List[Finding] = []
+
+    def flag(message: str) -> None:
+        findings.append(Finding(where, 0, "incident-manifest", message))
+
+    keys = getattr(incident_module, "MANIFEST_KEYS", None)
+    if not isinstance(keys, tuple) or not keys:
+        flag("MANIFEST_KEYS registry is missing or empty")
+        return findings
+    if len(set(keys)) != len(keys):
+        flag(f"duplicate manifest keys in {keys}")
+    if list(keys) != sorted(keys):
+        flag(
+            "MANIFEST_KEYS is not sorted; capture writes sort_keys=True, "
+            "so the declared order must match the on-disk order"
+        )
+    for key in keys:
+        if not _SNAKE_CASE.match(key):
+            flag(f"manifest key {key!r} is not snake_case")
+
+    try:
+        sample = incident_module.sample_manifest()
+    except Exception as exc:  # noqa: BLE001 — report, don't crash lint
+        flag(f"sample_manifest() raised: {exc}")
+        return findings
+    if not isinstance(sample, dict):
+        flag(f"sample_manifest() returned {type(sample).__name__}, not dict")
+        return findings
+    missing = sorted(set(keys) - set(sample))
+    extra = sorted(set(sample) - set(keys))
+    if missing:
+        flag(f"sample_manifest() lacks declared keys {missing}")
+    if extra:
+        flag(
+            f"sample_manifest() emits undeclared keys {extra} — add them "
+            "to MANIFEST_KEYS so the mircat/replay readers stay in lockstep"
+        )
+    return findings
+
+
 def wire_pass(root: Path) -> List[Finding]:
     pkg = root / "mirbft_tpu"
     findings = wire_static_pass(
@@ -1657,6 +1719,7 @@ def wire_pass(root: Path) -> List[Finding]:
         findings += wire_dynamic_pass()
         findings += check_frame_subtypes()
         findings += check_telemetry_subtypes()
+        findings += check_incident_manifest()
     return findings
 
 

@@ -148,7 +148,7 @@ def _write_cluster(
     *,
     seed: int = 0,
     faults: bool = False,
-    record_events: bool = False,
+    record_events: bool = True,
     thresholds: Optional[dict] = None,
     node_config: Optional[dict] = None,
     byzantine: Optional[dict] = None,
@@ -161,7 +161,11 @@ def _write_cluster(
 ) -> None:
     """``cluster.json``: everything a child needs to boot.  The fault
     plane keys are optional — plain deployments (``run_deployment``) leave
-    them at their inert defaults.  The pipelined schedule is the default;
+    them at their inert defaults.  The flight recorder is **on by
+    default** (``record_events``, docs/OBSERVABILITY.md "Flight
+    recorder"): every child journals its event stream to
+    ``node-<i>/journal/`` with bounded retention; ``--no-flight-recorder``
+    is the escape hatch.  The pipelined schedule is the default;
     ``pipeline=False`` (the ``--classic`` flag) selects the reference
     coordinator, and the active schedule is recorded under ``schedule``.
     Sharded deployments (docs/SHARDING.md) additionally record the node's
@@ -467,16 +471,20 @@ class _Instance:
             link = self.byz_link
 
         self.recorder = None
-        self.events_file = None
         if cluster.get("record_events"):
-            from mirbft_tpu.eventlog.record import Recorder
+            from mirbft_tpu.eventlog.journal import JournalRecorder
 
-            boot = len(list(ndir.glob("events-*.gz")))
-            self.events_file = open(ndir / f"events-{boot:03d}.gz", "wb")
-            self.recorder = Recorder(
+            # The always-on flight recorder (docs/OBSERVABILITY.md):
+            # segmented CRC-framed journal under node-<i>/journal/ with
+            # checkpoint-keyed retention and non-blocking overflow.  The
+            # Node binds its trace LRU to the recorder's trace_lookup
+            # slot, so recorded EventSteps carry fleet trace ids.
+            self.recorder = JournalRecorder(
+                ndir,
                 node_id,
-                self.events_file,
-                # Monotonic ms: the doctor pins its replay clock to these.
+                # Monotonic ms: the doctor pins its replay clock to
+                # these, and CLOCK_MONOTONIC is system-wide on Linux, so
+                # incident windows compare across local node processes.
                 time_source=lambda: time.monotonic_ns() // 1_000_000,
                 retain_request_data=True,
             )
@@ -532,6 +540,20 @@ class _Instance:
             if self.group_id is not None
             else f"n{node_id}"
         )
+        if self.recorder is not None:
+            from mirbft_tpu.eventlog.incident import AnomalyCapture
+
+            # Anomalies auto-capture incident bundles under
+            # <root>/incidents/ (flight_recorder_captures_total); the
+            # hook runs its file copies on a daemon thread, so detection
+            # never waits on disk.  The 2 s settle lets the condition's
+            # commit gap accumulate in the journal files past the
+            # replay stall threshold (STALL_GAP_MS) before the copy —
+            # the journal writer drains a queue, so the on-disk tail
+            # lags the detection instant by its flush cadence.
+            self.node.health_monitor.capture_hook = AnomalyCapture(
+                root, self.node_label, settle_s=2.0
+            )
         if self.fleet:
             self.app.trace_lookup = self.node.trace_id_of
 
@@ -710,7 +732,6 @@ class _Instance:
                 self.recorder.stop()
             except RuntimeError:
                 pass  # writer already failed; the log tail is simply torn
-            self.events_file.close()
         try:
             self.snapshot_metrics()  # final ledger for the doctor
         except Exception:
@@ -1064,10 +1085,13 @@ def run_deployment(
     timeout_s: float = 90.0,
     client_id: int = 0,
     pipeline: bool = True,
+    record_events: bool = True,
 ) -> dict:
     """Run a real multi-process deployment and return a result summary:
     ``{"commits": {node: n}, "agreement_problems": [...], "reconnects":
     {node: count}, "elapsed_s": ...}``.  Raises on timeout or divergence.
+    The flight recorder is on unless ``record_events=False``
+    (``--no-flight-recorder``).
     """
     owned_tmp = root_dir is None
     if owned_tmp:
@@ -1075,7 +1099,8 @@ def run_deployment(
     root = Path(root_dir)
     root.mkdir(parents=True, exist_ok=True)
     ports = _reserve_ports(node_count)
-    _write_cluster(root, node_count, ports, [client_id], pipeline=pipeline)
+    _write_cluster(root, node_count, ports, [client_id],
+                   pipeline=pipeline, record_events=record_events)
     for i in range(node_count):
         _node_dir(root, i).mkdir(parents=True, exist_ok=True)
 
@@ -1789,6 +1814,7 @@ def run_sharded_deployment(
     pipeline: bool = True,
     probe_redirect: bool = True,
     fleet: bool = False,
+    record_events: bool = True,
 ) -> dict:
     """Run ``groups`` independent consensus groups behind the routing
     tier and return a summary: per-group commit counts, the disjointness
@@ -1811,6 +1837,7 @@ def run_sharded_deployment(
         pipeline=pipeline,
         fleet=fleet,
         fleet_observers=observers_per_group,
+        record_events=record_events,
     ) as cluster:
         cluster.start()
         cluster.start_collector()
@@ -2307,6 +2334,34 @@ def _scenario_control(root: Path, seed: int, *, pipeline: bool = True) -> dict:
             )
     if res["agreement_problems"]:
         failures.append("; ".join(res["agreement_problems"]))
+    # Flight recorder on by default: the divergence audit over the
+    # always-on journals must come back clean (mircat --audit exit 0) —
+    # the determinism invariant enforced on a real deployment.
+    from mirbft_tpu.tools.mircat import audit_deployment
+
+    audit = audit_deployment(root)
+    res["audit"] = {
+        "clean": audit["clean"],
+        "divergence_count": audit["divergence_count"],
+        "verdicts": {
+            label: node["verdict"]
+            for label, node in audit["per_node"].items()
+        },
+    }
+    if not audit["clean"]:
+        failures.append(
+            f"divergence audit failed: "
+            f"{ {l: n['divergences'] for l, n in audit['per_node'].items() if n['divergences']} }"
+        )
+    if not audit["per_node"]:
+        failures.append("audit found no journaled nodes (flight recorder "
+                        "should be on by default)")
+    for label, node in audit["per_node"].items():
+        if node["verdict"] not in ("clean",):
+            failures.append(
+                f"audit verdict for {label} is {node['verdict']!r}, "
+                f"expected clean in a control run"
+            )
     return _verdict(root, "control", res, failures)
 
 
@@ -2387,7 +2442,93 @@ def _scenario_partition_minority(root: Path, seed: int, *, pipeline: bool = True
         failures.append(f"unexpected injected kinds: {noise}")
     if res["agreement_problems"]:
         failures.append("; ".join(res["agreement_problems"]))
+    _check_incident_capture(root, res, failures)
     return _verdict(root, "partition-minority", res, failures)
+
+
+def _check_incident_capture(
+    root: Path, res: dict, failures: List[str]
+) -> None:
+    """Flight-recorder acceptance for fault scenarios: the injected fault
+    must have auto-captured at least one complete incident bundle, the
+    bundle's deterministic replay must be byte-stable, and the replayed
+    commit stream must show the doctor-flagged outage — an inter-commit
+    gap overlapping the bundle window.  (A minority partition stops the
+    commit stream for everyone: no client traffic flows during the cut,
+    and the outage spans unreachable attribution plus the heal sleep, so
+    the replayed gap is well past the 1s stall threshold.)
+
+    Transport-only anomalies (``peer_fault``) never cross the state
+    machine, so replay cannot re-derive *them* — the reproduction bar for
+    those bundles is the commit gap; replay-visible kinds must also
+    reproduce their anomaly kind."""
+    from mirbft_tpu.eventlog.incident import replay_incident
+
+    replay_kinds = {
+        "watermark_stall",
+        "epoch_thrash",
+        "checkpoint_stagnation",
+        "client_starvation",
+        "msg_buffer_growth",
+    }
+    allowed = replay_kinds | {"peer_fault", "checkpoint_divergence"}
+    manifests = sorted(
+        (root / "incidents").glob("incident-*/manifest.json")
+    )
+    reasons: List[str] = []
+    for manifest_path in manifests:
+        try:
+            reasons.append(
+                json.loads(manifest_path.read_text()).get("reason", "?")
+            )
+        except ValueError:
+            failures.append(f"unreadable manifest {manifest_path}")
+    res["incident_bundles"] = {
+        "count": len(manifests),
+        "reasons": sorted(reasons),
+    }
+    if not manifests:
+        failures.append(
+            "no auto-captured incident bundle (the injected fault's "
+            "anomalies should have triggered HealthMonitor.capture_hook)"
+        )
+        return
+    for reason in reasons:
+        if reason not in allowed:
+            failures.append(
+                f"incident bundle captured for unexpected reason "
+                f"{reason!r}"
+            )
+    # Deep-check one bundle (they all carry every node's journal).
+    bundle = manifests[0].parent
+    manifest = json.loads(manifests[0].read_text())
+    first = replay_incident(bundle)
+    second = replay_incident(bundle)
+    if first != second:
+        failures.append(f"bundle {bundle.name} replay is not deterministic")
+    if not first["timeline"]:
+        failures.append(
+            f"bundle {bundle.name} replay produced an empty timeline"
+        )
+    window = manifest["window_ms"]
+    if not any(
+        s["until_ms"] >= window[0] and s["since_ms"] <= window[1]
+        for s in first["stalls"]
+    ):
+        failures.append(
+            f"bundle {bundle.name} replay shows no commit stall "
+            f"overlapping the captured window {window} "
+            f"(stalls={first['stalls']})"
+        )
+    if (
+        manifest["reason"] in replay_kinds
+        and manifest["reason"] not in first["anomaly_kinds"]
+    ):
+        failures.append(
+            f"bundle {bundle.name} replay did not reproduce the "
+            f"capturing anomaly {manifest['reason']!r} "
+            f"(got {first['anomaly_kinds']})"
+        )
 
 
 def _scenario_partition_leader(root: Path, seed: int, *, pipeline: bool = True) -> dict:
@@ -3144,6 +3285,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="run the fleet telemetry collector against "
                              "the deployment; its rolling output lands "
                              "under <dir>/fleet/ (--groups runs only)")
+    parser.add_argument("--no-flight-recorder", action="store_true",
+                        help="disable the always-on event journal "
+                             "(node-<i>/journal/); escape hatch for "
+                             "measuring raw throughput without the "
+                             "recorder")
     parser.add_argument("--top", action="store_true",
                         help="live fleet view over an existing --fleet "
                              "run's output (requires --dir; Ctrl-C exits)")
@@ -3194,6 +3340,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             timeout_s=args.timeout,
             pipeline=pipeline,
             fleet=args.fleet,
+            record_events=not args.no_flight_recorder,
         )
         print(json.dumps(result, indent=2, sort_keys=True))
         print(
@@ -3221,6 +3368,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         kill_restart=args.kill_restart,
         timeout_s=args.timeout,
         pipeline=pipeline,
+        record_events=not args.no_flight_recorder,
     )
     print(json.dumps(result, indent=2, sort_keys=True))
     print(

@@ -1,0 +1,455 @@
+"""Always-on flight recorder (mirbft_tpu/eventlog/journal.py,
+incident.py; docs/OBSERVABILITY.md "Flight recorder"): segmented
+CRC-framed journals with torn-tail recovery at every byte boundary,
+checkpoint-keyed retention bounding the on-disk footprint, non-blocking
+overflow on both recorders, the mircat divergence audit verdicts, and
+incident-bundle capture + deterministic replay."""
+
+import io
+import shutil
+import time
+
+import pytest
+
+from mirbft_tpu import messages as m
+from mirbft_tpu import metrics
+from mirbft_tpu import state as st
+from mirbft_tpu import wire
+from mirbft_tpu.eventlog import (
+    JournalRecorder,
+    Recorder,
+    SegmentSink,
+    journal_bytes,
+    load_boots,
+    read_event_log,
+)
+from mirbft_tpu.eventlog import incident as incident_mod
+from mirbft_tpu.eventlog import journal as journal_mod
+from mirbft_tpu.eventlog import record as record_mod
+from mirbft_tpu.statemachine.machine import StateMachine
+from mirbft_tpu.storage import segments
+from mirbft_tpu.testengine import Spec
+from mirbft_tpu.tools import mircat
+
+
+def tick_record(i):
+    return st.RecordedEvent(
+        node_id=0, time=1000 + i, state_event=st.EventTickElapsed()
+    )
+
+
+def run_sim_with_journals(root, node_count=4, reqs=6):
+    """One real testengine run with a JournalRecorder per node writing
+    under ``root/node-<i>``; returns the recorders (already stopped)."""
+    recorders = []
+
+    def factory(i):
+        rec = JournalRecorder(
+            root / f"node-{i}", i, registry=metrics.Registry()
+        )
+        recorders.append(rec)
+        return rec
+
+    spec = Spec(node_count=node_count, client_count=1, reqs_per_client=reqs)
+    recorder = spec.recorder()
+    recorder.interceptor_factory = factory
+    recording = recorder.recording()
+    recording.drain_clients(timeout=60000)
+    for rec in recorders:
+        rec.stop()
+    return recorders
+
+
+def write_live_logs(node_dir):
+    """Ground-truth ``commits.log`` for one node dir: what the node's
+    live commit path would have written, reconstructed once from the
+    journal (the audit then replays independently and must agree)."""
+    boots = load_boots(node_dir)
+    sm = StateMachine()
+    lines = []
+    for record, _trace in boots[-1].records:
+        for action in sm.apply_event(record.state_event):
+            if isinstance(action, st.ActionCommit):
+                lines.append(mircat._commit_line(action.batch))
+    (node_dir / "commits.log").write_text(
+        "".join(line + "\n" for line in lines)
+    )
+    return lines
+
+
+# --------------------------------------------------------------------------
+# Journal plane: roundtrip, trace annotation, torn tails, retention
+# --------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_from_engine_run(tmp_path):
+    run_sim_with_journals(tmp_path)
+    for i in range(4):
+        boots = load_boots(tmp_path / f"node-{i}")
+        assert len(boots) == 1
+        boot = boots[0]
+        assert boot.source == "journal"
+        assert boot.boot == 0
+        assert not boot.torn and not boot.crc_damage and not boot.pruned
+        assert boot.dropped == 0
+        assert boot.records, f"node {i} journaled nothing"
+        assert all(
+            isinstance(r, st.RecordedEvent) for r, _ in boot.records
+        )
+    assert journal_bytes(tmp_path / "node-0") > 0
+
+
+def test_trace_annotation_rides_the_framing(tmp_path):
+    rec = JournalRecorder(tmp_path, 0, registry=metrics.Registry())
+    rec.trace_lookup = lambda cid, req: 0xABC if (cid, req) == (7, 3) else 0
+    annotated = st.EventStep(
+        source=1,
+        msg=m.ForwardRequest(
+            request_ack=m.RequestAck(client_id=7, req_no=3, digest=b"d" * 32),
+            request_data=b"payload",
+        ),
+    )
+    plain = st.EventStep(
+        source=1,
+        msg=m.ForwardRequest(
+            request_ack=m.RequestAck(client_id=7, req_no=4, digest=b"d" * 32),
+            request_data=b"payload",
+        ),
+    )
+    rec.intercept(annotated)
+    rec.intercept(plain)
+    rec.stop()
+    (boot,) = load_boots(tmp_path)
+    assert [trace for _, trace in boot.records] == [0xABC, 0]
+
+
+def test_torn_journal_recovery_at_every_byte_boundary(tmp_path):
+    """SIGKILL mid-append can stop the final record at ANY byte.  Every
+    truncation point inside the final record must come back clean-cut:
+    the earlier records decoded, ``torn`` flagged, never an error — and
+    the audit must report it as a note, never divergence."""
+    src = tmp_path / "src"
+    sink = SegmentSink(src / "node-0" / "journal", 0)
+    records = [tick_record(i) for i in range(5)]
+    for record in records:
+        sink.append(journal_mod.TAG_EVENT, wire.encode(record))
+    sink.close()
+
+    (seg,) = list((src / "node-0" / "journal").glob("seg-*"))
+    raw = seg.read_bytes()
+    recs = list(segments.iter_records(raw))
+    last_start = recs[-1][2]
+    assert recs[-1][3] == len(raw)
+
+    for cut in range(last_start, len(raw)):
+        trial = tmp_path / f"cut-{cut}"
+        shutil.copytree(src, trial)
+        with open(trial / "node-0" / "journal" / seg.name, "r+b") as fh:
+            fh.truncate(cut)
+        (boot,) = load_boots(trial / "node-0")
+        assert boot.error is None, f"cut at byte {cut}"
+        got = [r for r, _ in boot.records]
+        assert got == records[:-1], f"cut at byte {cut}"
+        if cut > last_start:
+            assert boot.torn, f"cut at byte {cut}"
+
+        audit = mircat.audit_node(trial / "node-0")
+        assert audit["verdict"] == "clean", f"cut at byte {cut}"
+        assert not audit["divergences"]
+        if cut > last_start:
+            assert any("torn tail" in note for note in audit["notes"])
+
+
+def test_crc_damage_is_flagged_not_decoded(tmp_path):
+    sink = SegmentSink(tmp_path / "journal", 0)
+    for i in range(3):
+        sink.append(journal_mod.TAG_EVENT, wire.encode(tick_record(i)))
+    sink.close()
+    (seg,) = list((tmp_path / "journal").glob("seg-*"))
+    raw = bytearray(seg.read_bytes())
+    raw[-1] ^= 0xFF  # flip a payload byte in the final record
+    seg.write_bytes(bytes(raw))
+    (boot,) = load_boots(tmp_path)
+    assert boot.crc_damage
+    assert len(boot.records) == 2  # the damaged record never decodes
+
+
+def test_retention_bounds_footprint_across_checkpoint_intervals(tmp_path):
+    """The acceptance bound: with rotation + checkpoint-keyed retention,
+    the journal's on-disk footprint stops growing once more than
+    ``retain_checkpoints`` intervals have passed."""
+    sink = SegmentSink(
+        tmp_path / "journal", 0, rotate_bytes=256, retain_checkpoints=3
+    )
+    payload = wire.encode(tick_record(0))
+    sizes = []
+    for interval in range(8):
+        for _ in range(20):
+            sink.append(journal_mod.TAG_EVENT, payload)
+        sink.note_checkpoint((interval + 1) * 10)
+        sink.flush()
+        sizes.append(journal_bytes(tmp_path))
+    sink.close()
+
+    # Steady state: intervals past the retention depth stay bounded by
+    # the early-interval high-water mark (+ one in-flight segment).
+    assert max(sizes[4:]) <= max(sizes[:4]) + 256
+    # The head of the boot is really gone from disk.
+    indexes = [i for _, i, _ in journal_mod._segment_files(tmp_path / "journal")]
+    assert min(indexes) > 0
+    # And a reader classifies the pruned head honestly.
+    (boot,) = load_boots(tmp_path)
+    assert boot.pruned
+
+
+def test_boot_retention_prunes_old_boots_at_startup(tmp_path):
+    for _boot in range(5):
+        sink = SegmentSink(tmp_path / "journal", 0, retain_boots=3)
+        sink.append(journal_mod.TAG_EVENT, wire.encode(tick_record(0)))
+        sink.close()
+    boots = {b for b, _, _ in journal_mod._segment_files(tmp_path / "journal")}
+    assert boots == {2, 3, 4}
+
+
+# --------------------------------------------------------------------------
+# Overflow: the hot path never blocks on a slow writer (satellite fix)
+# --------------------------------------------------------------------------
+
+
+def test_journal_recorder_overflow_drops_oldest_never_blocks(tmp_path):
+    rec = JournalRecorder(
+        tmp_path, 0, buffer_size=8, registry=metrics.Registry()
+    )
+    orig_append = rec._sink.append
+
+    def throttled(tag, payload):
+        time.sleep(0.02)
+        orig_append(tag, payload)
+
+    rec._sink.append = throttled
+    start = time.monotonic()
+    for i in range(300):
+        rec.intercept(st.EventTickElapsed())
+    elapsed = time.monotonic() - start
+    # The old Recorder retry-loop would have stalled here for ~30 s.
+    assert elapsed < 1.0, f"intercept blocked for {elapsed:.2f}s"
+    assert rec.dropped_events > 0
+    rec.stop()
+
+    (boot,) = load_boots(tmp_path)
+    assert boot.dropped == rec.dropped_events  # TAG_GAP markers on disk
+    assert len(boot.records) == 300 - rec.dropped_events
+
+
+def test_legacy_recorder_overflow_drops_oldest_never_blocks(monkeypatch):
+    orig = record_mod.write_recorded_event
+
+    def throttled(stream, record):
+        time.sleep(0.02)
+        orig(stream, record)
+
+    monkeypatch.setattr(record_mod, "write_recorded_event", throttled)
+    dest = io.BytesIO()
+    rec = Recorder(0, dest, buffer_size=4)
+    start = time.monotonic()
+    for _ in range(300):
+        rec.intercept(st.EventTickElapsed())
+    elapsed = time.monotonic() - start
+    assert elapsed < 1.0, f"intercept blocked for {elapsed:.2f}s"
+    assert rec.dropped_events > 0
+    rec.stop()
+
+    written = list(read_event_log(io.BytesIO(dest.getvalue())))
+    assert len(written) == 300 - rec.dropped_events
+    assert all(isinstance(r, st.RecordedEvent) for r in written)
+
+
+# --------------------------------------------------------------------------
+# Divergence audit verdicts
+# --------------------------------------------------------------------------
+
+
+def test_audit_clean_on_faithful_deployment(tmp_path):
+    run_sim_with_journals(tmp_path)
+    total_commits = 0
+    for i in range(4):
+        total_commits += len(write_live_logs(tmp_path / f"node-{i}"))
+    assert total_commits > 0
+
+    report = mircat.audit_deployment(tmp_path)
+    assert report["clean"]
+    assert report["divergence_count"] == 0
+    assert set(report["per_node"]) == {f"n{i}" for i in range(4)}
+    for node in report["per_node"].values():
+        assert node["verdict"] == "clean"
+        assert node["compared"] > 0
+    assert (tmp_path / "audit.json").exists()
+    assert mircat.main([str(tmp_path), "--audit"]) == 0
+
+
+def test_audit_flags_tampered_live_log_as_divergent(tmp_path):
+    run_sim_with_journals(tmp_path)
+    for i in range(4):
+        write_live_logs(tmp_path / f"node-{i}")
+    log = tmp_path / "node-0" / "commits.log"
+    lines = log.read_text().splitlines()
+    seq, digest, reqs = lines[0].split(" ", 2)
+    flipped = "0" * len(digest) if digest[0] != "0" else "f" * len(digest)
+    lines[0] = f"{seq} {flipped} {reqs}"
+    log.write_text("".join(line + "\n" for line in lines))
+
+    audit = mircat.audit_node(tmp_path / "node-0")
+    assert audit["verdict"] == "divergent"
+    assert any("diverges" in d for d in audit["divergences"])
+    report = mircat.audit_deployment(tmp_path)
+    assert not report["clean"]
+    assert mircat.main([str(tmp_path), "--audit"]) == 1
+
+
+def test_audit_gapped_journal_skips_compare(tmp_path):
+    run_sim_with_journals(tmp_path)
+    write_live_logs(tmp_path / "node-0")
+    seg = sorted((tmp_path / "node-0" / "journal").glob("seg-*"))[-1]
+    with open(seg, "ab") as fh:
+        fh.write(
+            segments.encode_record(
+                journal_mod.TAG_GAP, journal_mod._uvarint(3)
+            )
+        )
+    audit = mircat.audit_node(tmp_path / "node-0")
+    assert audit["verdict"] == "gapped"
+    assert audit["compared"] == 0
+    assert not audit["divergences"]  # gapped is honest, not divergent
+
+
+def test_audit_observer_applied_stream(tmp_path):
+    node_dir = tmp_path / "observer-0"
+    sink = SegmentSink(node_dir / "journal", 0)
+    lines = [f"{seq} {'ab' * 32} 1:{seq}" for seq in (1, 2, 3)]
+    for seq, line in enumerate(lines, start=1):
+        sink.append(
+            journal_mod.TAG_APPLY,
+            journal_mod._uvarint(seq) + line.encode(),
+        )
+    sink.close()
+    (node_dir / "commits.log").write_text(
+        "".join(line + "\n" for line in lines)
+    )
+    audit = mircat.audit_node(node_dir)
+    assert audit["verdict"] == "clean"
+    assert audit["compared"] == 3
+
+    # A rewritten line in the observer's live log is hard divergence.
+    (node_dir / "commits.log").write_text(
+        lines[0] + "\n" + lines[1].replace("1:2", "9:9") + "\n" + lines[2] + "\n"
+    )
+    assert mircat.audit_node(node_dir)["verdict"] == "divergent"
+
+
+# --------------------------------------------------------------------------
+# Incident bundles: capture, deterministic replay, auto-capture hook
+# --------------------------------------------------------------------------
+
+
+def test_incident_capture_and_deterministic_replay(tmp_path):
+    run_sim_with_journals(tmp_path)
+    for i in range(4):
+        write_live_logs(tmp_path / f"node-{i}")
+
+    reg = metrics.Registry()
+    bundle = incident_mod.capture_incident(
+        tmp_path, (0.0, 1e15), reason="manual", registry=reg
+    )
+    assert reg.counter("flight_recorder_captures_total").value == 1
+
+    manifest = (bundle / "manifest.json").read_text()
+    import json
+
+    doc = json.loads(manifest)
+    assert tuple(sorted(doc)) == incident_mod.MANIFEST_KEYS
+    assert doc["nodes"] == [f"n{i}" for i in range(4)]
+    assert doc["reason"] == "manual"
+
+    first = incident_mod.replay_incident(bundle)
+    second = incident_mod.replay_incident(bundle)
+    assert first == second
+    assert first["timeline"], "replay reconstructed no timeline"
+    assert any(e["kind"] == "commit" for e in first["timeline"])
+    assert all(n["commits"] > 0 for n in first["nodes"])
+    assert all(n["error"] is None for n in first["nodes"])
+
+    rendered = incident_mod.format_replay(first)
+    assert doc["incident_id"] in rendered
+    assert "commit" in rendered
+
+    # Capture is idempotent: a complete bundle is never rewritten.
+    again = incident_mod.capture_incident(
+        tmp_path, (5.0, 6.0), reason="other", registry=reg
+    )
+    assert again == bundle or (bundle / "manifest.json").read_text() == manifest
+    assert mircat.main([str(bundle), "--incident"]) == 0
+
+
+def test_anomaly_capture_hook_one_bundle_per_kind(tmp_path):
+    from mirbft_tpu.health import Anomaly
+
+    run_sim_with_journals(tmp_path, node_count=1, reqs=2)
+    write_live_logs(tmp_path / "node-0")
+    reg = metrics.Registry()
+    hook = incident_mod.AnomalyCapture(
+        tmp_path, "n0", settle_s=0.0, registry=reg,
+        time_source=lambda: 100_000.0,
+    )
+    anomaly = Anomaly(
+        kind="watermark_stall", node_id=0, time=30.0, since=20.0
+    )
+    hook(anomaly)
+    hook(anomaly)  # same kind: first capture wins
+
+    bundle = tmp_path / "incidents" / "incident-n0-watermark_stall"
+    deadline = time.monotonic() + 10.0
+    while not (bundle / "manifest.json").exists():
+        assert time.monotonic() < deadline, "capture thread never finished"
+        time.sleep(0.05)
+    assert hook.captured == ["watermark_stall"]
+    assert reg.counter("flight_recorder_captures_total").value == 1
+
+    import json
+
+    doc = json.loads((bundle / "manifest.json").read_text())
+    assert doc["reason"] == "watermark_stall"
+    # Window is anchored at the hook instant in *wall ms* (the journal's
+    # clock domain): the anomaly's 10 s lead plus the 15 s pre-window
+    # back from now, the 2 s post-window forward.
+    assert doc["window_ms"] == [
+        100_000.0 - (10.0 + 15.0) * 1000.0,
+        100_000.0 + 2.0 * 1000.0,
+    ]
+
+
+# --------------------------------------------------------------------------
+# mirlint: manifest schema lockstep
+# --------------------------------------------------------------------------
+
+
+def test_mirlint_incident_manifest_lockstep():
+    from types import SimpleNamespace
+
+    from mirbft_tpu.tools.mirlint import check_incident_manifest
+
+    assert check_incident_manifest() == []
+
+    drifted = SimpleNamespace(
+        MANIFEST_KEYS=("b_key", "a_key"),
+        sample_manifest=lambda: {"a_key": 1, "extra": 2},
+    )
+    messages = [f.message for f in check_incident_manifest(drifted)]
+    assert any("not sorted" in msg for msg in messages)
+    assert any("lacks declared keys" in msg for msg in messages)
+    assert any("undeclared keys" in msg for msg in messages)
+
+    missing = SimpleNamespace(MANIFEST_KEYS=None, sample_manifest=dict)
+    assert any(
+        "missing or empty" in f.message
+        for f in check_incident_manifest(missing)
+    )
