@@ -1729,6 +1729,14 @@ def bench_sharded(detail, reqs_per_group=30, nodes_per_group=2,
       observer per group on the 2-group run; the history predates the
       feeds' retained backlog, so this path exercises the RESET +
       KIND_SNAPSHOT bootstrap, not just tailing.
+    - ``c6_cohost_2g_unique_req_per_s`` / ``c6_cohost_scaling_ratio``:
+      the same 2-group shard in the **cohost** layout (one process per
+      node index running a node of every group), where co-hosted groups
+      share one fused crypto wave when the backend supports it.
+      ``c6_layout_detail`` records whether the shared-wave mux actually
+      engaged or the hosts degraded to per-group host hashing (non-TPU
+      backend) — without that row a cohost number silently measured
+      without the mux would read as a mux result.
     """
     import shutil
     import tempfile
@@ -1792,6 +1800,132 @@ def bench_sharded(detail, reqs_per_group=30, nodes_per_group=2,
     detail["c6_1g_unique_req_per_s"] = round(rates[1], 1)
     detail["c6_2g_unique_req_per_s"] = round(rates[2], 1)
     detail["c6_scaling_ratio"] = round(rates[2] / max(rates[1], 1e-9), 2)
+
+    # Cohost layout: the same 2-group shard packed into nodes_per_group
+    # host processes (one node of every group each), sharing one fused
+    # crypto wave per host when the backend supports it.
+    root = tempfile.mkdtemp(prefix="bench-shard-cohost-")
+    try:
+        with mirnet._ShardedCluster(
+            root,
+            groups=2,
+            nodes_per_group=nodes_per_group,
+            layout="cohost",
+            timeout_s=timeout_s,
+        ) as cluster:
+            cluster.start()
+            client = mirnet._connect_routed(
+                cluster.map.members(0)[0], timeout_s
+            )
+            t0 = time.monotonic()
+            try:
+                for g in range(2):
+                    cluster.submit_group(g, 0, reqs_per_group, client=client)
+                for g in range(2):
+                    cluster.wait_commits(g, reqs_per_group)
+            finally:
+                client.close()
+            cohost_rate = (
+                2 * reqs_per_group / max(time.monotonic() - t0, 1e-9)
+            )
+            # Honesty row: did the shared-wave mux engage, or did the
+            # hosts degrade to per-group host hashing (non-TPU backend)?
+            time.sleep(1.0)  # let a metrics.prom snapshot land
+            mux_active = mirnet._metric_file_value(
+                mirnet._node_dir(mirnet._group_dir(cluster.root, 0), 0)
+                / "metrics.prom",
+                "wave_mux_active",
+            )
+            detail["c6_layout_detail"] = (
+                "cohost: shared-wave mux active"
+                if mux_active >= 1.0
+                else "cohost: mux degraded to per-group host hashing "
+                "(non-TPU backend)"
+            )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    detail["c6_cohost_2g_unique_req_per_s"] = round(cohost_rate, 1)
+    detail["c6_cohost_scaling_ratio"] = round(
+        cohost_rate / max(rates[1], 1e-9), 2
+    )
+
+
+def bench_cohost_fused(detail, groups=2, rows_per_group=96, msg_len=608,
+                       rounds=6):
+    """Cross-group wave anatomy (docs/PERFORMANCE.md §16): drive a 2-group
+    ``CohostCryptoPlane`` in-process and compare the shared group-tagged
+    wave against per-group fused pipelines hashing the SAME rows.  On
+    record:
+
+    - ``fused_wave_occupancy``: real rows over padded wave rows on the
+      shared wave (the amortization the mux exists to buy — two groups'
+      half-waves fill one wave instead of padding two).
+    - ``c6_cohost_fused_groups_per_wave``: tenants riding the last wave.
+    - ``c6_cohost_fused_rows_per_s`` vs ``c6_cohost_fused_solo_rows_per_s``
+      and their ratio ``c6_cohost_fused_amortization``: same rows, muxed
+      (one wave per round) vs per-group pipelines (two half-empty waves
+      per round).
+    """
+    import hashlib
+
+    from mirbft_tpu import metrics as metrics_mod
+    from mirbft_tpu.groups.cohost import CohostCryptoPlane
+    from mirbft_tpu.ops.fused import FusedCryptoPipeline
+    from mirbft_tpu.testengine.crypto import DeviceHashPlane
+
+    wave = groups * rows_per_group
+    pad = b"\x00" * msg_len  # > _host_fast threshold: rows take the device
+
+    def fresh_rows(tag, r):
+        return [
+            [
+                [b"cohost-%s-%d-%d-%d" % (tag, r, g, i) + pad]
+                for i in range(rows_per_group)
+            ]
+            for g in range(groups)
+        ]
+
+    # --- muxed: one CohostCryptoPlane, groups share each wave ---
+    # Fixed wave size: the quantity under measurement is the shared-wave
+    # amortization at a known shape, not the controller's convergence.
+    plane = CohostCryptoPlane(groups, wave_size=wave, adaptive=False)
+    hashers = [plane.hasher_for(g) for g in range(groups)]
+
+    def run_round(hs, batches):
+        handles = [hs[g].dispatch_batches(batches[g]) for g in range(groups)]
+        return [hs[g].collect_batches(handles[g]) for g in range(groups)]
+
+    warm = fresh_rows(b"mux-warm", 0)
+    digests = run_round(hashers, warm)
+    for g in range(groups):  # bit-identity vs hashlib before timing
+        for i, digest in enumerate(digests[g]):
+            assert digest == hashlib.sha256(warm[g][i][0]).digest()
+
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        run_round(hashers, fresh_rows(b"mux", r))
+    muxed_s = time.perf_counter() - t0
+    occupancy = metrics_mod.gauge("fused_wave_occupancy").value
+    groups_per_wave = metrics_mod.gauge("wave_mux_groups_per_wave").value
+
+    # --- solo: per-group fused pipelines, same rows, no sharing ---
+    solo = []
+    for g in range(groups):
+        p = DeviceHashPlane(device=True, wave_size=wave, adaptive=False)
+        p.attach_fused(FusedCryptoPipeline())
+        solo.append(p)
+    run_round(solo, fresh_rows(b"solo-warm", 0))
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        run_round(solo, fresh_rows(b"solo", r))
+    solo_s = time.perf_counter() - t0
+
+    total_rows = groups * rows_per_group * rounds
+    detail["fused_wave_occupancy"] = round(occupancy, 3)
+    detail["c6_cohost_fused_groups_per_wave"] = round(groups_per_wave, 1)
+    detail["c6_cohost_fused_rows_per_s"] = round(total_rows / muxed_s, 1)
+    detail["c6_cohost_fused_solo_rows_per_s"] = round(total_rows / solo_s, 1)
+    detail["c6_cohost_fused_amortization"] = round(solo_s / muxed_s, 2)
 
 
 def bench_fleet_scrape(detail, cycles=20, events_per_cycle=200,
@@ -1993,7 +2127,8 @@ def guard_pipeline_planes(detail):
     """The pipeline must not tax the planes it composes, and the pipelined
     headline must hold what it won: this run's ``wal_append_mb_s``,
     ``fused_wave_4096_ms``, ``pipeline_e2e_hashes_per_s``,
-    ``c1_4n_unique_req_per_s``, ``c6_2g_unique_req_per_s`` and
+    ``c1_4n_unique_req_per_s``, ``c6_2g_unique_req_per_s``,
+    ``c6_scaling_ratio``, ``fused_wave_occupancy`` and
     ``observer_catchup_s`` must stay within ±25% (in the direction
     that hurts) of the most recent recorded bench round carrying the key
     (``BENCH_r*.json``) — the ``hash_sync_regression`` guard pattern.
@@ -2026,6 +2161,8 @@ def guard_pipeline_planes(detail):
                             ("pipeline_e2e_hashes_per_s", False),
                             ("c1_4n_unique_req_per_s", False),
                             ("c6_2g_unique_req_per_s", False),
+                            ("c6_scaling_ratio", False),
+                            ("fused_wave_occupancy", False),
                             ("observer_catchup_s", True)):
         current = detail.get(key)
         ref, source = latest_recorded(key)
@@ -2325,6 +2462,11 @@ def main():
         bench_sharded(detail)
     except Exception as exc:
         detail["sharded_error"] = f"{type(exc).__name__}: {exc}"[:160]
+    try:
+        # Cross-group wave anatomy: shared cohost wave vs per-group waves.
+        bench_cohost_fused(detail)
+    except Exception as exc:
+        detail["cohost_fused_error"] = f"{type(exc).__name__}: {exc}"[:160]
     try:
         # Fleet observability plane: scrape-cycle cost + the <2% guard.
         bench_fleet_scrape(detail)

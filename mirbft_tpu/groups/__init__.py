@@ -12,11 +12,16 @@ package supplies everything *above* the protocol core:
 * :mod:`~mirbft_tpu.groups.observer` — the non-voting
   :class:`Observer`/learner role: snapshot bootstrap over KIND_SNAPSHOT,
   then log tailing to a bit-identical checkpoint state.
+* :mod:`~mirbft_tpu.groups.cohost` — the shared crypto plane for the
+  cohost layout: one :class:`CohostCryptoPlane` multiplexes every
+  co-hosted group's hash/verify work into shared group-tagged fused
+  device waves (``testengine.crypto.SharedWaveMux``).
 
 Deployment wiring (topology files, child processes, scenarios) lives in
 ``tools/mirnet.py``; this package has no process-management concerns.
 """
 
+from .cohost import CohostCryptoPlane
 from .observer import Observer
 from .routing import (
     CLIENT_BUSY,
@@ -35,6 +40,7 @@ __all__ = [
     "CLIENT_OK",
     "CLIENT_REDIRECT",
     "CLIENT_REQ",
+    "CohostCryptoPlane",
     "GroupMap",
     "Observer",
     "RoutedClient",

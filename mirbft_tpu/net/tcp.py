@@ -40,7 +40,7 @@ import struct
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import metrics as metrics_mod
 from .. import tracing, wire
@@ -71,6 +71,10 @@ BACKOFF = "backoff"
 MIRLINT_SHARED_STATE = {
     "_Peer.frames": "cond",
     "_Peer.queued_bytes": "cond",
+    "_ConnSender.pending": "cond",
+    "_ConnSender.pending_bytes": "cond",
+    "_ConnSender.writing": "cond",
+    "_ConnSender.error": "cond",
     "TcpTransport._conns": "_conns_lock",
 }
 
@@ -111,6 +115,73 @@ class _Peer:
         self.down_since: Optional[float] = None
         self.fault_recorded = False
         self.thread: Optional[threading.Thread] = None
+
+
+class _ConnSender:
+    """Writer-combining batched sender for one accepted connection.
+
+    Group-plane pushes (ShipFeed), client replies, and telemetry answers
+    can all race on the same inbound socket, and ``sendall`` under a
+    plain lock serializes every producer behind the slowest subscriber
+    (docs/PERFORMANCE.md §16).  Producers instead append the encoded
+    frame under the condition and the first appender becomes the
+    *writer*: it swaps the whole pending batch out, drops the lock, and
+    pushes the batch with one ``sendall`` — a burst of N frames costs
+    one syscall, and every non-writer producer returns after a list
+    append instead of queueing behind the socket.  Pending bytes are
+    bounded: a producer over the budget blocks until the writer drains
+    (the pre-batching behaviour — blocking in ``sendall`` under the
+    lock — with the socket timeout surfacing as a latched connection
+    error that every later sender re-raises)."""
+
+    MAX_PENDING_BYTES = 4 << 20
+
+    __slots__ = ("conn", "cond", "pending", "pending_bytes", "writing", "error")
+
+    def __init__(self, conn: socket.socket):
+        self.conn = conn
+        self.cond = threading.Condition()
+        self.pending: List[bytes] = []
+        self.pending_bytes = 0
+        self.writing = False
+        self.error: Optional[BaseException] = None
+
+    def send(self, frame: bytes, wait_hist, tx_bytes) -> None:
+        t0 = time.perf_counter()
+        with self.cond:
+            wait_hist.observe(time.perf_counter() - t0)
+            while (
+                self.error is None
+                and self.writing
+                and self.pending_bytes >= self.MAX_PENDING_BYTES
+            ):
+                self.cond.wait()
+            if self.error is not None:
+                raise self.error
+            self.pending.append(frame)
+            self.pending_bytes += len(frame)
+            if self.writing:
+                return  # the active writer flushes this frame
+            self.writing = True
+        while True:
+            with self.cond:
+                if not self.pending:
+                    self.writing = False
+                    self.cond.notify_all()
+                    return
+                batch = b"".join(self.pending)
+                self.pending.clear()
+                self.pending_bytes = 0
+                self.cond.notify_all()
+            try:
+                self.conn.sendall(batch)
+            except BaseException as exc:
+                with self.cond:
+                    self.error = exc
+                    self.writing = False
+                    self.cond.notify_all()
+                raise
+            tx_bytes.inc(len(batch))
 
 
 class TcpTransport:
@@ -443,16 +514,17 @@ class TcpTransport:
         source: Optional[int] = None
         # Group-plane pushes (ShipFeed) come from the node's app thread
         # while this reader may be answering on the same socket, so every
-        # send on this connection goes through one lock.
-        send_lock = threading.Lock()
+        # send on this connection goes through one writer-combining
+        # batcher: frames enqueue under the condition, one producer at a
+        # time drains the batch with a single sendall outside it.
+        sender = _ConnSender(conn)
 
         def locked_send(kind: int, payload: bytes) -> None:
-            frame = encode_frame(kind, payload)
-            t0 = time.perf_counter()
-            with send_lock:
-                self._send_lock_wait.observe(time.perf_counter() - t0)
-                conn.sendall(frame)
-            self._tx_bytes.inc(len(frame))
+            sender.send(
+                encode_frame(kind, payload),
+                self._send_lock_wait,
+                self._tx_bytes,
+            )
 
         def reply(payload: bytes) -> None:
             locked_send(KIND_CLIENT, payload)

@@ -357,6 +357,17 @@ class Node:
         self.inbox.put(("stop", None))
         for thread in self._threads:
             thread.join(timeout=5)
+        # A hasher with device waves still in flight (the cohost shared
+        # wave, or any plane-backed hasher) must drain them before the
+        # runtime is torn down — an uncollected wave would pin its pooled
+        # packing lease and, on a shared mux, leave a dead tenant's rows
+        # in other groups' waves.
+        flush = getattr(self.processor_config.hasher, "flush_inflight", None)
+        if flush is not None:
+            try:
+                flush()
+            except Exception:
+                pass  # best-effort: shutdown must not fail on a flush race
         if not self.notifier.exit_status_event.is_set():
             self.notifier.set_exit_status(
                 status_mod.snapshot(self.state_machine)

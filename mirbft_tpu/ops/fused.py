@@ -87,6 +87,8 @@ def _fused_body(
     valid,
     digest_rows,
     claimed,
+    row_groups,
+    touch_groups,
     *,
     layout: str,
     backend: str,
@@ -103,11 +105,17 @@ def _fused_body(
     # digest table.  The previous wave's words never left HBM — chaining
     # concatenates device-resident arrays in-program (``prev_words`` is a
     # one-row dummy on unchained waves; the host pre-offsets the rows).
+    # ``row_groups`` tags every combined row with its owning group and
+    # ``touch_groups`` every gated touch — in a multiplexed wave a gate
+    # only opens when the digest matches AND the row belongs to the
+    # touch's group, so one tenant's content can never satisfy another
+    # tenant's quorum gate, even on a forged cross-group row index.
     combined = jnp.concatenate([prev_words, digests], axis=0)
     gate = digest_rows >= 0
     rows = jnp.clip(digest_rows, 0, combined.shape[0] - 1)
     eq = jnp.all(combined[rows] == claimed, axis=-1)
-    gated_valid = valid & (~gate | eq)
+    grp_ok = row_groups[rows] == touch_groups
+    gated_valid = valid & (~gate | (eq & grp_ok))
     masks, counts, posts, newbits = accumulate_body(
         masks, counts, sources, touches, gated_valid
     )
@@ -145,11 +153,11 @@ class FusedDispatch:
         "words", "count", "rows", "layout", "lease",
         "ok", "valid", "verify_count",
         "posts", "newbits", "auth_keys", "auth_items",
-        "chain", "row_map",
+        "chain", "row_map", "groups",
     )
 
     def __init__(self, words, count, rows, layout, lease, ok, valid,
-                 verify_count, posts, newbits, chain=None):
+                 verify_count, posts, newbits, chain=None, groups=None):
         self.words = words
         self.count = count
         # Padded device row count — the chained row space the NEXT wave's
@@ -170,6 +178,10 @@ class FusedDispatch:
         # partial collects.
         self.chain = chain
         self.row_map = None
+        # Per-row owning group over the padded row space (int32 [rows]);
+        # chained successor waves concatenate this when building their
+        # combined row-group column.
+        self.groups = groups
 
 
 class FusedResult:
@@ -192,6 +204,13 @@ class FusedCryptoPipeline:
     pad to minimal fixed shapes so the jitted program count stays bounded:
     a signed-free wave carries one invalid verify row, a quorum-free wave
     one all-invalid touch wave.
+
+    ``n_groups`` makes the pipeline multi-tenant: the quorum plane grows
+    to ``n_groups`` stacked per-group slabs (group ``g``'s slot ``w`` lives
+    at row ``g * n_slots + w``), quorum entries may carry a leading group
+    id (``(group, source, rows)``), and every digest row is tagged with
+    its owning group so gates stay closed across tenants.  One-group
+    callers see the exact legacy behavior.
     """
 
     def __init__(
@@ -201,8 +220,11 @@ class FusedCryptoPipeline:
         kernel: str = "auto",
         touch_k: int = 8,
         verify_kernel: str = "auto",
+        n_groups: int = 1,
     ):
         self.touch_k = touch_k
+        self.n_slots = n_slots
+        self.n_groups = n_groups
         self.hasher = TpuHasher(min_device_batch=1, kernel=kernel)
         from .ed25519 import Ed25519BatchVerifier
 
@@ -214,9 +236,11 @@ class FusedCryptoPipeline:
             min_device_batch=1, kernel=verify_kernel
         )
         self.masks = jnp.zeros(
-            (n_slots, n_digest_slots, MASK_WORDS), dtype=jnp.uint32
+            (n_groups * n_slots, n_digest_slots, MASK_WORDS), dtype=jnp.uint32
         )
-        self.counts = jnp.zeros((n_slots, n_digest_slots), dtype=jnp.int32)
+        self.counts = jnp.zeros(
+            (n_groups * n_slots, n_digest_slots), dtype=jnp.int32
+        )
         self._interpret = jax.default_backend() != "tpu"
         self._donate = jax.default_backend() == "tpu"
 
@@ -230,15 +254,21 @@ class FusedCryptoPipeline:
     def _pack_quorum(
         self, quorum, total_rows: int, row_offset: int = 0
     ):
-        """(sources, touches, valid, digest_rows, claimed) fixed-shape
-        arrays from [(source, [(w, d, digest_row, claimed_digest|None)])].
+        """(sources, touches, valid, digest_rows, claimed, touch_groups)
+        fixed-shape arrays from
+        ``[(source, [(w, d, digest_row, claimed_digest|None)])]`` or the
+        group-tagged ``[(group, source, rows)]`` form (the two may mix —
+        an untagged entry is group 0).
 
         ``total_rows`` is the caller-visible gated row space; the device
         program prepends ``prev_words`` before indexing, so unchained
         waves shift every gated row past the one-row dummy
         (``row_offset=1``) while chained waves pass rows through
         (``row_offset=0`` — the combined [chain; current] space IS the
-        device space)."""
+        device space).  Group-tagged entries land in their group's slab:
+        slot ``w`` is offset to ``group * n_slots + w`` host-side, and the
+        entry's group rides along as the touch's group tag for the
+        device-side cross-tenant gate check."""
         k = self.touch_k
         n = _next_pow2(len(quorum)) if quorum else 1
         sources = np.zeros(n, dtype=np.int32)
@@ -246,13 +276,30 @@ class FusedCryptoPipeline:
         valid = np.zeros((n, k), dtype=bool)
         digest_rows = np.full((n, k), -1, dtype=np.int32)
         claimed = np.zeros((n, k, 8), dtype=np.uint32)
-        for i, (source, rows) in enumerate(quorum):
+        touch_groups = np.zeros((n, k), dtype=np.int32)
+        for i, entry in enumerate(quorum):
+            if len(entry) == 3:
+                group, source, rows = entry
+            else:
+                group, (source, rows) = 0, entry
+            if not 0 <= group < self.n_groups:
+                raise ValueError(
+                    f"group {group} outside pipeline of {self.n_groups}"
+                )
             if len(rows) > k:
                 raise ValueError(f"wave {i} exceeds K={k} touches")
             sources[i] = source
             for j, (w, d, row, claim) in enumerate(rows):
-                touches[i, j] = (w, d)
+                if self.n_groups > 1 and not 0 <= w < self.n_slots:
+                    # Multi-tenant slabs are adjacent: an out-of-range slot
+                    # would land in a neighbor group's rows, so it is an
+                    # error rather than the single-tenant clip-to-edge.
+                    raise ValueError(
+                        f"slot {w} outside group slab of {self.n_slots}"
+                    )
+                touches[i, j] = (group * self.n_slots + w, d)
                 valid[i, j] = True
+                touch_groups[i, j] = group
                 if row is not None and row >= 0:
                     if row >= total_rows:
                         raise ValueError(
@@ -262,7 +309,7 @@ class FusedCryptoPipeline:
                     claimed[i, j] = np.frombuffer(
                         claim, dtype=">u4"
                     ).astype(np.uint32)
-        return sources, touches, valid, digest_rows, claimed
+        return sources, touches, valid, digest_rows, claimed, touch_groups
 
     def _stage(self, arr):
         if self._donate:
@@ -280,14 +327,21 @@ class FusedCryptoPipeline:
         batch_bucket: Optional[int] = None,
         packed: Optional[PackedWave] = None,
         chain: Optional[FusedDispatch] = None,
+        groups: Optional[Sequence[int]] = None,
     ) -> FusedDispatch:
         """ONE device dispatch covering all three stages.
 
         ``messages`` (or a pre-``pack``ed wave) feed the hash stage;
         ``signed`` is the verify stage's (pubs, msgs, sigs); ``quorum`` is a
         wave stream ``[(source, [(slot, digest_slot, digest_row|None,
-        claimed_digest)])]`` whose gated touches compare against this very
-        wave's digests.  Returns without blocking on the device.
+        claimed_digest)])]`` — or group-tagged ``[(group, source, rows)]``
+        in a multi-tenant pipeline — whose gated touches compare against
+        this very wave's digests.  Returns without blocking on the device.
+
+        ``groups`` tags message row ``i`` with its owning group id; a
+        multiplexed wave interleaves several groups' rows and the tags
+        keep digest gating tenant-correct on device.  ``None`` means
+        every row belongs to group 0 (the legacy single-tenant wave).
 
         ``chain`` threads the PREVIOUS wave's device-resident digest words
         into this program's gate: gated ``digest_row``s then index the
@@ -304,16 +358,40 @@ class FusedCryptoPipeline:
             batch_rows = packed.blocks.shape[0] * TILE
         else:
             batch_rows = packed.blocks.shape[0]
+        # Per-row group column over the padded row space.  Legacy waves
+        # (no tags) are all group 0 everywhere, padding included, so their
+        # gate arithmetic is bit-identical to the pre-multi-tenant program;
+        # tagged waves mark padding rows -1 — fail-closed against a gate
+        # that references a padding row across groups.
+        if groups is None:
+            cur_groups = np.zeros(batch_rows, dtype=np.int32)
+        else:
+            if len(groups) > batch_rows:
+                raise ValueError("more group tags than wave rows")
+            cur_groups = np.full(batch_rows, -1, dtype=np.int32)
+            cur_groups[: len(groups)] = np.asarray(groups, dtype=np.int32)
         if chain is not None:
             if chain.words is None:
                 raise ValueError("chained handle's digests were released")
             prev_words = chain.words
             row_offset = 0
             total_rows = chain.rows + batch_rows
+            prev_groups = (
+                chain.groups
+                if chain.groups is not None
+                else np.zeros(chain.rows, dtype=np.int32)
+            )
         else:
             prev_words = np.zeros((1, 8), dtype=np.uint32)
             row_offset = 1
             total_rows = batch_rows
+            # The dummy row gates closed for every group when tags are in
+            # play; group 0 when untagged, matching the legacy program
+            # (its zero digest words never equal a real claim anyway).
+            prev_groups = np.zeros(1, dtype=np.int32)
+            if groups is not None:
+                prev_groups = np.full(1, -1, dtype=np.int32)
+        row_groups = np.concatenate([prev_groups, cur_groups])
 
         if signed and len(signed[0]):
             pubs, vmsgs, sigs = signed
@@ -332,8 +410,8 @@ class FusedCryptoPipeline:
             valid = np.zeros(1, dtype=bool)
             verify_count = 0
 
-        sources, touches, tvalid, digest_rows, claimed = self._pack_quorum(
-            quorum or [], total_rows, row_offset
+        sources, touches, tvalid, digest_rows, claimed, touch_groups = (
+            self._pack_quorum(quorum or [], total_rows, row_offset)
         )
 
         backend = self.verifier.resolved_kernel()
@@ -357,6 +435,8 @@ class FusedCryptoPipeline:
             self._stage(tvalid),
             self._stage(digest_rows),
             self._stage(claimed),
+            self._stage(row_groups),
+            self._stage(touch_groups),
         )
         m = _metrics()
         m.histogram("hash_device_dispatch_seconds").observe(
@@ -364,9 +444,12 @@ class FusedCryptoPipeline:
         )
         m.counter("fused_wave_dispatches").inc()
         m.counter("fused_wave_messages").inc(packed.count)
+        if batch_rows:
+            m.gauge("fused_wave_occupancy").set(packed.count / batch_rows)
         return FusedDispatch(
             digests, packed.count, batch_rows, packed.layout, packed.lease,
             ok, valid, verify_count, posts, newbits, chain=chain,
+            groups=cur_groups,
         )
 
     def collect(self, handle: FusedDispatch) -> FusedResult:
@@ -437,6 +520,9 @@ def host_fused_reference(
     touch_k: int = 8,
     prev_digests: Optional[Sequence[bytes]] = None,
     prev_rows: Optional[int] = None,
+    groups: Optional[Sequence[int]] = None,
+    prev_groups: Optional[Sequence[int]] = None,
+    n_slots: Optional[int] = None,
 ) -> Tuple[List[bytes], np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Pure-host oracle for the fused wave: hashlib digests, RFC 8032
     verdicts, and numpy quorum accumulation with identical digest gating.
@@ -447,13 +533,24 @@ def host_fused_reference(
     occupying rows ``[0, prev_rows)`` (``prev_rows`` defaults to
     ``len(prev_digests)``; pass the chained handle's padded ``rows`` when
     mirroring device padding).  Rows in the padding gap gate closed, like
-    the device's zero-padded digest rows never matching a real claim."""
+    the device's zero-padded digest rows never matching a real claim.
+
+    The multi-tenant wave is mirrored too: ``groups`` tags message ``i``
+    with its group (default: all group 0), ``prev_groups`` the chained
+    rows, and quorum entries may be group-tagged ``(group, source, rows)``
+    — the entry's slots land at ``group * n_slots`` in the stacked slab
+    (``n_slots`` is required for tagged entries) and a gate only opens
+    when the referenced row's group equals the entry's group."""
     import hashlib
 
     from .ed25519 import verify_one
 
     digests = [hashlib.sha256(m).digest() for m in messages]
+    row_tags = list(groups) if groups is not None else [0] * len(messages)
+    if len(row_tags) != len(messages):
+        raise ValueError("groups must tag every message")
     prev = list(prev_digests or [])
+    prev_tags = list(prev_groups) if prev_groups is not None else [0] * len(prev)
     offset = len(prev) if prev_rows is None else prev_rows
     if signed and len(signed[0]):
         verdicts = np.array(
@@ -468,16 +565,29 @@ def host_fused_reference(
     sources = np.zeros(n, dtype=np.int32)
     touches = np.zeros((n, k, 2), dtype=np.int32)
     valid = np.zeros((n, k), dtype=bool)
-    for i, (source, rows) in enumerate(quorum):
+    for i, entry in enumerate(quorum):
+        if len(entry) == 3:
+            group, source, rows = entry
+            if n_slots is None:
+                raise ValueError("group-tagged quorum entries need n_slots")
+            slot_base = group * n_slots
+        else:
+            group, (source, rows) = 0, entry
+            slot_base = 0
         sources[i] = source
         for j, (w, d, row, claim) in enumerate(rows):
-            touches[i, j] = (w, d)
+            touches[i, j] = (slot_base + w, d)
             gate_ok = True
             if row is not None and row >= 0:
                 if row < offset:
-                    gate_ok = row < len(prev) and prev[row] == claim
+                    gate_ok = (
+                        row < len(prev)
+                        and prev[row] == claim
+                        and prev_tags[row] == group
+                    )
                 else:
-                    gate_ok = digests[row - offset] == claim
+                    r = row - offset
+                    gate_ok = digests[r] == claim and row_tags[r] == group
             valid[i, j] = gate_ok
     masks, counts, posts, newbits = host_accumulate(
         masks, counts, sources, touches, valid

@@ -359,6 +359,13 @@ class CryptoConfig:
     # events spin faster than the device round-trip elapses — opt in only
     # when the host has spare cores to burn during device waits.
     defer_unready: bool = False
+    # Co-hosted multi-group mode: attach this recording's crypto planes to
+    # a shared cross-group SharedWaveMux (testengine/crypto.py) as tenant
+    # ``mux_group`` instead of building a private fused pipeline.  Every
+    # recording sharing the mux rides the same fused device waves;
+    # digests/verdicts stay bit-identical (tests/test_wave_mux.py).
+    mux: object = None
+    mux_group: int = 0
 
 
 class SimClient:
@@ -562,7 +569,9 @@ class Recorder:
             for client_id, pub in signed_pubs.items():
                 auth_plane.register(client_id, pub)
 
-        if crypto.fused and crypto.device:
+        if crypto.mux is not None and crypto.device:
+            hash_plane.attach_mux(crypto.mux, crypto.mux_group, auth_plane)
+        elif crypto.fused and crypto.device:
             from ..ops.fused import FusedCryptoPipeline
 
             hash_plane.attach_fused(

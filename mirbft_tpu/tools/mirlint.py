@@ -949,10 +949,14 @@ REQUIRED_METRIC_NAMES = (
     "net_reconnects_total",
     "net_peer_queue_depth",
     "net_peer_up",
-    # Fused device pipeline (ops/fused.py) + adaptive wave sizing.
+    # Fused device pipeline (ops/fused.py) + adaptive wave sizing
+    # + cross-group wave multiplexer (testengine/crypto.py SharedWaveMux).
     "fused_wave_dispatches",
     "fused_wave_messages",
     "hash_wave_autotune_size",
+    "fused_wave_occupancy",
+    "wave_mux_groups_per_wave",
+    "wave_mux_rows_total",
     # Fault-injection plane (net/faults.py, docs/FAULTS.md).
     "net_faults_injected_total",
     "net_frames_corrupted_total",

@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import signal
 import socket
@@ -373,7 +374,8 @@ class _Instance:
     ``submit_router`` so any of the host's listening ports serves client
     envelopes for every co-hosted group."""
 
-    def __init__(self, root: Path, node_id: int, submit_router=None):
+    def __init__(self, root: Path, node_id: int, submit_router=None,
+                 hasher=None):
         from mirbft_tpu import metrics as metrics_mod
         from mirbft_tpu.config import Config, standard_initial_network_state
         from mirbft_tpu.health import HealthThresholds
@@ -516,8 +518,12 @@ class _Instance:
             node_id,
             Config(**cfg),
             ProcessorConfig(
+                # ``hasher``: injected by run_host when the cohost layout
+                # shares one fused device wave across groups
+                # (groups/cohost.py); every other layout keeps the
+                # per-process CPU hasher.
+                hasher=hasher if hasher is not None else CpuHasher(),
                 link=link,
-                hasher=CpuHasher(),
                 app=self.app,
                 wal=self.wal,
                 request_store=self.request_store,
@@ -783,6 +789,46 @@ def run_node(root: Path, node_id: int) -> int:
     return _child_loop([inst], stop)
 
 
+def _build_cohost_plane(n_groups: int, shard: dict):
+    """The host's shared crypto plane, or ``None`` when the deployment did
+    not ask for it / no accelerator backend is present.
+
+    Importing jax costs seconds, so a host pinned to a CPU backend via
+    ``JAX_PLATFORMS`` skips the import outright; otherwise the backend is
+    probed and the plane only built on a real accelerator.  Either way the
+    resolution is recorded in the ``wave_mux_active`` gauge (it lands in
+    every co-hosted metrics.prom snapshot), which is what bench's
+    ``c6_layout_detail`` reports so cohost-vs-disjoint comparisons across
+    rounds stay apples-to-apples."""
+    from mirbft_tpu import metrics as metrics_mod
+
+    active_gauge = metrics_mod.default_registry.gauge("wave_mux_active")
+    if not shard.get("shared_wave"):
+        active_gauge.set(0)
+        return None
+    force = os.environ.get("MIRNET_SHARED_WAVE", "") == "force"
+    if not force:
+        platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+        if platforms and "tpu" not in platforms:
+            active_gauge.set(0)
+            return None
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            active_gauge.set(0)
+            return None
+        if backend != "tpu":
+            active_gauge.set(0)
+            return None
+    from mirbft_tpu.groups.cohost import CohostCryptoPlane
+
+    plane = CohostCryptoPlane(n_groups)
+    active_gauge.set(1)
+    return plane
+
+
 def run_host(root: Path, host_id: int) -> int:
     """Cohost child: one OS process running node index ``host_id`` of
     *every* group in the shard (shard.json layout "cohost").  The
@@ -794,9 +840,22 @@ def run_host(root: Path, host_id: int) -> int:
 
     The co-hosted instances share the process-wide metrics registry, so
     their metrics.prom snapshots are a merged view; per-group doctor
-    attribution needs the default disjoint layout (docs/SHARDING.md)."""
+    attribution needs the default disjoint layout (docs/SHARDING.md).
+
+    When shard.json sets ``shared_wave`` (the cohost default), the host
+    also shares the CRYPTO plane: one ``CohostCryptoPlane`` multiplexes
+    every co-hosted group's hash/verify work into shared group-tagged
+    fused device waves (docs/SHARDING.md "Cohost"), amortizing the
+    per-dispatch overhead that used to be paid once per group.  Without
+    an accelerator backend the plane would cost more than it saves, so
+    the child degrades to per-group CPU hashers and says so in the
+    ``wave_mux_active`` gauge — bench comparisons stay honest
+    (``MIRNET_SHARED_WAVE=force`` overrides, for wiring tests)."""
     shard = json.loads(_shard_path(root).read_text())
     instances: Dict[int, _Instance] = {}
+    n_groups = int(shard["groups"])
+
+    cohost_plane = _build_cohost_plane(n_groups, shard)
 
     def router(env_group: int, body: bytes, reply, trace_id: int = 0) -> None:
         inst = instances.get(env_group)
@@ -805,9 +864,14 @@ def run_host(root: Path, host_id: int) -> int:
         else:
             inst.serve_client(body, reply, trace_id=trace_id)
 
-    for g in range(int(shard["groups"])):
+    for g in range(n_groups):
         instances[g] = _Instance(
-            _group_dir(root, g), host_id, submit_router=router
+            _group_dir(root, g), host_id, submit_router=router,
+            hasher=(
+                cohost_plane.hasher_for(g)
+                if cohost_plane is not None
+                else None
+            ),
         )
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -1276,12 +1340,17 @@ def _write_shard(
     client_ids: List[int],
     fleet: bool = False,
     observer_telemetry: Optional[Dict[str, int]] = None,
+    shared_wave: bool = False,
 ) -> GroupMap:
     """``shard.json``: the deployment-wide topology file — group count,
     layout, the authoritative group map, each group's home client, and
     (fleet deployments) the observers' telemetry listen ports keyed
     ``"<group>:<obs_idx>"`` (members answer TEL_PULL on their transport
-    socket, observers need a dedicated listener)."""
+    socket, observers need a dedicated listener).  ``shared_wave`` (cohost
+    layout) asks each host process to multiplex its co-hosted groups'
+    crypto through one shared fused device wave (groups/cohost.py); the
+    child degrades to per-group host hashing when no accelerator backend
+    is present and records which way it went in ``wave_mux_active``."""
     gmap = GroupMap(
         {
             g: [
@@ -1304,6 +1373,7 @@ def _write_shard(
             "client_ids": {str(g): client_ids[g] for g in range(groups)},
             "fleet": bool(fleet),
             "observer_telemetry": dict(observer_telemetry or {}),
+            "shared_wave": bool(shared_wave),
         },
     )
     return gmap
@@ -1391,6 +1461,7 @@ class _ShardedCluster:
         pipeline: bool = True,
         fleet: bool = False,
         fleet_observers: int = 0,
+        shared_wave: Optional[bool] = None,
     ):
         if layout not in ("disjoint", "cohost"):
             raise ValueError(f"unknown shard layout {layout!r}")
@@ -1399,6 +1470,13 @@ class _ShardedCluster:
         self.groups = groups
         self.nodes_per_group = nodes_per_group
         self.layout = layout
+        # Cohost defaults to the shared cross-group wave (the whole point
+        # of co-hosting); ``shared_wave=False`` is the escape hatch back
+        # to per-group hashers.  Meaningless (and off) for disjoint.
+        self.shared_wave = (
+            (layout == "cohost") if shared_wave is None
+            else bool(shared_wave and layout == "cohost")
+        )
         self.timeout_s = timeout_s
         self.fleet = bool(fleet)
         self.collector = None
@@ -1425,6 +1503,7 @@ class _ShardedCluster:
             self.client_ids,
             fleet=self.fleet,
             observer_telemetry=self.observer_telemetry,
+            shared_wave=self.shared_wave,
         )
         map_doc = {
             str(g): [[h, p] for h, p in self.map.members(g)]
@@ -1815,6 +1894,7 @@ def run_sharded_deployment(
     probe_redirect: bool = True,
     fleet: bool = False,
     record_events: bool = True,
+    shared_wave: Optional[bool] = None,
 ) -> dict:
     """Run ``groups`` independent consensus groups behind the routing
     tier and return a summary: per-group commit counts, the disjointness
@@ -1838,6 +1918,7 @@ def run_sharded_deployment(
         fleet=fleet,
         fleet_observers=observers_per_group,
         record_events=record_events,
+        shared_wave=shared_wave,
     ) as cluster:
         cluster.start()
         cluster.start_collector()
@@ -3263,6 +3344,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         default="disjoint",
                         help="sharded process packaging: one process per "
                              "(group, node) or one per host index")
+    parser.add_argument("--no-shared-wave", action="store_true",
+                        help="cohost layout: keep per-group hashers "
+                             "instead of multiplexing all co-hosted "
+                             "groups' crypto through one shared fused "
+                             "device wave (the cohost default)")
     parser.add_argument("--observers", type=int, default=0,
                         help="observers per group for --groups runs")
     parser.add_argument("--reqs", type=int, default=10)
@@ -3341,6 +3427,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             pipeline=pipeline,
             fleet=args.fleet,
             record_events=not args.no_flight_recorder,
+            shared_wave=False if args.no_shared_wave else None,
         )
         print(json.dumps(result, indent=2, sort_keys=True))
         print(

@@ -1,0 +1,379 @@
+"""Cross-group shared fused wave (testengine.crypto.SharedWaveMux +
+multi-tenant ops/fused.py): mixed-group waves must be bit-identical to
+per-group pipelines — digests, verify verdicts, and quorum state — with
+digest gates and forged-signature verdicts isolated per tenant, pool
+leases released exactly once per shared wave, the WaveController's
+per-group floor protecting low-rate tenants from the idle shrink, and a
+2-group co-hosted engine run committing the same streams as solo runs.
+
+Under pytest the "device" is the XLA CPU backend (see conftest): the
+multiplexed program, group-tag gating, partial collects and sub-handle
+bookkeeping are identical; only the chip differs.
+"""
+
+import hashlib
+
+import numpy as np
+
+from mirbft_tpu import metrics
+from mirbft_tpu.ops.ed25519 import keypair_from_seed
+from mirbft_tpu.ops.fused import FusedCryptoPipeline, host_fused_reference
+from mirbft_tpu.processor.verify import seal, signing_payload
+from mirbft_tpu.testengine import CryptoConfig, DeviceAuthPlane, Spec
+from mirbft_tpu.testengine.crypto import (
+    DeviceHashPlane,
+    SharedWaveMux,
+    WaveController,
+)
+
+# SHA-256 padding boundaries (see tests/test_fused_wave.py).
+BOUNDARY_LENGTHS = (0, 1, 55, 56, 63, 64, 119, 120, 183, 184, 247, 248)
+
+
+def _mux_pair(wave_size, n_groups=2, kernel="scan", auth=None, **pipe_kw):
+    """A multi-tenant pipeline, its mux, and one attached plane per group."""
+    pipe = FusedCryptoPipeline(kernel=kernel, n_groups=n_groups, **pipe_kw)
+    mux = SharedWaveMux(pipe, wave_size=wave_size, adaptive=False)
+    planes = []
+    for g in range(n_groups):
+        plane = DeviceHashPlane(
+            device=True, wave_size=wave_size, device_floor=1, kernel=kernel
+        )
+        plane.attach_mux(mux, g, auth[g] if auth else None)
+        planes.append(plane)
+    return pipe, mux, planes
+
+
+def _digest(parts):
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p)
+    return h.digest()
+
+
+def test_mux_mixed_group_digest_parity_boundary_lengths():
+    """Two tenants' rows at every SHA-256 padding boundary ride shared
+    waves; each tenant's digests equal hashlib (== a private pipeline's).
+    The second tenant's enqueue crosses the AGGREGATE threshold and
+    launches for both."""
+    pipe, mux, planes = _mux_pair(wave_size=2 * len(BOUNDARY_LENGTHS))
+    batches = []
+    for g in range(2):
+        rows = []
+        for length in BOUNDARY_LENGTHS:
+            msg = bytes([65 + g]) * length
+            # Two-part batches so zero/short rows still take the device
+            # (single parts under 512 B short-circuit to hashlib).
+            rows.append([msg[: length // 2], msg[length // 2 :]])
+        batches.append(rows)
+
+    planes[0].enqueue(batches[0])
+    # Half the aggregate wave: tenant 0 alone must NOT launch.
+    assert planes[0].pending_count() == len(BOUNDARY_LENGTHS)
+    planes[1].enqueue(batches[1])
+    # Aggregate threshold crossed: BOTH tenants drained into shared waves.
+    assert planes[0].pending_count() == 0
+    assert planes[1].pending_count() == 0
+    assert metrics.gauge("wave_mux_groups_per_wave").value == 2
+    assert metrics.gauge("fused_wave_occupancy").value > 0
+
+    for g, plane in enumerate(planes):
+        out = plane.hash_batches(batches[g])
+        assert out == [_digest(parts) for parts in batches[g]]
+    for plane in planes:
+        assert not plane._inflight
+    # Every shared wave's pooled packing slab came back exactly once:
+    # lengths <= 247 bucket together, 248 overflows to the next block
+    # bucket, so the 24 mixed rows rode exactly two shared waves.
+    assert sum(len(v) for v in pipe.hasher._pool._free.values()) == 2
+
+
+def test_pipeline_multigroup_quorum_digest_gating_parity():
+    """Group-tagged rows and quorum slabs on one wave match the host
+    oracle bit-for-bit, and a digest gate referencing ANOTHER tenant's
+    row stays closed even with the correct digest claim."""
+    n_slots, n_digest_slots = 8, 2
+    pipe = FusedCryptoPipeline(
+        n_slots=n_slots,
+        n_digest_slots=n_digest_slots,
+        kernel="scan",
+        n_groups=2,
+    )
+    msgs = [b"mux-%d" % i + b"q" * (i * 31 % 200) for i in range(8)]
+    groups = [0, 1, 0, 1, 0, 1, 0, 1]
+    claim2 = hashlib.sha256(msgs[2]).digest()  # row 2 is group 0's
+    claim3 = hashlib.sha256(msgs[3]).digest()  # row 3 is group 1's
+    quorum = [
+        (0, 5, [(0, 0, 2, claim2)]),  # own row, right claim: opens
+        (1, 5, [(0, 0, 3, claim3)]),  # own row, right claim: opens
+        # Correct digest, WRONG tenant: group 1 gating on group 0's row
+        # must stay closed — the cross-tenant isolation invariant.
+        (1, 6, [(1, 0, 2, claim2)]),
+        (0, 6, [(1, 1, 4, b"\xff" * 32)]),  # wrong claim: closed
+        (0, 7, [(2, 0, None, None)]),  # ungated: counts
+    ]
+    res = pipe.collect(pipe.dispatch_wave(msgs, quorum=quorum, groups=groups))
+    masks0 = np.zeros((2 * n_slots, n_digest_slots, 8), dtype=np.uint32)
+    counts0 = np.zeros((2 * n_slots, n_digest_slots), dtype=np.int32)
+    rd, _rv, rm, rc, rp, rn = host_fused_reference(
+        msgs, None, quorum, masks0, counts0, groups=groups, n_slots=n_slots
+    )
+    assert res.digests == rd
+    dm, dc = pipe.quorum_state()
+    assert (dm == rm).all()
+    assert (dc == rc).all()
+    nq = len(quorum)
+    assert (res.posts[:nq] == rp[:nq]).all()
+    assert (res.newbits[:nq] == rn[:nq]).all()
+    # Explicit: the cross-tenant gate contributed nothing to group 1's
+    # slab, while both same-tenant gates landed.
+    assert dc[0 * n_slots + 0, 0] == 1  # entry 0 (group 0, slot 0)
+    assert dc[1 * n_slots + 0, 0] == 1  # entry 1 (group 1, slot 0)
+    assert dc[1 * n_slots + 1, 0] == 0  # entry 2 rejected cross-tenant
+
+
+def test_mux_forged_signature_isolated_per_group():
+    """Both tenants' pending signatures ride one shared wave's verify
+    stage; a forged signature in group 0's slice flips ONLY that row —
+    group 1's verdicts are untouched, and both harvests come from the
+    wave (not a host re-verify)."""
+    pub0, sign0 = keypair_from_seed(b"\x03" * 32)
+    pub1, sign1 = keypair_from_seed(b"\x04" * 32)
+
+    def envelopes(cid, sign, n, forge=()):
+        out = []
+        for i in range(n):
+            payload = b"req-%d-%d" % (cid, i)
+            sig = (
+                b"\x00" * 64
+                if i in forge
+                else sign(signing_payload(cid, i, payload))
+            )
+            out.append(seal(payload, sig))
+        return out
+
+    envs0 = envelopes(7, sign0, 4, forge=(2,))
+    envs1 = envelopes(9, sign1, 3)
+    chunks = {
+        (7, 0): list(enumerate(envs0)),
+        (9, 0): list(enumerate(envs1)),
+    }
+
+    def provider(client_id, start_req):
+        return chunks.get((client_id, start_req), [])
+
+    auth0 = DeviceAuthPlane(
+        provider, device=True, wave_size=64, device_floor=64, lookahead=8
+    )
+    auth0.register(7, pub0)
+    auth1 = DeviceAuthPlane(
+        provider, device=True, wave_size=64, device_floor=64, lookahead=8
+    )
+    auth1.register(9, pub1)
+    pipe, mux, planes = _mux_pair(wave_size=4, auth=(auth0, auth1))
+
+    auth0.note(7, 0)
+    auth1.note(9, 0)
+    hash_batches = [
+        [[b"h%d" % g, bytes([g]) * 600]] for g in range(2)
+    ]
+    for g in range(2):
+        planes[g].enqueue(hash_batches[g])
+    mux.launch()
+    for g in range(2):
+        out = planes[g].hash_batches(hash_batches[g])
+        assert out == [_digest(hash_batches[g][0])]
+    # Verdicts were harvested from the shared wave's verify slices.
+    assert auth0.verified_count == 4
+    assert auth1.verified_count == 3
+    assert [auth0.authenticate(7, i, envs0[i]) for i in range(4)] == [
+        True, True, False, True,
+    ]
+    assert all(auth1.authenticate(9, i, envs1[i]) for i in range(3))
+    # No host re-verification happened for the memoized verdicts.
+    assert auth0.verified_count == 4
+    assert auth1.verified_count == 3
+
+
+def test_mux_partial_collect_lease_discipline_across_waves():
+    """One tenant's partial collect releases the shared wave's pooled
+    lease exactly once while the wave's digest words stay device-resident
+    for the other tenant; across successive shared waves the pool is
+    reused, never grown, and nothing stays in flight."""
+    pipe, mux, planes = _mux_pair(wave_size=8)
+
+    def round_batches(tag):
+        return [
+            [[b"%s-%d-%d" % (tag, g, i), bytes([g + 1]) * 520]
+             for i in range(4)]
+            for g in range(2)
+        ]
+
+    first = round_batches(b"r0")
+    planes[0].enqueue(first[0])
+    planes[1].enqueue(first[1])  # aggregate 8 -> one mixed wave
+    sub0 = planes[0]._inflight[0][2]
+    sub1 = planes[1]._inflight[0][2]
+    assert sub0.wave is sub1.wave  # one shared FusedDispatch
+
+    # Tenant 0 pulls a single commit-ready row across the host boundary.
+    part = mux.collect_ready(sub0, [0])
+    assert part.digests == [_digest(first[0][0])]
+    assert sub0.wave.lease is None  # pooled slab returned on first collect
+    assert sub0.wave.words is not None  # digests stayed device-resident
+
+    # Tenant 1 (and then tenant 0) still materialize everything.
+    assert planes[1].hash_batches(first[1]) == [
+        _digest(p) for p in first[1]
+    ]
+    assert planes[0].hash_batches(first[0]) == [
+        _digest(p) for p in first[0]
+    ]
+    free_counts = {
+        k: len(v) for k, v in pipe.hasher._pool._free.items() if v
+    }
+    assert sum(free_counts.values()) == 1  # the one lease, back once
+
+    # Two more shared waves: pooled buffers are reused, never grown.
+    for tag in (b"r1", b"r2"):
+        batches = round_batches(tag)
+        planes[0].enqueue(batches[0])
+        planes[1].enqueue(batches[1])
+        for g in range(2):
+            assert planes[g].hash_batches(batches[g]) == [
+                _digest(p) for p in batches[g]
+            ]
+    assert {
+        k: len(v) for k, v in pipe.hasher._pool._free.items() if v
+    } == free_counts
+    for plane in planes:
+        assert not plane._inflight
+        assert not plane._issued
+
+
+def test_wave_controller_group_floor_blocks_idle_shrink_starvation():
+    """The idle shrink clamps at ``active_groups * group_floor``: a
+    bursty tenant going quiet cannot walk a shared wave below every
+    active tenant's minimum row budget."""
+    wc = WaveController(initial=256, floor=16, ceiling=512, group_floor=64)
+    assert wc.effective_floor(1) == 64
+    assert wc.effective_floor(3) == 192
+    size = 256
+    for _ in range(4):
+        size = wc.observe(10, 8, 8e-5, active_groups=3)
+    assert size == 192  # halving would hit 128; 3-tenant floor holds 192
+    for _ in range(8):
+        size = wc.observe(10, 8, 8e-5, active_groups=3)
+    assert size == 192  # pinned at the floor, not walked further down
+    # With a single active tenant the same controller shrinks past it.
+    for _ in range(4):
+        size = wc.observe(10, 8, 8e-5, active_groups=1)
+    assert size == 96
+    # The latency back-off respects the same per-group floor.
+    wc2 = WaveController(initial=256, floor=16, ceiling=512, group_floor=64)
+    wc2.observe(256, 256, 256e-5, active_groups=3)  # best: 1e-5 s/msg
+    assert wc2.observe(600, 128, 128 * 5e-5, active_groups=3) == 192
+
+
+def test_wave_controller_group_floor_zero_keeps_legacy_trajectory():
+    """group_floor=0 (the default) reproduces the single-tenant policy
+    exactly, whatever active_groups claims."""
+    legacy = WaveController(initial=64, floor=16, ceiling=512)
+    tagged = WaveController(initial=64, floor=16, ceiling=512, group_floor=0)
+    trace = [
+        (200, 64, 64e-5), (600, 128, 128e-5), (10, 8, 8e-5),
+        (10, 8, 8e-5), (10, 8, 8e-5), (10, 8, 8e-5), (2000, 128, 128e-5),
+    ]
+    for depth, n, secs in trace:
+        assert legacy.observe(depth, n, secs) == tagged.observe(
+            depth, n, secs, active_groups=4
+        )
+
+
+def _final_states(recording):
+    return sorted(
+        (node.state.checkpoint_seq_no, node.state.checkpoint_hash)
+        for node in recording.nodes
+    )
+
+
+def _drain_interleaved(recordings, timeout=200_000):
+    """Round-robin ``step()`` across co-hosted recordings until each hits
+    drain_clients' own completion condition; returns per-recording step
+    counts comparable to ``drain_clients`` return values."""
+
+    def done(rec):
+        target_reqs = {
+            c.config.id: 0 if c.config.corrupt else c.config.total
+            for c in rec.clients.values()
+        }
+        for node in rec.nodes:
+            for client_state in node.state.checkpoint_state.clients:
+                target = target_reqs.get(client_state.id)
+                if target is not None and target != client_state.low_watermark:
+                    return False
+        finished = {
+            cid
+            for cid, total in target_reqs.items()
+            if total == 0
+            or any(
+                node.state.committed_reqs.get(cid, 0) >= total
+                for node in rec.nodes
+            )
+        }
+        return finished >= set(target_reqs)
+
+    steps = [0] * len(recordings)
+    finished = [False] * len(recordings)
+    while not all(finished):
+        for k, rec in enumerate(recordings):
+            if finished[k]:
+                continue
+            steps[k] += 1
+            rec.step()
+            if done(rec):
+                finished[k] = True
+            assert steps[k] <= timeout, "interleaved drain stalled"
+    return steps
+
+
+def test_mux_two_group_engine_differential():
+    """Two co-hosted consensus groups (distinct specs, one signed) share
+    one SharedWaveMux and run INTERLEAVED, step for step — commit streams
+    and step counts must be bit-identical to each group's solo run."""
+    spec0 = dict(
+        node_count=4, client_count=2, reqs_per_client=8, batch_size=4,
+        signed_requests=True,
+    )
+    spec1 = dict(node_count=4, client_count=3, reqs_per_client=5, batch_size=5)
+
+    solo = []
+    for base in (spec0, spec1):
+        metrics.default_registry.reset()
+        recording = Spec(**base).recorder().recording()
+        steps = recording.drain_clients(timeout=200_000)
+        solo.append((steps, _final_states(recording)))
+
+    metrics.default_registry.reset()
+    pipe = FusedCryptoPipeline(kernel="scan", n_groups=2)
+    mux = SharedWaveMux(pipe, wave_size=8, adaptive=False)
+    recordings = []
+    for g, base in enumerate((spec0, spec1)):
+        crypto = CryptoConfig(
+            device=True, hash_wave=4, hash_floor=1, kernel="scan",
+            defer_unready=False, mux=mux, mux_group=g,
+            auth_wave=64, auth_floor=4, lookahead=16,
+        )
+        recordings.append(
+            Spec(**base, crypto=crypto).recorder().recording()
+        )
+    steps = _drain_interleaved(recordings)
+    snap = metrics.snapshot()
+
+    for g in range(2):
+        assert steps[g] == solo[g][0]
+        assert _final_states(recordings[g]) == solo[g][1]
+    # The shared wave actually carried traffic for both tenants.
+    assert snap.get("fused_wave_dispatches", 0) > 0
+    assert snap.get('wave_mux_rows_total{group="0"}', 0) > 0
+    assert snap.get('wave_mux_rows_total{group="1"}', 0) > 0
