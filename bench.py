@@ -1729,6 +1729,10 @@ def bench_sharded(detail, reqs_per_group=30, nodes_per_group=2,
       observer per group on the 2-group run; the history predates the
       feeds' retained backlog, so this path exercises the RESET +
       KIND_SNAPSHOT bootstrap, not just tailing.
+    - ``reshard_cutover_seconds``: wall time from split-marker
+      submission to the reconfiguration checkpoint applying on group 1
+      (docs/SHARDING.md "Elastic resharding") — the parent-side cutover
+      cost, no child group booted.
     - ``c6_cohost_2g_unique_req_per_s`` / ``c6_cohost_scaling_ratio``:
       the same 2-group shard in the **cohost** layout (one process per
       node index running a node of every group), where co-hosted groups
@@ -1795,6 +1799,42 @@ def bench_sharded(detail, reqs_per_group=30, nodes_per_group=2,
                             raise RuntimeError(
                                 f"observer {g}/0 diverged: {problems}"
                             )
+
+                    # Elastic-resharding cutover cost (docs/SHARDING.md
+                    # "Elastic resharding"): marker submission to
+                    # reconfiguration-applied on group 1, wall clock.
+                    # The split map names pre-reserved (never booted)
+                    # child addresses — only the parent-side cutover
+                    # path is on the clock, and the drained group's log
+                    # is pumped with control requests so the
+                    # reconfiguration checkpoint actually arrives.
+                    from mirbft_tpu.groups import reshard as reshard_mod
+
+                    child_members = [
+                        ("127.0.0.1", p)
+                        for p in mirnet._reserve_ports(nodes_per_group)
+                    ]
+                    v1 = cluster.map.split_group(1, 2, child_members)
+                    plan = reshard_mod.ReshardPlan(
+                        plan_id="bench-split",
+                        action=reshard_mod.ACTION_SPLIT,
+                        group_id=1,
+                        moved_client=cluster.client_ids[1],
+                        moved_client_width=100,
+                        map_doc=json.loads(v1.to_json_bytes().decode()),
+                        marker_req_no=0,
+                    )
+                    members = cluster.map.members(1)
+                    mirnet._stage_plan(members, plan)
+                    t0 = time.monotonic()
+                    mirnet._submit_control(members[0], 1, 0)
+                    mirnet._wait_reshard_done(
+                        members[0], 1, timeout_s=timeout_s,
+                        pump_next_ctrl=1,
+                    )
+                    detail["reshard_cutover_seconds"] = round(
+                        time.monotonic() - t0, 2
+                    )
         finally:
             shutil.rmtree(root, ignore_errors=True)
     detail["c6_1g_unique_req_per_s"] = round(rates[1], 1)
@@ -2163,7 +2203,8 @@ def guard_pipeline_planes(detail):
                             ("c6_2g_unique_req_per_s", False),
                             ("c6_scaling_ratio", False),
                             ("fused_wave_occupancy", False),
-                            ("observer_catchup_s", True)):
+                            ("observer_catchup_s", True),
+                            ("reshard_cutover_seconds", True)):
         current = detail.get(key)
         ref, source = latest_recorded(key)
         if not isinstance(current, (int, float)):

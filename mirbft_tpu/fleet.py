@@ -621,7 +621,7 @@ def slo_rows(history: List[Dict]) -> List[Dict]:
         row = by_group.setdefault(
             int(group),
             {"p50": [], "p99": [], "lag": [], "stall": [], "lock": [],
-             "fsync_share": []},
+             "fsync_share": [], "mapv": []},
         )
         row["p50"].extend(
             _metric_values(snap, "commit_latency_seconds", "_p50")
@@ -630,6 +630,7 @@ def slo_rows(history: List[Dict]) -> List[Dict]:
             _metric_values(snap, "commit_latency_seconds", "_p99")
         )
         row["lag"].extend(_metric_values(snap, "observer_lag_batches"))
+        row["mapv"].extend(_metric_values(snap, "map_version"))
         row["stall"].extend(
             _metric_values(
                 snap, "pipeline_admission_stall_seconds", "_p99"
@@ -667,6 +668,10 @@ def slo_rows(history: List[Dict]) -> List[Dict]:
                 ),
                 "wal_fsync_share_pct": None if not agg["fsync_share"]
                 else round(max(agg["fsync_share"]), 2),
+                # Routing epoch (docs/SHARDING.md "Elastic resharding"):
+                # the newest map any member of the group has installed.
+                "map_version": None if not agg["mapv"]
+                else int(max(agg["mapv"])),
             }
         )
     return rows

@@ -80,6 +80,8 @@ class Observer:
         self.applied_seq = 0
         self.head_seq = 0
         self.stable_checkpoint: Optional[Tuple[int, bytes]] = None
+        # Last RESHARD_CUTOVER heard on the feed: (marker seq, map bytes).
+        self.reshard_cutover: Optional[Tuple[int, bytes]] = None
         for line in self._read_lines(self.out_dir / "commits.log"):
             self.applied_seq = max(self.applied_seq, int(line.split(" ", 1)[0]))
         for line in self._read_lines(self._checkpoints_path):
@@ -212,6 +214,12 @@ class Observer:
                         self._on_batch(seq, body)
                     elif subtype == ship.SHIP_CHECKPOINT:
                         self._on_checkpoint(seq, body)
+                    elif subtype == ship.RESHARD_CUTOVER:
+                        # The group committed its cutover marker at seq;
+                        # body is the post-cutover map.  Recorded so a
+                        # learner being promoted (docs/SHARDING.md
+                        # "Elastic resharding") knows the epoch it joins.
+                        self.reshard_cutover = (seq, bytes(body))
         finally:
             try:
                 sock.close()
@@ -246,4 +254,5 @@ class Observer:
             "applied_seq": self.applied_seq,
             "head_seq": self.head_seq,
             "stable_checkpoint": self.stable_checkpoint,
+            "reshard_cutover": self.reshard_cutover,
         }

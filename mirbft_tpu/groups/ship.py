@@ -21,6 +21,18 @@ Subtypes:
   tailing resumes.
 * ``MAP_REQUEST`` / ``MAP_REPLY`` — group-map discovery; the reply body
   is :meth:`~mirbft_tpu.groups.routing.GroupMap.to_json_bytes`.
+* ``RESHARD_PLAN`` — harness -> node: stage one serialized
+  :class:`~mirbft_tpu.groups.reshard.ReshardPlan` on a group member
+  ahead of the cutover marker (``seq`` carries the marker req_no the
+  plan is keyed by); answered with ``RESHARD_STATE``.
+* ``RESHARD_QUERY`` / ``RESHARD_STATE`` — reshard progress poll; the
+  state body is the coordinator's JSON state document (phase,
+  map_version, cutover seq).
+* ``RESHARD_CUTOVER`` — node -> observer: the group committed its
+  cutover marker and crossed the reconfiguration checkpoint at ``seq``;
+  body is the new map's JSON wire form, so bootstrapping learners hear
+  about the epoch they are being promoted into on the same feed they
+  tail (docs/SHARDING.md "Elastic resharding").
 
 The registry (:data:`SUBTYPE_NAMES`) and :func:`sample_payloads` exist
 for mirlint's wire-schema pass: every subtype must be named, unique, and
@@ -42,9 +54,14 @@ SHIP_CHECKPOINT = 2
 SHIP_RESET = 3
 MAP_REQUEST = 4
 MAP_REPLY = 5
+RESHARD_PLAN = 6
+RESHARD_QUERY = 7
+RESHARD_STATE = 8
+RESHARD_CUTOVER = 9
 
 # Subtype registry: mirlint's wire pass checks this stays in lockstep
-# with the SHIP_*/MAP_* constants above (docs/STATIC_ANALYSIS.md).
+# with the SHIP_*/MAP_*/RESHARD_* constants above
+# (docs/STATIC_ANALYSIS.md).
 SUBTYPE_NAMES = {
     SHIP_SUBSCRIBE: "ship_subscribe",
     SHIP_BATCH: "ship_batch",
@@ -52,6 +69,10 @@ SUBTYPE_NAMES = {
     SHIP_RESET: "ship_reset",
     MAP_REQUEST: "map_request",
     MAP_REPLY: "map_reply",
+    RESHARD_PLAN: "reshard_plan",
+    RESHARD_QUERY: "reshard_query",
+    RESHARD_STATE: "reshard_state",
+    RESHARD_CUTOVER: "reshard_cutover",
 }
 
 _SUB_HEADER = struct.Struct(">BIQ")
@@ -107,6 +128,26 @@ def encode_map_reply(map_bytes: bytes) -> bytes:
     return encode(MAP_REPLY, 0, 0, map_bytes)
 
 
+def encode_reshard_plan(
+    group_id: int, marker_req_no: int, plan_bytes: bytes
+) -> bytes:
+    return encode(RESHARD_PLAN, group_id, marker_req_no, plan_bytes)
+
+
+def encode_reshard_query(group_id: int) -> bytes:
+    return encode(RESHARD_QUERY, group_id, 0)
+
+
+def encode_reshard_state(group_id: int, state_bytes: bytes) -> bytes:
+    return encode(RESHARD_STATE, group_id, 0, state_bytes)
+
+
+def encode_reshard_cutover(
+    group_id: int, cutover_seq: int, map_bytes: bytes
+) -> bytes:
+    return encode(RESHARD_CUTOVER, group_id, cutover_seq, map_bytes)
+
+
 def sample_payloads() -> dict:
     """One representative payload per subtype — mirlint round-trips every
     entry and fails if a subtype is missing from this table."""
@@ -117,6 +158,12 @@ def sample_payloads() -> dict:
         SHIP_RESET: encode_reset(1, 40, b"\x02" * 32),
         MAP_REQUEST: encode_map_request(),
         MAP_REPLY: encode_map_reply(b'{"0": [["127.0.0.1", 1]]}'),
+        RESHARD_PLAN: encode_reshard_plan(1, 0, b'{"action": "split"}'),
+        RESHARD_QUERY: encode_reshard_query(1),
+        RESHARD_STATE: encode_reshard_state(1, b'{"phase": 3}'),
+        RESHARD_CUTOVER: encode_reshard_cutover(
+            1, 40, b'{"map_version": 1}'
+        ),
     }
 
 
@@ -194,6 +241,21 @@ class ShipFeed:
             dead = self._push(
                 list(self._subs),
                 encode_checkpoint(self.group_id, seq, digest),
+            )
+            for send in dead:
+                self._subs.remove(send)
+            if dead:
+                self._sub_gauge.set(len(self._subs))
+
+    def note_reshard_cutover(self, seq: int, map_bytes: bytes) -> None:
+        """Announce a committed cutover to live subscribers.  Not added
+        to the batch backlog — the marker batch itself is already in the
+        tail; this frame just carries the new map to bootstrapping
+        learners ahead of their promotion (docs/SHARDING.md)."""
+        with self._lock:
+            dead = self._push(
+                list(self._subs),
+                encode_reshard_cutover(self.group_id, seq, map_bytes),
             )
             for send in dead:
                 self._subs.remove(send)

@@ -70,7 +70,28 @@ class ReconfigNewConfig:
     config: NetworkConfig
 
 
-Reconfiguration = Union[ReconfigNewClient, ReconfigRemoveClient, ReconfigNewConfig]
+@dataclass(frozen=True, slots=True)
+class ReconfigTransferClient:
+    """Admit a client mid-stream at an explicit low watermark.
+
+    Used by elastic resharding (docs/SHARDING.md): when a merge moves a
+    client back into its parent group, the parent must start the client's
+    window at one past the highest request the child committed —
+    ``ReconfigNewClient`` (watermark 0) would re-open already-committed
+    request numbers and break exactly-once under client retries.
+    """
+
+    id: int
+    width: int
+    low_watermark: int
+
+
+Reconfiguration = Union[
+    ReconfigNewClient,
+    ReconfigRemoveClient,
+    ReconfigNewConfig,
+    ReconfigTransferClient,
+]
 
 
 @dataclass(frozen=True, slots=True)

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import struct
 import zlib
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 FRAME_MAGIC = b"MB"
 FRAME_VERSION = 1
@@ -158,12 +158,22 @@ class FrameDecoder:
 CLIENT_ENV_MAGIC = 0xC1
 CLIENT_ENV_VERSION = 1
 CLIENT_ENV_VERSION_TRACED = 2
+CLIENT_ENV_VERSION_ROUTED = 3
 _CLIENT_ENV = struct.Struct(">BBI")  # magic, version, group id
 _CLIENT_ENV_TRACE = struct.Struct(">BBIQ")  # + u64 trace id (version 2)
+# Version 3 ("routed", docs/SHARDING.md "Elastic resharding") appends the
+# u64 client id and the u32 map version the sender routed under, so a
+# node can re-route the *client* under its own (possibly newer) map
+# instead of trusting the sender's group pick, and redirect stale epochs.
+_CLIENT_ENV_ROUTED = struct.Struct(">BBIQQI")
 
 
 def encode_client_envelope(
-    group_id: int, body: bytes, trace_id: int = 0
+    group_id: int,
+    body: bytes,
+    trace_id: int = 0,
+    client_id: int = None,
+    map_version: int = None,
 ) -> bytes:
     """Wrap a client submission body with its destination group id.
 
@@ -171,7 +181,18 @@ def encode_client_envelope(
     appends the 8-byte id after the group id (docs/OBSERVABILITY.md
     "Fleet plane"); ``trace_id == 0`` emits the byte-identical version-1
     envelope, so untraced submissions stay compatible with old decoders.
+    Passing ``client_id`` (with the sender's ``map_version``, default 0)
+    emits the version-3 routed envelope.
     """
+    if client_id is not None:
+        return _CLIENT_ENV_ROUTED.pack(
+            CLIENT_ENV_MAGIC,
+            CLIENT_ENV_VERSION_ROUTED,
+            group_id,
+            trace_id,
+            client_id,
+            map_version or 0,
+        ) + body
     if trace_id:
         return _CLIENT_ENV_TRACE.pack(
             CLIENT_ENV_MAGIC, CLIENT_ENV_VERSION_TRACED, group_id, trace_id
@@ -184,18 +205,43 @@ def decode_client_envelope(payload: bytes) -> Tuple[int, int, bytes]:
     payloads (no envelope magic) imply group 0, and version-1 envelopes
     imply trace id 0 (untraced).  Raises :class:`FrameError` on an
     envelope from a future version."""
+    group_id, trace_id, _cid, _mv, body = decode_client_envelope_routed(
+        payload
+    )
+    return group_id, trace_id, body
+
+
+def decode_client_envelope_routed(
+    payload: bytes,
+) -> Tuple[int, int, Optional[int], Optional[int], bytes]:
+    """``(group_id, trace_id, client_id, map_version, body)``; the last
+    two are ``None`` below envelope version 3 (the sender predates the
+    routed form — route by its group pick, as before)."""
     if len(payload) >= _CLIENT_ENV.size and payload[0] == CLIENT_ENV_MAGIC:
         _magic, version, group_id = _CLIENT_ENV.unpack_from(payload)
         if version == CLIENT_ENV_VERSION:
-            return group_id, 0, payload[_CLIENT_ENV.size:]
+            return group_id, 0, None, None, payload[_CLIENT_ENV.size:]
         if version == CLIENT_ENV_VERSION_TRACED:
             if len(payload) < _CLIENT_ENV_TRACE.size:
                 raise FrameError("truncated traced client envelope")
             _m, _v, group_id, trace_id = _CLIENT_ENV_TRACE.unpack_from(
                 payload
             )
-            return group_id, trace_id, payload[_CLIENT_ENV_TRACE.size:]
+            return (
+                group_id, trace_id, None, None,
+                payload[_CLIENT_ENV_TRACE.size:],
+            )
+        if version == CLIENT_ENV_VERSION_ROUTED:
+            if len(payload) < _CLIENT_ENV_ROUTED.size:
+                raise FrameError("truncated routed client envelope")
+            (
+                _m, _v, group_id, trace_id, client_id, map_version,
+            ) = _CLIENT_ENV_ROUTED.unpack_from(payload)
+            return (
+                group_id, trace_id, client_id, map_version,
+                payload[_CLIENT_ENV_ROUTED.size:],
+            )
         raise FrameError(
             f"unsupported client envelope version {version}"
         )
-    return 0, 0, payload
+    return 0, 0, None, None, payload

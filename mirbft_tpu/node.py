@@ -314,6 +314,14 @@ class Node:
             admission=self.scheduler.admission,
         )
 
+    def has_client(self, client_id: int) -> bool:
+        """Whether the consensused client set currently admits
+        ``client_id`` — i.e. a propose would be accepted rather than
+        raise ClientNotExistError.  Routers use this to distinguish "not
+        yet reconfigured in" (busy, retry) from "routed to the wrong
+        group" (redirect) during a reshard (groups/reshard.py)."""
+        return bool(self.clients.client(client_id).requests)
+
     # --- fleet trace bindings (docs/OBSERVABILITY.md "Fleet plane") ---
 
     _TRACE_BINDINGS_CAP = 8192

@@ -22,6 +22,7 @@ from ..messages import (
     ReconfigNewClient,
     ReconfigNewConfig,
     ReconfigRemoveClient,
+    ReconfigTransferClient,
     TEntry,
 )
 from ..state import EventCheckpointResult
@@ -176,6 +177,16 @@ def next_network_config(
                 raise AssertionError(
                     f"asked to remove client {reconfig.id} which doesn't exist"
                 )
+        elif isinstance(reconfig, ReconfigTransferClient):
+            next_clients.append(
+                ClientState(
+                    id=reconfig.id,
+                    width=reconfig.width,
+                    width_consumed_last_checkpoint=0,
+                    low_watermark=reconfig.low_watermark,
+                    committed_mask=b"",
+                )
+            )
         elif isinstance(reconfig, ReconfigNewConfig):
             next_config = reconfig.config
 

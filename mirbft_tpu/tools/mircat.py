@@ -463,6 +463,36 @@ def _print_deployment_report(report: dict) -> None:
     )
 
 
+def _group_map_version(group_dir: Path) -> Optional[int]:
+    """Newest routing-map version any member of the group has installed,
+    from the per-node ``metrics.prom`` ledgers (the ``map_version``
+    gauge); ``None`` for pre-resharding deployments that never exported
+    the gauge."""
+    versions = [
+        value
+        for node_dir in sorted(group_dir.glob("node-*"))
+        for _labels, value in _node_prom(node_dir, "map_version")
+    ]
+    return int(max(versions)) if versions else None
+
+
+def _map_skew_findings(versions: Dict) -> List[str]:
+    """Flag groups whose installed map is older than one cutover behind
+    the fleet's newest — one behind is a rollout in flight, two or more
+    means a group missed a reshard entirely (docs/SHARDING.md "Elastic
+    resharding")."""
+    known = [v for v in versions.values() if v is not None]
+    if not known:
+        return []
+    newest = max(known)
+    return [
+        f"group {label} map_version {version} is "
+        f"{newest - version} cutovers behind the fleet head {newest}"
+        for label, version in sorted(versions.items(), key=str)
+        if version is not None and newest - version > 1
+    ]
+
+
 def _sharded_group_dirs(path: Path) -> List[Tuple[str, Path]]:
     """``(label, deployment_dir)`` pairs for one doctor input path.
 
@@ -495,12 +525,14 @@ def doctor_sharded(
     """
     per_group: Dict[str, dict] = {}
     faults: Dict[str, float] = {}
+    map_versions: Dict[str, Optional[int]] = {}
     anomaly_count = 0
     truncated: List[str] = []
     for path in paths:
         for label, group_dir in _sharded_group_dirs(Path(path)):
             report = doctor_deployment(group_dir, thresholds=thresholds)
             per_group[label] = report
+            map_versions[label] = _group_map_version(group_dir)
             anomaly_count += report["anomaly_count"]
             truncated.extend(report["truncated_logs"])
             for key, count in report["faults"].items():
@@ -511,6 +543,8 @@ def doctor_sharded(
         "anomaly_count": anomaly_count,
         "faults": dict(sorted(faults.items())),
         "per_group": per_group,
+        "map_versions": map_versions,
+        "map_skew": _map_skew_findings(map_versions),
         "truncated_logs": truncated,
     }
 
@@ -518,13 +552,17 @@ def doctor_sharded(
 def _print_sharded_report(report: dict) -> None:
     for label in report["per_group"]:
         group = report["per_group"][label]
+        version = (report.get("map_versions") or {}).get(label)
+        version_col = "" if version is None else f", map_version {version}"
         print(
             f"=== {label}: "
             f"{'HEALTHY' if group['healthy'] else 'UNHEALTHY'} "
             f"({group['anomaly_count']} anomalies, "
-            f"{len(group['per_node'])} nodes) ==="
+            f"{len(group['per_node'])} nodes{version_col}) ==="
         )
         _print_deployment_report(group)
+    for line in report.get("map_skew") or []:
+        print(f"map skew: {line}")
     print(
         f"sharded verdict: "
         f"{'HEALTHY' if report['healthy'] else 'UNHEALTHY'} "
@@ -562,13 +600,24 @@ def audit_node(node_dir) -> dict:
     (retention removed the boot's head, replay cannot initialize),
     ``no-journal``.  Torn tails are clean-cut by construction and only
     noted — a crash is evidence, never divergence."""
+    from ..groups.reshard import RESHARD_CONTROL_CLIENT, parse_commit_line
+
     node_dir = Path(node_dir)
     live_commits: Dict[int, str] = {}
+    cutover_markers = 0
     for line in _read_log_lines(node_dir / "commits.log"):
         try:
             live_commits[int(line.split(" ", 1)[0])] = line
         except ValueError:
             continue
+        # Reshard cutover markers are ordinary committed requests from
+        # the reserved control client; replay reconstructs them like any
+        # other batch, so they are counted, never flagged.
+        if any(
+            cid == RESHARD_CONTROL_CLIENT
+            for cid, _rno in parse_commit_line(line)[1]
+        ):
+            cutover_markers += 1
     live_max = max(live_commits, default=0)
     live_checkpoints: Dict[int, str] = {}
     for line in _read_log_lines(node_dir / "checkpoints.log"):
@@ -678,6 +727,7 @@ def audit_node(node_dir) -> dict:
         "verdict": verdict,
         "boots": len(boots),
         "compared": compared,
+        "cutover_markers": cutover_markers,
         "divergences": divergences,
         "notes": notes,
     }
@@ -830,7 +880,7 @@ def fleet_report(fleet_dir, trace_id: Optional[str] = None) -> int:
     header = (
         f"{'group':>5} {'commit p50 ms':>14} {'commit p99 ms':>14} "
         f"{'obs lag':>8} {'stall p99 ms':>13} {'lock p99 ms':>12} "
-        f"{'fsync %':>8}"
+        f"{'fsync %':>8} {'map ver':>8}"
     )
     print(header)
     print("-" * len(header))
@@ -841,10 +891,15 @@ def fleet_report(fleet_dir, trace_id: Optional[str] = None) -> int:
             f"{_fmt_cell(row['observer_lag']):>8} "
             f"{_fmt_cell(row['admission_stall_p99_ms']):>13} "
             f"{_fmt_cell(row['send_lock_wait_p99_ms']):>12} "
-            f"{_fmt_cell(row['wal_fsync_share_pct']):>8}"
+            f"{_fmt_cell(row['wal_fsync_share_pct']):>8} "
+            f"{_fmt_cell(row.get('map_version')):>8}"
         )
     if not rows:
         print("(no history samples yet)")
+    for line in _map_skew_findings(
+        {row["group"]: row.get("map_version") for row in rows}
+    ):
+        print(f"map skew: {line}")
 
     findings = fleet_mod.detect_trends(doc["history"])
     for finding in findings:

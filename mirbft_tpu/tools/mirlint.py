@@ -983,6 +983,12 @@ REQUIRED_METRIC_NAMES = (
     "group_commits_total",
     "router_redirects_total",
     "observer_lag_batches",
+    # Elastic resharding (groups/reshard.py, docs/SHARDING.md
+    # "Elastic resharding").
+    "reshard_state",
+    "map_version",
+    "reshard_cutover_seconds",
+    "router_stale_map_redirects_total",
     # Fleet observability plane (fleet.py, net/telemetry.py,
     # docs/OBSERVABILITY.md "Fleet plane").
     "net_send_lock_wait_seconds",
@@ -1536,7 +1542,8 @@ def check_frame_subtypes(ship_module=None) -> List[Finding]:
     constants = {
         attr: value
         for attr, value in vars(ship_module).items()
-        if attr.startswith(("SHIP_", "MAP_")) and isinstance(value, int)
+        if attr.startswith(("SHIP_", "MAP_", "RESHARD_"))
+        and isinstance(value, int)
     }
     for attr, value in sorted(constants.items()):
         if value not in names:
@@ -1544,8 +1551,8 @@ def check_frame_subtypes(ship_module=None) -> List[Finding]:
     for value in sorted(names):
         if value not in constants.values():
             flag(
-                f"SUBTYPE_NAMES[{value}] has no matching SHIP_*/MAP_* "
-                "constant"
+                f"SUBTYPE_NAMES[{value}] has no matching "
+                "SHIP_*/MAP_*/RESHARD_* constant"
             )
     if len(set(constants.values())) != len(constants):
         flag(f"duplicate subtype values in {sorted(constants.items())}")

@@ -82,8 +82,10 @@ from mirbft_tpu.groups.routing import (
     GroupMap,
     RoutedClient,
     client_for_group,
+    client_hash,
 )
 from mirbft_tpu.groups.routing import CLIENT_REQ as _CLIENT_REQ
+from mirbft_tpu.groups.reshard import RESHARD_CONTROL_CLIENT
 
 _METRICS_SNAPSHOT_S = 0.5
 _PROPOSE_RETRY_S = 10.0
@@ -159,6 +161,7 @@ def _write_cluster(
     num_groups: int = 1,
     group_map: Optional[dict] = None,
     fleet: bool = False,
+    client_watermarks: Optional[Dict[int, int]] = None,
 ) -> None:
     """``cluster.json``: everything a child needs to boot.  The fault
     plane keys are optional — plain deployments (``run_deployment``) leave
@@ -197,6 +200,14 @@ def _write_cluster(
         doc["group_id"] = int(group_id)
         doc["num_groups"] = int(num_groups)
         doc["group_map"] = group_map or {}
+        # Elastic resharding (docs/SHARDING.md): a group bootstrapped as
+        # the receiving side of a client transfer seeds that client's
+        # request window at one past what the previous owner committed,
+        # so retried requests below the watermark dedup instead of
+        # double-committing.
+        doc["client_watermarks"] = {
+            str(k): int(v) for k, v in (client_watermarks or {}).items()
+        }
     _write_json_atomic(_cluster_path(root), doc)
 
 
@@ -279,6 +290,10 @@ class _CommitLogApp:
         # shipped batches then carry the trace trailer observers strip
         # before journaling, which keeps commits.log byte-identical.
         self.trace_lookup = None
+        # Optional groups.reshard.ReshardCoordinator: sees every applied
+        # batch (marker detection) and injects pending reconfigurations
+        # at checkpoint boundaries (docs/SHARDING.md).
+        self.reshard = None
 
     def apply(self, entry) -> None:
         reqs = ",".join(f"{r.client_id}:{r.req_no}" for r in entry.requests)
@@ -286,6 +301,8 @@ class _CommitLogApp:
         with self._lock:
             self._file.write(line + "\n")
             self._last_seq = entry.seq_no
+        if self.reshard is not None:
+            self.reshard.on_commit(entry.seq_no, entry.requests)
         if self.feed is not None:
             trace = None
             if self.trace_lookup is not None:
@@ -319,7 +336,13 @@ class _CommitLogApp:
                     f.write(f"{seq} {digest.hex()}\n")
             if self.feed is not None:
                 self.feed.note_checkpoint(seq, digest)
-            return digest, ()
+            pendings = ()
+            if self.reshard is not None:
+                # Deterministic across members: every node staged the
+                # same plan before the marker committed, so all emit the
+                # identical reconfiguration at the same checkpoint.
+                pendings = self.reshard.on_checkpoint(client_states, seq)
+            return digest, pendings
         return hashlib.sha256(encoded).digest() + encoded, ()
 
     def transfer_to(self, seq_no, snap):
@@ -379,7 +402,7 @@ class _Instance:
         from mirbft_tpu import metrics as metrics_mod
         from mirbft_tpu.config import Config, standard_initial_network_state
         from mirbft_tpu.health import HealthThresholds
-        from mirbft_tpu.net.framing import decode_client_envelope
+        from mirbft_tpu.net.framing import decode_client_envelope_routed
         from mirbft_tpu.net.tcp import TcpTransport, config_fingerprint
         from mirbft_tpu.node import Node, ProcessorConfig
         from mirbft_tpu.ops import CpuHasher
@@ -387,12 +410,20 @@ class _Instance:
 
         self.root = root
         self.node_id = node_id
-        self._decode_env = decode_client_envelope
+        self._decode_env = decode_client_envelope_routed
         self._submit_router = submit_router
 
         cluster = json.loads(_cluster_path(root).read_text())
         node_count = cluster["node_count"]
         self.client_ids = cluster["client_ids"]
+        # Transferred-client watermarks (docs/SHARDING.md "Elastic
+        # resharding"): requests below a client's watermark were already
+        # committed by the previous owner group — acked without
+        # proposing, and the genesis window starts past them.
+        self.client_watermarks: Dict[int, int] = {
+            int(k): int(v)
+            for k, v in (cluster.get("client_watermarks") or {}).items()
+        }
         ports: Dict[int, int] = {
             int(k): v for k, v in cluster["ports"].items()
         }
@@ -408,21 +439,38 @@ class _Instance:
         network_state = standard_initial_network_state(
             node_count, *self.client_ids
         )
+        if self.client_watermarks:
+            from mirbft_tpu.messages import ClientState, NetworkState
+
+            network_state = NetworkState(
+                config=network_state.config,
+                clients=tuple(
+                    ClientState(
+                        c.id,
+                        c.width,
+                        c.width_consumed_last_checkpoint,
+                        self.client_watermarks.get(c.id, c.low_watermark),
+                        c.committed_mask,
+                    )
+                    for c in network_state.clients
+                ),
+                pending_reconfigurations=(),
+            )
 
         self.group_id: Optional[int] = cluster.get("group_id")
         self.map_bytes: Optional[bytes] = None
+        self.current_map: Optional[GroupMap] = None
+        self.map_version = 0
         self.feed = None
+        self.reshard = None
         self._redirects = None
         if self.group_id is not None:
             from mirbft_tpu.groups.ship import ShipFeed
 
-            gmap = GroupMap(
-                {
-                    int(g): [(h, int(p)) for h, p in members]
-                    for g, members in cluster["group_map"].items()
-                }
-            )
+            gmap = GroupMap.from_json_doc(cluster["group_map"])
+            self.current_map = gmap
             self.map_bytes = gmap.to_json_bytes()
+            self.map_version = gmap.map_version
             self.feed = ShipFeed(self.group_id)
             self._redirects = metrics_mod.default_registry.counter(
                 "router_redirects_total",
@@ -507,6 +555,30 @@ class _Instance:
                 ndir / "checkpoints.log" if self.feed is not None else None
             ),
         )
+        if self.group_id is not None:
+            from mirbft_tpu.groups import reshard as reshard_mod
+
+            self.reshard = reshard_mod.ReshardCoordinator(
+                self.group_id,
+                initial_map_version=self.map_version,
+                state_path=ndir / "reshard-state.json",
+                on_cutover=self._install_map,
+            )
+            self.app.reshard = self.reshard
+            # A restart mid-reshard re-installs the post-cutover map the
+            # coordinator persisted (the feed has no subscribers yet, so
+            # no cutover frame needs re-pushing).
+            if (
+                self.reshard.phase >= reshard_mod.CUTTING
+                and self.reshard.plan is not None
+            ):
+                self._install_map(
+                    json.dumps(
+                        self.reshard.plan.map_doc, sort_keys=True
+                    ).encode(),
+                    self.reshard.plan.map_version(),
+                    self.reshard.marker_seq or 0,
+                )
         self.wal = GroupCommitWAL(str(ndir / "wal"))
         self.request_store = LogStore(str(ndir / "reqs"))
         pipeline = None
@@ -571,48 +643,89 @@ class _Instance:
         except Exception:
             pass  # node stopping; the reader connection just drops
 
-    def serve_client(self, body: bytes, reply, trace_id: int = 0) -> None:
+    def _install_map(self, map_bytes: bytes, version: int, seq: int) -> None:
+        """Cutover hook (groups/reshard.py): swap in the post-cutover map
+        and announce it on the ship feed.  Plain attribute assignment —
+        atomic under the GIL; reader threads pick up the new epoch on
+        their next redirect/route check."""
+        self.current_map = GroupMap.from_json_bytes(map_bytes)
+        self.map_bytes = map_bytes
+        self.map_version = version
+        if self.feed is not None:
+            self.feed.note_reshard_cutover(seq, map_bytes)
+
+    def serve_client(
+        self,
+        body: bytes,
+        reply,
+        trace_id: int = 0,
+        client_id: Optional[int] = None,
+    ) -> None:
         """Propose one de-enveloped client submission on this instance and
         ack it on the requester's connection.  A traced envelope binds the
         id locally and announces it to group peers (best-effort) so every
-        replica's commit span carries the request's trace id."""
+        replica's commit span carries the request's trace id.
+
+        ``client_id`` comes from a version-3 routed envelope; legacy
+        envelopes (None) mean the group's home client.  Two reshard
+        surfaces live here: requests below a transferred client's
+        watermark were committed by the previous owner and ack without
+        proposing, and while a reshard plan is in flight the moved
+        client's acks are **commit-gated** — an OK must imply commit,
+        or the cutover reconfiguration could drop an acked request."""
         from mirbft_tpu import tracing
 
         (req_no,) = _CLIENT_REQ.unpack_from(body)
         data = body[_CLIENT_REQ.size :]
-        client_id = self.client_ids[0]
+        if client_id is None:
+            client_id = self.client_ids[0]
+        watermark = self.client_watermarks.get(client_id)
+        if watermark is not None and req_no < watermark:
+            reply(CLIENT_OK)
+            return
         if trace_id:
             self.node.note_trace(client_id, req_no, trace_id)
             if self.fleet:
                 self._announce_trace(client_id, req_no, trace_id)
+        gated = (
+            self.reshard is not None
+            and self.reshard.gated_client() == client_id
+        )
         tracer = tracing.default_tracer
         start = tracer.now() if tracer.enabled else 0.0
         deadline = time.monotonic() + _PROPOSE_RETRY_S
         while time.monotonic() < deadline:
             try:
                 self.node.client(client_id).propose(req_no, data)
-                if tracer.enabled:
-                    # The routing tier's own span: admission of one routed
-                    # submission on this member, under the request's fleet
-                    # trace id when the envelope carried one.
-                    args = {
-                        "client": client_id,
-                        "req_no": req_no,
-                        "group": self.group_id,
-                    }
-                    if trace_id:
-                        args["trace"] = "%016x" % trace_id
-                    tracer.complete(
-                        "route_submit",
-                        start,
-                        pid=self.group_id or 0,
-                        tid=self.node_id,
-                        args=args,
-                    )
-                reply(CLIENT_OK)
-                return
             except KeyError:
                 time.sleep(0.02)  # client window not allocated yet
+                continue
+            if gated:
+                while self.reshard.committed_up_to(client_id) < req_no:
+                    if time.monotonic() >= deadline:
+                        reply(CLIENT_BUSY)  # not committed: client retries
+                        return
+                    time.sleep(0.02)
+            if tracer.enabled:
+                # The routing tier's own span: admission of one routed
+                # submission on this member, under the request's fleet
+                # trace id when the envelope carried one.
+                args = {
+                    "client": client_id,
+                    "req_no": req_no,
+                    "group": self.group_id,
+                }
+                if trace_id:
+                    args["trace"] = "%016x" % trace_id
+                tracer.complete(
+                    "route_submit",
+                    start,
+                    pid=self.group_id or 0,
+                    tid=self.node_id,
+                    args=args,
+                )
+            reply(CLIENT_OK)
+            return
         reply(CLIENT_BUSY)
 
     def _announce_trace(
@@ -639,25 +752,74 @@ class _Instance:
         reply(CLIENT_REDIRECT + self.map_bytes)
 
     def _on_client(self, payload: bytes, reply) -> None:
-        env_group, trace_id, body = self._decode_env(payload)
+        from mirbft_tpu.groups.reshard import RESHARD_CONTROL_CLIENT
+
+        env_group, trace_id, client_id, _mv, body = self._decode_env(
+            payload
+        )
         if self._submit_router is not None:
-            self._submit_router(env_group, body, reply, trace_id)
-        elif self.group_id is not None and env_group != self.group_id:
+            self._submit_router(
+                env_group, body, reply, trace_id, client_id
+            )
+        elif self.group_id is None:
+            self.serve_client(body, reply, trace_id=trace_id)
+        elif (
+            client_id is not None
+            and client_id != RESHARD_CONTROL_CLIENT
+        ):
+            # Routed (v3) envelope: route by the *client* under our own
+            # map — possibly newer than the sender's — so a submission
+            # routed under a stale epoch earns a redirect carrying the
+            # current map instead of committing to the wrong group.
+            # Control-client markers are exempt: the harness addresses
+            # them to a specific group by construction.
+            if self.current_map.group_for(client_id) != self.group_id:
+                self.redirect(reply)
+            else:
+                self.serve_client(
+                    body, reply, trace_id=trace_id, client_id=client_id
+                )
+        elif env_group != self.group_id:
             self.redirect(reply)
         else:
-            self.serve_client(body, reply, trace_id=trace_id)
+            self.serve_client(
+                body, reply, trace_id=trace_id, client_id=client_id
+            )
 
     def _on_group(self, payload: bytes, send) -> None:
+        from mirbft_tpu.groups import reshard as reshard_mod
         from mirbft_tpu.groups import ship
 
         try:
-            subtype, group, seq, _body = ship.decode(payload)
+            subtype, group, seq, body = ship.decode(payload)
         except ValueError:
             return  # garbage subframe: drop, never kill the connection
         if subtype == ship.MAP_REQUEST:
             send(ship.encode_map_reply(self.map_bytes))
         elif subtype == ship.SHIP_SUBSCRIBE and group == self.group_id:
             self.feed.handle_subscribe(seq, send)
+        elif subtype == ship.RESHARD_PLAN and group == self.group_id:
+            try:
+                self.reshard.stage(
+                    reshard_mod.ReshardPlan.from_json_bytes(body)
+                )
+                doc = self.reshard.state_doc()
+            except (ValueError, RuntimeError) as err:
+                doc = {"group": self.group_id, "error": str(err)}
+            send(
+                ship.encode_reshard_state(
+                    self.group_id, json.dumps(doc, sort_keys=True).encode()
+                )
+            )
+        elif subtype == ship.RESHARD_QUERY and group == self.group_id:
+            send(
+                ship.encode_reshard_state(
+                    self.group_id,
+                    json.dumps(
+                        self.reshard.state_doc(), sort_keys=True
+                    ).encode(),
+                )
+            )
 
     def _on_telemetry(self, payload: bytes, send) -> None:
         from mirbft_tpu import fleet as fleet_mod
@@ -857,12 +1019,20 @@ def run_host(root: Path, host_id: int) -> int:
 
     cohost_plane = _build_cohost_plane(n_groups, shard)
 
-    def router(env_group: int, body: bytes, reply, trace_id: int = 0) -> None:
+    def router(
+        env_group: int,
+        body: bytes,
+        reply,
+        trace_id: int = 0,
+        client_id: Optional[int] = None,
+    ) -> None:
         inst = instances.get(env_group)
         if inst is None:
             next(iter(instances.values())).redirect(reply)
         else:
-            inst.serve_client(body, reply, trace_id=trace_id)
+            inst.serve_client(
+                body, reply, trace_id=trace_id, client_id=client_id
+            )
 
     for g in range(n_groups):
         instances[g] = _Instance(
@@ -1462,6 +1632,7 @@ class _ShardedCluster:
         fleet: bool = False,
         fleet_observers: int = 0,
         shared_wave: Optional[bool] = None,
+        extra_clients: Optional[Dict[int, List[int]]] = None,
     ):
         if layout not in ("disjoint", "cohost"):
             raise ValueError(f"unknown shard layout {layout!r}")
@@ -1470,6 +1641,15 @@ class _ShardedCluster:
         self.groups = groups
         self.nodes_per_group = nodes_per_group
         self.layout = layout
+        # Stashed for add_group (a split child provisioned mid-run must
+        # boot with the same knobs as the original groups).
+        self._seed = seed
+        self._record_events = record_events
+        self._pipeline = pipeline
+        self._unreachable_after_s = unreachable_after_s
+        self._node_config = dict(
+            _STEADY_CONFIG if node_config is None else node_config
+        )
         # Cohost defaults to the shared cross-group wave (the whole point
         # of co-hosting); ``shared_wave=False`` is the escape hatch back
         # to per-group hashers.  Meaningless (and off) for disjoint.
@@ -1511,14 +1691,21 @@ class _ShardedCluster:
         }
         merged_thresholds = dict(_WIRE_THRESHOLDS)
         merged_thresholds.update(thresholds or {})
+        self._thresholds = merged_thresholds
         for g in range(groups):
             gdir = _group_dir(self.root, g)
             gdir.mkdir(parents=True, exist_ok=True)
+            # Every group's genesis admits the reshard control client —
+            # cutover markers (groups/reshard.py) are ordinary committed
+            # requests of that client, so it must exist before any plan
+            # is staged.  ``extra_clients`` adds scenario-specific client
+            # identities (e.g. the to-be-moved client of a split).
             _write_cluster(
                 gdir,
                 nodes_per_group,
                 [p for _h, p in self.map.members(g)],
-                [self.client_ids[g]],
+                [self.client_ids[g], RESHARD_CONTROL_CLIENT]
+                + list((extra_clients or {}).get(g, ())),
                 seed=seed + g,
                 faults=faults,
                 record_events=record_events,
@@ -1564,6 +1751,47 @@ class _ShardedCluster:
         self.procs[("obs", group_id, obs_idx)] = _spawn_observer(
             self.root, group_id, obs_idx
         )
+
+    def add_group(
+        self,
+        group_id: int,
+        ports: List[int],
+        client_ids: List[int],
+        group_map_doc: dict,
+        client_watermarks: Optional[Dict[int, int]] = None,
+    ) -> None:
+        """Provision and start a new group mid-run — the receiving side
+        of a split (docs/SHARDING.md "Elastic resharding").  The caller
+        reserved ``ports`` up front: the child's addresses must be known
+        *before* the parent's cutover marker commits, because the
+        post-cutover map riding in the marker already names them.
+        ``group_map_doc`` is the versioned map the children boot with;
+        ``client_watermarks`` seeds each moved client's request window
+        one past what the parent committed, so retries that straddle the
+        cutover dedup instead of double-committing."""
+        gdir = _group_dir(self.root, group_id)
+        gdir.mkdir(parents=True, exist_ok=True)
+        _write_cluster(
+            gdir,
+            len(ports),
+            ports,
+            client_ids,
+            seed=self._seed + group_id,
+            faults=False,
+            record_events=self._record_events,
+            thresholds=self._thresholds,
+            node_config=dict(self._node_config),
+            unreachable_after_s=self._unreachable_after_s,
+            pipeline=self._pipeline,
+            group_id=group_id,
+            num_groups=len(group_map_doc.get("groups", group_map_doc)),
+            group_map=group_map_doc,
+            fleet=self.fleet,
+            client_watermarks=client_watermarks,
+        )
+        for i in range(len(ports)):
+            _node_dir(gdir, i).mkdir(parents=True, exist_ok=True)
+            self.procs[("node", group_id, i)] = _spawn(gdir, i)
 
     # --- fleet telemetry ---
 
@@ -3210,6 +3438,666 @@ def _scenario_cross_group_partition(
     return _verdict(root, "cross-group-partition", res, failures)
 
 
+# --------------------------------------------------------------------------
+# Elastic resharding choreography (docs/SHARDING.md "Elastic resharding")
+# --------------------------------------------------------------------------
+
+
+def _group_rpc(addr: Tuple[str, int], payload: bytes,
+               timeout_s: float = 10.0) -> bytes:
+    """One KIND_GROUP request/reply round trip against a group member."""
+    from mirbft_tpu.net.framing import KIND_GROUP, FrameDecoder, encode_frame
+
+    with socket.create_connection(addr, timeout=timeout_s) as sock:
+        sock.settimeout(timeout_s)
+        sock.sendall(encode_frame(KIND_GROUP, payload))
+        decoder = FrameDecoder()
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                raise ConnectionError(f"{addr} closed before replying")
+            for kind, reply in decoder.feed(data):
+                if kind == KIND_GROUP:
+                    return reply
+
+
+def _stage_plan(members: List[Tuple[str, int]], plan) -> None:
+    """Stage one ReshardPlan on *every* member before its marker is
+    submitted — the plan carries the cutover semantics (batches circulate
+    as digests), so a member without it could not act on the marker.
+    Raises if any member rejects the plan."""
+    from mirbft_tpu.groups import ship
+
+    payload = ship.encode_reshard_plan(
+        plan.group_id, plan.marker_req_no, plan.to_json_bytes()
+    )
+    for addr in members:
+        subtype, _g, _s, body = ship.decode(_group_rpc(addr, payload))
+        doc = json.loads(body.decode())
+        if subtype != ship.RESHARD_STATE or doc.get("error"):
+            raise RuntimeError(f"{addr} rejected reshard plan: {doc}")
+
+
+def _reshard_state(addr: Tuple[str, int], group_id: int) -> dict:
+    from mirbft_tpu.groups import ship
+
+    reply = _group_rpc(addr, ship.encode_reshard_query(group_id))
+    _sub, _g, _s, body = ship.decode(reply)
+    return json.loads(body.decode())
+
+
+def _submit_control(addr: Tuple[str, int], group_id: int, req_no: int,
+                    timeout_s: float = 30.0) -> None:
+    """Commit one request of the reserved control client on ``group_id``
+    via ``addr`` (cutover markers and the drain pump).  Control requests
+    are addressed by the envelope group and exempt from client-routing,
+    so they land exactly where the harness points them."""
+    from mirbft_tpu.net.framing import (
+        KIND_CLIENT,
+        FrameDecoder,
+        encode_client_envelope,
+        encode_frame,
+    )
+
+    body = _CLIENT_REQ.pack(req_no) + b"reshard-marker"
+    frame = encode_frame(
+        KIND_CLIENT,
+        encode_client_envelope(
+            group_id, body, client_id=RESHARD_CONTROL_CLIENT
+        ),
+    )
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(addr, timeout=10.0) as sock:
+                sock.settimeout(10.0)
+                sock.sendall(frame)
+                decoder = FrameDecoder()
+                status = b""
+                while not status:
+                    data = sock.recv(65536)
+                    if not data:
+                        raise ConnectionError("closed mid-reply")
+                    for kind, payload in decoder.feed(data):
+                        if kind == KIND_CLIENT:
+                            status = payload[:1]
+                            break
+            if status == CLIENT_OK:
+                return
+        except (OSError, ConnectionError):
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(
+        f"group {group_id} never accepted control request {req_no}"
+    )
+
+
+def _wait_reshard_done(addr: Tuple[str, int], group_id: int,
+                       timeout_s: float = 90.0,
+                       pump_next_ctrl: Optional[int] = None) -> dict:
+    """Poll RESHARD_QUERY until the coordinator reports DONE; returns the
+    final state document.  ``pump_next_ctrl`` drives the group's sequence
+    space forward with control-client commits — a *drained* group has no
+    organic traffic left, and reconfigurations only apply at checkpoint
+    boundaries, so someone must keep the log moving."""
+    from mirbft_tpu.groups import reshard as reshard_mod
+
+    deadline = time.monotonic() + timeout_s
+    ctrl = pump_next_ctrl
+    last: dict = {}
+    while time.monotonic() < deadline:
+        try:
+            last = _reshard_state(addr, group_id)
+        except (OSError, ConnectionError):
+            time.sleep(0.2)
+            continue
+        if last.get("phase") == reshard_mod.DONE:
+            return last
+        if ctrl is not None:
+            _submit_control(addr, group_id, ctrl, timeout_s=10.0)
+            ctrl += 1
+        time.sleep(0.2)
+    raise TimeoutError(f"group {group_id} reshard stuck at {last}")
+
+
+def _client_with_residue(modulus: int, residue: int, avoid=(),
+                         start: int = 1) -> int:
+    """Smallest client id >= ``start`` whose routing hash has the given
+    residue — how the scenarios pick the "staying" and "moved" clients of
+    a split of the dense ``(2, 1)`` route into ``(4, 1)`` + ``(4, 3)``."""
+    cid = start
+    while client_hash(cid) % modulus != residue or cid in avoid:
+        cid += 1
+        if cid - start > 200_000:
+            raise RuntimeError(
+                f"no client id with hash residue {residue} (mod {modulus})"
+            )
+    return cid
+
+
+class _ReshardLoad(threading.Thread):
+    """One client's continuous, strictly sequential submission stream,
+    kept running *across* cutovers.  Redirect chases, BUSY backpressure,
+    refused stale-map downgrades, and connection failures (mid-split the
+    child group's members are not even listening yet) are all survivable:
+    the thread retries the same req_no until it acks, so ``acked`` is the
+    exactly-once floor the verdict checks against."""
+
+    def __init__(self, group_map: GroupMap, client_id: int,
+                 stop: threading.Event, pace_s: float = 0.02):
+        super().__init__(daemon=True)
+        self.client_id = client_id
+        self._halt = stop
+        self.pace_s = pace_s
+        self.client = RoutedClient(group_map=group_map)
+        self.acked = 0
+        self.errors = 0
+
+    def run(self) -> None:
+        req_no = 0
+        while not self._halt.is_set():
+            try:
+                ok = self.client.submit(
+                    self.client_id, req_no, b"reshard-%d" % req_no
+                )
+            except (OSError, ConnectionError):
+                self.errors += 1
+                time.sleep(0.1)
+                continue
+            if ok:
+                req_no += 1
+                self.acked = req_no
+                time.sleep(self.pace_s)
+            else:
+                time.sleep(0.05)
+        self.client.close()
+
+
+def _wait_load(threads, target: int, timeout_s: float) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if all(t.acked >= target for t in threads):
+            return
+        time.sleep(0.1)
+    raise TimeoutError(
+        "load threads stuck: " + ", ".join(
+            f"client {t.client_id}: {t.acked}/{target} "
+            f"(errors {t.errors})"
+            for t in threads
+        )
+    )
+
+
+def _wait_client_commits(gdir: Path, node_ids, client_id: int, reqs,
+                         timeout_s: float) -> None:
+    """Block until every node in ``node_ids`` has committed all of
+    ``reqs`` for ``client_id``."""
+    from mirbft_tpu.groups import reshard as reshard_mod
+
+    want = set(reqs)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if all(
+            want <= reshard_mod.committed_requests_of(
+                _read_commits(gdir, i), client_id
+            )
+            for i in node_ids
+        ):
+            return
+        time.sleep(0.2)
+    raise TimeoutError(
+        f"client {client_id} requests never all committed in {gdir}"
+    )
+
+
+def _observer_backlog_problems(root: Path, group_id: int, obs_idx: int,
+                               moved: int, parent_lines: List[str],
+                               ceiling: int) -> List[str]:
+    """Bootstrap-observer identity check: every commit line the observer
+    holds must be byte-identical to the parent's at the same sequence,
+    and from its first applied sequence up to ``ceiling`` (the split
+    cutover checkpoint it was confirmed synced through before being
+    promoted) it must hold *every* parent line carrying the moved client
+    (its half of the backlog).  The ceiling matters in the merge run:
+    the moved client re-enters the parent long after the observers were
+    promoted away, and those later commits are not backlog."""
+    from mirbft_tpu.groups import reshard as reshard_mod
+
+    obs_path = _observer_dir(root, group_id, obs_idx) / "commits.log"
+    obs_lines = (
+        [ln for ln in obs_path.read_text().splitlines() if ln]
+        if obs_path.exists()
+        else []
+    )
+    if not obs_lines:
+        return [f"observer {obs_idx} applied nothing"]
+    problems: List[str] = []
+    by_seq = {int(ln.split(" ", 1)[0]): ln for ln in parent_lines}
+    floor = int(obs_lines[0].split(" ", 1)[0])
+    for line in obs_lines:
+        seq = int(line.split(" ", 1)[0])
+        if by_seq.get(seq) != line:
+            problems.append(
+                f"observer {obs_idx} diverges from parent at seq {seq}"
+            )
+    have = {
+        (reshard_mod.parse_commit_line(ln)[0], rno)
+        for ln in obs_lines
+        for cid, rno in reshard_mod.parse_commit_line(ln)[1]
+        if cid == moved
+    }
+    missing = {
+        (seq, rno)
+        for ln in parent_lines
+        for seq, pairs in [reshard_mod.parse_commit_line(ln)]
+        for cid, rno in pairs
+        if cid == moved and floor <= seq <= ceiling
+    } - have
+    if missing:
+        problems.append(
+            f"observer {obs_idx} backlog misses moved-client commits "
+            f"{sorted(missing)[:8]}"
+        )
+    return problems
+
+
+def _run_reshard(root: Path, seed: int, *, pipeline: bool = True,
+                 merge: bool = False) -> dict:
+    """Shared split(+merge) choreography.  Split: group 1's dense
+    ``(2, 1)`` route refines into parent ``(4, 1)`` + child group 3 at
+    ``(4, 3)``; the child's members bootstrap as observers of the parent,
+    the parent commits the marker, and the moved client's stream heals
+    onto the child with requests below the transfer watermark deduped.
+    Merge reverses it: the child drains the client back behind a second
+    marker and the parent re-admits it at the child's watermark —
+    crossing a deliberate stale-redirect window while the parent still
+    serves the older map."""
+    from mirbft_tpu.config import DEFAULT_CLIENT_WIDTH
+    from mirbft_tpu.groups import reshard as reshard_mod
+    from mirbft_tpu.tools.mircat import doctor_deployment
+
+    groups, npg = 2, 2
+    ci = 5 * npg  # standard_initial_network_state checkpoint interval
+    parent, child = 1, 3  # child id skips 2: exercises sparse group ids
+    staying = _client_with_residue(4, 1)
+    moved = _client_with_residue(4, 3, avoid={staying})
+    name = "reshard-merge" if merge else "reshard-split"
+    res: dict = {
+        "staying_client": staying,
+        "moved_client": moved,
+    }
+    failures: List[str] = []
+    with _ShardedCluster(
+        root,
+        groups=groups,
+        nodes_per_group=npg,
+        seed=seed,
+        record_events=True,
+        timeout_s=120.0,
+        pipeline=pipeline,
+        extra_clients={parent: [staying, moved]},
+    ) as cluster:
+        cluster.start()
+        home0 = cluster.client_ids[0]
+        parent_members = cluster.map.members(parent)
+        _connect_routed(cluster.map.members(0)[0], 60.0).close()
+        # Child members bootstrap as observers of the parent over the
+        # ship feed + KIND_SNAPSHOT plane — spawned before any load so
+        # their committed prefix starts at genesis.
+        cluster.spawn_observer(parent, 0)
+        cluster.spawn_observer(parent, 1)
+
+        stop = threading.Event()
+        loads = {
+            "home0": _ReshardLoad(cluster.map, home0, stop),
+            "staying": _ReshardLoad(cluster.map, staying, stop),
+            "moved": _ReshardLoad(cluster.map, moved, stop),
+        }
+        try:
+            for t in loads.values():
+                t.start()
+            _wait_load(loads.values(), 5, timeout_s=90.0)
+
+            # --- split ---
+            child_ports = _reserve_ports(npg)
+            child_members = [("127.0.0.1", p) for p in child_ports]
+            v1 = cluster.map.split_group(parent, child, child_members)
+            v1_doc = json.loads(v1.to_json_bytes().decode())
+            split_plan = reshard_mod.ReshardPlan(
+                plan_id=f"split-{seed}",
+                action=reshard_mod.ACTION_SPLIT,
+                group_id=parent,
+                moved_client=moved,
+                moved_client_width=DEFAULT_CLIENT_WIDTH,
+                map_doc=v1_doc,
+                marker_req_no=0,
+            )
+            _stage_plan(parent_members, split_plan)
+            head0_at_marker = cluster.head(0)
+            _submit_control(parent_members[0], parent, 0)
+            split_state = _wait_reshard_done(parent_members[0], parent)
+            head0_at_done = cluster.head(0)
+
+            # The parent's moved-client commits are final once the
+            # removal applied; sync the bootstrapping observers past the
+            # reconfiguration checkpoint, then promote them: stop the
+            # learners and boot the child group's voters on the
+            # pre-reserved addresses the v1 map already names.
+            for k in (0, 1):
+                wait_observer_synced(
+                    root, parent, k, split_state["cutover_seq"],
+                    timeout_s=60.0,
+                )
+                proc = cluster.procs.pop(("obs", parent, k))
+                proc.terminate()
+                proc.wait(timeout=15)
+            parent_lines_mid = _read_commits(_group_dir(root, parent), 0)
+            w0 = reshard_mod.low_watermark_after(parent_lines_mid, moved)
+            backlog = reshard_mod.backlog_lines(parent_lines_mid, moved)
+            child_gdir = _group_dir(root, child)
+            child_gdir.mkdir(parents=True, exist_ok=True)
+            (child_gdir / "backlog.log").write_text(
+                "".join(line + "\n" for line in backlog)
+            )
+            cluster.add_group(
+                child,
+                child_ports,
+                [moved, RESHARD_CONTROL_CLIENT],
+                v1_doc,
+                client_watermarks={moved: w0},
+            )
+            moved_at_cutover = loads["moved"].acked
+            _wait_load([loads["moved"]], moved_at_cutover + 5,
+                       timeout_s=90.0)
+            base = {k: t.acked for k, t in loads.items()}
+            _wait_load(loads.values(), max(base.values()) + 3,
+                       timeout_s=90.0)
+            res.update(
+                w0=w0,
+                split_state=split_state,
+                moved_at_cutover=moved_at_cutover,
+                head0_at_marker=head0_at_marker,
+                head0_at_done=head0_at_done,
+            )
+
+            if merge:
+                # --- merge: drain the child back into the parent ---
+                v2 = v1.merge_group(child, parent)
+                v2_doc = json.loads(v2.to_json_bytes().decode())
+                drain_plan = reshard_mod.ReshardPlan(
+                    plan_id=f"drain-{seed}",
+                    action=reshard_mod.ACTION_MERGE_DRAIN,
+                    group_id=child,
+                    moved_client=moved,
+                    moved_client_width=DEFAULT_CLIENT_WIDTH,
+                    map_doc=v2_doc,
+                    marker_req_no=0,
+                )
+                _stage_plan(child_members, drain_plan)
+                _submit_control(child_members[0], child, 0)
+                drain_state = _wait_reshard_done(
+                    child_members[0], child, pump_next_ctrl=1
+                )
+                # Deliberate stale-redirect window: the parent still
+                # serves map v1 and redirects the moved client with it;
+                # the router must refuse the downgrade (and count it)
+                # rather than bounce between epochs.
+                time.sleep(1.5)
+                w1 = reshard_mod.low_watermark_after(
+                    _read_commits(child_gdir, 0), moved
+                )
+                merge_plan = reshard_mod.ReshardPlan(
+                    plan_id=f"merge-{seed}",
+                    action=reshard_mod.ACTION_MERGE_COMMIT,
+                    group_id=parent,
+                    moved_client=moved,
+                    moved_client_width=DEFAULT_CLIENT_WIDTH,
+                    map_doc=v2_doc,
+                    marker_req_no=1,
+                    low_watermark=w1,
+                )
+                _stage_plan(parent_members, merge_plan)
+                _submit_control(parent_members[0], parent, 1)
+                merge_state = _wait_reshard_done(
+                    parent_members[0], parent
+                )
+                moved_at_merge = loads["moved"].acked
+                _wait_load([loads["moved"]], moved_at_merge + 3,
+                           timeout_s=90.0)
+                final_client = _connect_routed(parent_members[0], 30.0)
+                final_map = final_client.map
+                final_client.close()
+                res.update(
+                    w1=w1,
+                    drain_state=drain_state,
+                    merge_state=merge_state,
+                    stale_redirects=loads["moved"].client.stale_redirects,
+                    final_map_version=final_map.map_version,
+                    final_routes={
+                        g: list(r) for g, r in final_map.routes.items()
+                    },
+                    final_addrs_match=(
+                        final_map.addrs
+                        == {g: cluster.map.addrs[g] for g in (0, 1)}
+                    ),
+                )
+        finally:
+            stop.set()
+            for t in loads.values():
+                t.join(timeout=30.0)
+        totals = {k: t.acked for k, t in loads.items()}
+        res["acked"] = totals
+
+        # Everything acked must land on disk before judging.
+        _wait_client_commits(
+            _group_dir(root, 0), range(npg), home0,
+            range(totals["home0"]), timeout_s=60.0,
+        )
+        _wait_client_commits(
+            _group_dir(root, parent), range(npg), staying,
+            range(totals["staying"]), timeout_s=60.0,
+        )
+        moved_home = _group_dir(root, parent if merge else child)
+        _wait_client_commits(
+            moved_home, range(npg), moved,
+            range(res["w1"] if merge else w0, totals["moved"]),
+            timeout_s=60.0,
+        )
+        cluster.stop_all()
+
+        # --- judgement ---
+        parent_lines = _read_commits(_group_dir(root, parent), 0)
+        child_lines = _read_commits(child_gdir, 0)
+        group0_lines = _read_commits(_group_dir(root, 0), 0)
+        parent_moved = reshard_mod.committed_requests_of(
+            parent_lines, moved
+        )
+        child_moved = reshard_mod.committed_requests_of(
+            child_lines, moved
+        )
+        union = parent_moved | child_moved
+        n_top = (max(union) + 1) if union else 0
+        res["moved_committed"] = {
+            "parent": len(parent_moved),
+            "child": len(child_moved),
+        }
+        if parent_moved & child_moved:
+            failures.append(
+                f"moved client committed twice: "
+                f"{sorted(parent_moved & child_moved)[:8]}"
+            )
+        if not union >= set(range(totals["moved"])):
+            failures.append(
+                f"moved client lost acked requests: "
+                f"{sorted(set(range(totals['moved'])) - union)[:8]}"
+            )
+        if union != set(range(n_top)):
+            failures.append(
+                f"moved client commit range has gaps: "
+                f"{sorted(set(range(n_top)) - union)[:8]}"
+            )
+        if merge:
+            w1 = res["w1"]
+            if child_moved != set(range(w0, w1)):
+                failures.append(
+                    f"child committed outside its [{w0}, {w1}) span"
+                )
+            expect_parent = set(range(w0)) | set(range(w1, n_top))
+            if parent_moved != expect_parent:
+                failures.append(
+                    f"parent moved-client commits not "
+                    f"[0, {w0}) + [{w1}, {n_top})"
+                )
+            if res["stale_redirects"] < 1:
+                failures.append(
+                    "moved client never saw a refused stale-map redirect "
+                    "across the merge window"
+                )
+            if res["final_map_version"] != 2:
+                failures.append(
+                    f"final map version {res['final_map_version']}, "
+                    f"expected 2"
+                )
+            # Pre-split routes restored (modulo map_version): the same
+            # two groups, the same members, the dense route shape.
+            if res["final_routes"] != {0: [2, 0], 1: [2, 1]}:
+                failures.append(
+                    f"merge did not restore the dense routes: "
+                    f"{res['final_routes']}"
+                )
+            if not res["final_addrs_match"]:
+                failures.append(
+                    "merge did not restore the pre-split membership"
+                )
+            state2 = merge_state
+            if (
+                state2["cutover_seq"] - state2["marker_seq"] > 2 * ci
+            ):
+                failures.append(
+                    f"merge cutover stalled the parent "
+                    f"{state2['cutover_seq'] - state2['marker_seq']} seqs "
+                    f"(> {2 * ci})"
+                )
+        else:
+            if parent_moved != set(range(w0)):
+                failures.append(
+                    f"parent moved-client commits not exactly [0, {w0})"
+                )
+            if child_moved and min(child_moved) < w0:
+                failures.append(
+                    f"child committed below the watermark {w0}"
+                )
+        if reshard_mod.committed_requests_of(child_lines, staying):
+            failures.append("staying client leaked into the child group")
+        if reshard_mod.committed_requests_of(group0_lines, moved):
+            failures.append("moved client leaked into group 0")
+        state1 = split_state
+        if state1["cutover_seq"] - state1["marker_seq"] > 2 * ci:
+            failures.append(
+                f"split cutover stalled the parent "
+                f"{state1['cutover_seq'] - state1['marker_seq']} seqs "
+                f"(> {2 * ci})"
+            )
+        if head0_at_done <= head0_at_marker:
+            failures.append(
+                "group 0's head stood still across the split cutover "
+                f"({head0_at_marker} -> {head0_at_done})"
+            )
+        for g in (0, parent, child):
+            problems = _agreement_by_seq(
+                _group_dir(root, g), list(range(npg))
+            )
+            if problems:
+                failures.append(f"group {g}: " + "; ".join(problems))
+        for k in (0, 1):
+            for problem in _observer_backlog_problems(
+                root, parent, k, moved, parent_lines,
+                split_state["cutover_seq"]
+            ):
+                failures.append(problem)
+        doctors = {
+            g: doctor_deployment(_group_dir(root, g))
+            for g in (0, parent, child)
+        }
+        res["doctor"] = {
+            g: {"healthy": d["healthy"], "faults": d["faults"]}
+            for g, d in doctors.items()
+        }
+        # A cutover ends the epoch at the reconfiguration checkpoint
+        # (machine._complete_pending_reconfiguration): every tracker
+        # reinitializes and the epoch-tracker's resume path deliberately
+        # self-suspects, so the doctor attributes ``suspicion_vote`` to
+        # the epoch primary and may log a transient ``watermark_stall``
+        # in the groups that cut over.  Tolerate exactly those kinds
+        # there — the sequence-space stall bound above already caps the
+        # pause — and hold every uninvolved group to strict health
+        # ("zero stall in uninvolved groups").
+        cutover_groups = {parent, child} if merge else {parent}
+        for g, d in doctors.items():
+            if d["healthy"]:
+                continue
+            if g in cutover_groups:
+                kinds = {k.split(":", 1)[1] for k in d["faults"]}
+                anomalies = {
+                    kind
+                    for node in d["per_node"].values()
+                    for kind in node["anomaly_kinds"]
+                }
+                if not kinds - {"suspicion_vote"} and not anomalies - {
+                    "peer_fault",
+                    "watermark_stall",
+                }:
+                    continue
+            failures.append(
+                f"group {g} doctor unhealthy: faults={d['faults']} "
+                f"anomalies={d['anomaly_count']}"
+            )
+        want_version = 2 if merge else 1
+        res["metrics"] = {
+            "parent_map_version": cluster.group_metric(
+                parent, "map_version"
+            ),
+            "parent_reshard_state": cluster.group_metric(
+                parent, "reshard_state"
+            ),
+            "parent_cutover_seconds": cluster.group_metric(
+                parent, "reshard_cutover_seconds"
+            ),
+            "child_map_version": cluster.group_metric(
+                child, "map_version"
+            ),
+        }
+        if res["metrics"]["parent_map_version"] != npg * want_version:
+            failures.append(
+                f"parent map_version gauges sum to "
+                f"{res['metrics']['parent_map_version']}, expected "
+                f"{npg * want_version}"
+            )
+        if res["metrics"]["parent_cutover_seconds"] <= 0:
+            failures.append("reshard_cutover_seconds never observed")
+    return _verdict(root, name, res, failures)
+
+
+def _scenario_reshard_split(root: Path, seed: int, *,
+                            pipeline: bool = True) -> dict:
+    """Live split: group 1 sheds its ``(4, 3)`` residue clients to a new
+    group 3 bootstrapped from observers, behind a consensus-ordered
+    cutover marker — clients keep submitting throughout; judged on
+    exactly-once across the cutover, byte-identical logs within every
+    group, a bounded parent stall, and an untouched group 0."""
+    return _run_reshard(root, seed, pipeline=pipeline, merge=False)
+
+
+def _scenario_reshard_merge(root: Path, seed: int, *,
+                            pipeline: bool = True) -> dict:
+    """Split, then merge back: the child drains the moved client behind
+    its own marker, the parent re-admits it at the child's watermark, and
+    the fleet returns to the pre-split routes (modulo ``map_version``) —
+    with the moved client deliberately crossing a stale-redirect window
+    that the router must refuse to downgrade through."""
+    return _run_reshard(root, seed, pipeline=pipeline, merge=True)
+
+
 SCENARIOS = {
     "control": _scenario_control,
     "cross-group-partition": _scenario_cross_group_partition,
@@ -3220,6 +4108,8 @@ SCENARIOS = {
     "byzantine-leader": _scenario_byzantine_leader,
     "rolling-kill": _scenario_rolling_kill,
     "kill-under-write": _scenario_kill_under_write,
+    "reshard-split": _scenario_reshard_split,
+    "reshard-merge": _scenario_reshard_merge,
 }
 
 

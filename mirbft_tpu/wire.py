@@ -140,6 +140,7 @@ _REGISTRY_ORDER: List[type] = [
     s.RecordedEvent,
     m.AckBatch,
     m.MsgBatch,
+    m.ReconfigTransferClient,
 ]
 
 _TAG_OF: Dict[type, int] = {cls: i for i, cls in enumerate(_REGISTRY_ORDER)}
